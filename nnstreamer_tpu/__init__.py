@@ -1,0 +1,41 @@
+"""nnstreamer_tpu — a TPU-native streaming-AI framework.
+
+A ground-up re-design of the capabilities of NNStreamer (reference:
+/root/reference, v2.3.0) for TPU hardware: typed, shape-negotiated tensor
+stream pipelines whose tensor-domain subgraphs compile to single XLA
+computations executed via jit/PJRT, with pallas kernels for hot ops and
+jax.sharding meshes for multi-chip scale-out.
+
+Layer map (mirrors SURVEY.md §1, re-architected):
+
+  tensor/    — tensor data model: dtypes, TensorInfo/TensorsSpec, dim
+               strings, self-describing meta header, sparse codec, buffers
+               (reference L1: gst/nnstreamer/include/tensor_typedef.h)
+  core/      — config, subplugin registry, logging, errors (reference L2)
+  graph/     — pipeline graph, gst-launch-style DSL, static shape/dtype
+               negotiation (reference: GStreamer caps negotiation)
+  runtime/   — push-model streaming scheduler (reference: GStreamer core)
+  elements/  — pipeline elements (reference L3: gst/nnstreamer/elements/)
+  backends/  — filter backends: XLA/jit, custom callables, pallas
+               (reference L4: ext/nnstreamer/tensor_filter/*)
+  models/    — flagship model zoo (MobileNetV2, SSD, PoseNet) in flax
+  parallel/  — mesh sharding, pod batch dispatcher, ring attention
+  edge/      — among-device offload: query client/server, pub/sub
+               (reference L5: tensor_query/, gst/edge/, gst/mqtt/)
+  trainer/   — on-device training element (reference: tensor_trainer type)
+"""
+
+__version__ = "0.1.0"
+
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec, TensorFormat
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+__all__ = [
+    "DType",
+    "TensorInfo",
+    "TensorsSpec",
+    "TensorFormat",
+    "TensorBuffer",
+    "__version__",
+]

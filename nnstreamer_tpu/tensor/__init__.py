@@ -1,0 +1,22 @@
+"""Tensor data model — the framework's L1 (no device, no jax dependency).
+
+Reference parity: gst/nnstreamer/include/tensor_typedef.h,
+nnstreamer_plugin_api_util_impl.c (dim strings, info compare/size),
+gst_tensor_meta_info_* (self-describing per-tensor header),
+gsttensor_sparseutil.c (COO sparse codec).
+"""
+
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec, TensorFormat, MediaType
+from nnstreamer_tpu.tensor.meta import MetaHeader
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+__all__ = [
+    "DType",
+    "TensorInfo",
+    "TensorsSpec",
+    "TensorFormat",
+    "MediaType",
+    "MetaHeader",
+    "TensorBuffer",
+]

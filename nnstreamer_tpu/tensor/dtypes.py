@@ -1,0 +1,119 @@
+"""Tensor element dtypes.
+
+Reference parity: the 11 dtypes of `_nns_tensor_type`
+(gst/nnstreamer/include/tensor_typedef.h:131-146). We keep the reference's
+wire enum ordering (so serialized streams are stable) and extend with
+``bfloat16`` — the TPU-native compute dtype the reference lacks — at the
+tail of the enum space.
+
+This module is pure python + numpy; jax is never imported here so the
+tensor core stays usable host-side (wire codecs, CLI tools) with no
+device runtime.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class DType(enum.IntEnum):
+    """Element type of a tensor. Values are the wire/enum encoding."""
+
+    INT32 = 0
+    UINT32 = 1
+    INT16 = 2
+    UINT16 = 3
+    INT8 = 4
+    UINT8 = 5
+    FLOAT64 = 6
+    FLOAT32 = 7
+    INT64 = 8
+    UINT64 = 9
+    FLOAT16 = 10
+    # TPU extension (not in the reference enum): XLA's preferred matmul dtype.
+    BFLOAT16 = 11
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        try:
+            return _NP_DTYPES[self]
+        except KeyError:
+            raise TypeError(
+                f"dtype {self.type_name} has no host numpy representation on "
+                f"this system (bfloat16 requires the ml_dtypes package)"
+            ) from None
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+    @property
+    def type_name(self) -> str:
+        return _NAMES[self]
+
+    @classmethod
+    def from_name(cls, name: str) -> "DType":
+        try:
+            return _BY_NAME[name.strip().lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown tensor dtype {name!r}; valid names: {sorted(_BY_NAME)}"
+            ) from None
+
+    @classmethod
+    def from_np(cls, dtype) -> "DType":
+        dtype = np.dtype(dtype) if not _is_ml_dtype(dtype) else dtype
+        key = str(dtype)
+        try:
+            return _BY_NAME[key]
+        except KeyError:
+            raise ValueError(f"no tensor DType for numpy dtype {dtype!r}") from None
+
+
+def _is_ml_dtype(dtype) -> bool:
+    return str(dtype) == "bfloat16"
+
+
+def _bfloat16_np():
+    """bfloat16 numpy dtype via ml_dtypes (vendored with jax)."""
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+_NP_DTYPES = {
+    DType.INT32: np.dtype(np.int32),
+    DType.UINT32: np.dtype(np.uint32),
+    DType.INT16: np.dtype(np.int16),
+    DType.UINT16: np.dtype(np.uint16),
+    DType.INT8: np.dtype(np.int8),
+    DType.UINT8: np.dtype(np.uint8),
+    DType.FLOAT64: np.dtype(np.float64),
+    DType.FLOAT32: np.dtype(np.float32),
+    DType.INT64: np.dtype(np.int64),
+    DType.UINT64: np.dtype(np.uint64),
+    DType.FLOAT16: np.dtype(np.float16),
+}
+try:  # bfloat16 requires ml_dtypes; degrade gracefully without it.
+    _NP_DTYPES[DType.BFLOAT16] = _bfloat16_np()
+except ImportError:  # pragma: no cover
+    pass
+
+_NAMES = {
+    DType.INT32: "int32",
+    DType.UINT32: "uint32",
+    DType.INT16: "int16",
+    DType.UINT16: "uint16",
+    DType.INT8: "int8",
+    DType.UINT8: "uint8",
+    DType.FLOAT64: "float64",
+    DType.FLOAT32: "float32",
+    DType.INT64: "int64",
+    DType.UINT64: "uint64",
+    DType.FLOAT16: "float16",
+    DType.BFLOAT16: "bfloat16",
+}
+
+_BY_NAME = {name: dt for dt, name in _NAMES.items()}

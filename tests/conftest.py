@@ -1,0 +1,27 @@
+"""Test config: force an 8-device virtual CPU mesh before jax loads.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective tests
+run on XLA's host platform with 8 virtual devices (same technique the
+driver's dryrun uses). Bench (bench.py) runs on the real chip instead.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_cpu_devices():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip(f"need 8 virtual devices, got {len(devs)}")
+    return devs

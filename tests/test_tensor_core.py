@@ -1,0 +1,270 @@
+"""Tensor core unit tests (reference: tests/common/unittest_common.cc)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.tensor import (
+    DType,
+    MediaType,
+    MetaHeader,
+    TensorBuffer,
+    TensorFormat,
+    TensorInfo,
+    TensorsSpec,
+)
+from nnstreamer_tpu.tensor.info import (
+    parse_dim_string,
+    shapes_compatible,
+    to_dim_string,
+)
+from nnstreamer_tpu.tensor.sparse import sparse_decode, sparse_encode, sparse_nbytes
+
+
+class TestDTypes:
+    def test_roundtrip_names(self):
+        for dt in DType:
+            assert DType.from_name(dt.type_name) is dt
+
+    def test_np_roundtrip(self):
+        for dt in DType:
+            if dt == DType.BFLOAT16:
+                continue
+            assert DType.from_np(dt.np_dtype) is dt
+
+    def test_bfloat16(self):
+        dt = DType.BFLOAT16
+        assert dt.itemsize == 2
+        assert DType.from_name("bfloat16") is dt
+
+    def test_unknown_name(self):
+        with pytest.raises(ValueError, match="unknown tensor dtype"):
+            DType.from_name("float128")
+
+    def test_wire_values_stable(self):
+        # Wire enum encoding must not drift (serialized stream compat).
+        assert DType.INT32 == 0
+        assert DType.UINT8 == 5
+        assert DType.FLOAT32 == 7
+        assert DType.FLOAT16 == 10
+        assert DType.BFLOAT16 == 11
+
+
+class TestDimStrings:
+    def test_parse_reference_order(self):
+        # reference: "3:224:224:1" = ch:w:h:batch innermost-first
+        assert parse_dim_string("3:224:224:1") == (1, 224, 224, 3)
+
+    def test_roundtrip(self):
+        for s in ["1", "3:224:224:1", "10:1:1:1", "5:4:3:2:1"]:
+            assert to_dim_string(parse_dim_string(s)) == s
+
+    def test_bad_dims(self):
+        for bad in ["", "0:3", "-1:2", "a:b", ":" , "3:?"]:
+            with pytest.raises(ValueError):
+                parse_dim_string(bad)
+
+    def test_rank_limit(self):
+        with pytest.raises(ValueError, match="rank"):
+            parse_dim_string(":".join(["2"] * 17))
+
+    def test_compat_ignores_padding(self):
+        assert shapes_compatible((1, 224, 224, 3), (224, 224, 3))
+        assert shapes_compatible((1, 1, 5), (5,))
+        assert not shapes_compatible((2, 5), (5,))
+
+
+class TestTensorInfo:
+    def test_size(self):
+        ti = TensorInfo.from_dim_string("3:224:224:1", "uint8")
+        assert ti.nbytes == 224 * 224 * 3
+        assert ti.num_elements == 224 * 224 * 3
+
+    def test_compat(self):
+        a = TensorInfo((1, 10), DType.FLOAT32)
+        b = TensorInfo((10,), DType.FLOAT32)
+        c = TensorInfo((10,), DType.UINT8)
+        assert a.is_compatible(b)
+        assert not a.is_compatible(c)
+
+    def test_bad_shape(self):
+        with pytest.raises(ValueError):
+            TensorInfo((0, 5))
+
+
+class TestTensorsSpec:
+    def test_from_strings_multi(self):
+        spec = TensorsSpec.from_strings("3:224:224:1,1001:1", "uint8,float32")
+        assert spec.num_tensors == 2
+        assert spec.tensors[0].dtype == DType.UINT8
+        assert spec.tensors[1].shape == (1, 1001)
+
+    def test_type_broadcast(self):
+        spec = TensorsSpec.from_strings("4:4,2:2", "float32")
+        assert all(t.dtype == DType.FLOAT32 for t in spec.tensors)
+
+    def test_mismatched_lists(self):
+        with pytest.raises(ValueError, match="entries"):
+            TensorsSpec.from_strings("4:4,2:2,1:1", "float32,uint8")
+
+    def test_hashable(self):
+        a = TensorsSpec.from_strings("3:4:5", "float32")
+        b = TensorsSpec.from_strings("3:4:5", "float32")
+        assert a == b and hash(a) == hash(b)
+        assert {a: 1}[b] == 1
+
+    def test_max_tensors(self):
+        infos = tuple(TensorInfo((1,)) for _ in range(17))
+        with pytest.raises(ValueError, match="exceeds limit"):
+            TensorsSpec(tensors=infos)
+
+    def test_flexible_matches_anything(self):
+        flex = TensorsSpec.of(TensorInfo((1,)), format=TensorFormat.FLEXIBLE)
+        stat = TensorsSpec.from_strings("3:224:224:1", "uint8")
+        assert flex.is_compatible(stat)
+
+    def test_roundtrip_strings(self):
+        spec = TensorsSpec.from_strings("3:224:224:1,1001:1", "uint8,float32", "img,logits")
+        dims, types, names = spec.to_strings()
+        spec2 = TensorsSpec.from_strings(dims, types, names)
+        assert spec == spec2
+
+
+class TestMetaHeader:
+    def test_roundtrip(self):
+        hdr = MetaHeader(shape=(1, 224, 224, 3), dtype=DType.UINT8,
+                         media=MediaType.VIDEO)
+        data = hdr.pack() + b"payload"
+        parsed, off = MetaHeader.unpack(data)
+        assert parsed == hdr
+        assert data[off:] == b"payload"
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError, match="magic"):
+            MetaHeader.unpack(b"\x00" * 64)
+
+    def test_truncated(self):
+        hdr = MetaHeader(shape=(4, 4), dtype=DType.FLOAT32).pack()
+        with pytest.raises(ValueError):
+            MetaHeader.unpack(hdr[:8])
+
+    def test_info_roundtrip(self):
+        ti = TensorInfo((7, 5), DType.INT16)
+        hdr = MetaHeader.for_info(ti)
+        assert hdr.to_info() == ti
+
+
+class TestSparse:
+    def test_roundtrip(self):
+        rng = np.random.default_rng(0)
+        dense = rng.random((8, 16)).astype(np.float32)
+        dense[dense < 0.9] = 0
+        frame = sparse_encode(dense)
+        out = sparse_decode(frame)
+        np.testing.assert_array_equal(out, dense)
+
+    def test_int_dtype(self):
+        dense = np.zeros((4, 4), dtype=np.int16)
+        dense[1, 2] = -7
+        np.testing.assert_array_equal(sparse_decode(sparse_encode(dense)), dense)
+
+    def test_all_zero(self):
+        dense = np.zeros((3, 3), dtype=np.float32)
+        np.testing.assert_array_equal(sparse_decode(sparse_encode(dense)), dense)
+
+    def test_size_win(self):
+        dense = np.zeros((100, 100), dtype=np.float32)
+        dense[0, 0] = 1
+        sp, dn = sparse_nbytes(dense)
+        assert sp < dn
+
+    def test_reject_dense_frame(self):
+        hdr = MetaHeader(shape=(2, 2), dtype=DType.FLOAT32).pack()
+        with pytest.raises(ValueError, match="not a sparse"):
+            sparse_decode(hdr + b"\x00" * 16)
+
+
+class TestTensorBuffer:
+    def test_spec(self):
+        buf = TensorBuffer.of(np.zeros((1, 4), np.float32), np.zeros((2,), np.uint8))
+        spec = buf.spec()
+        assert spec.num_tensors == 2
+        assert spec.tensors[1].dtype == DType.UINT8
+
+    def test_subset(self):
+        buf = TensorBuffer.of(*(np.full((1,), i) for i in range(4)))
+        sub = buf.subset([2, 0])
+        assert sub.tensors[0][0] == 2 and sub.tensors[1][0] == 0
+        with pytest.raises(IndexError, match="out of range"):
+            buf.subset([7])
+
+    def test_meta_update(self):
+        buf = TensorBuffer.of(np.zeros(1), pts=123)
+        b2 = buf.with_meta(client_id=9)
+        assert b2.meta["client_id"] == 9 and b2.pts == 123
+        assert "client_id" not in buf.meta
+
+    def test_host_passthrough(self):
+        buf = TensorBuffer.of(np.zeros(3))
+        assert buf.to_host() is buf
+        assert not buf.on_device
+
+
+class TestCorruptWire:
+    """Regression tests for malformed-wire handling (review findings)."""
+
+    def test_sparse_oob_index(self):
+        from nnstreamer_tpu.tensor.info import TensorFormat
+        hdr = MetaHeader(shape=(2, 2), dtype=DType.FLOAT32,
+                         format=TensorFormat.SPARSE, extra=1)
+        frame = hdr.pack() + np.float32(1.0).tobytes() + np.uint32(100).tobytes()
+        with pytest.raises(ValueError, match="out of range"):
+            sparse_decode(frame)
+
+    def test_sparse_nnz_too_large(self):
+        from nnstreamer_tpu.tensor.info import TensorFormat
+        hdr = MetaHeader(shape=(2, 2), dtype=DType.FLOAT32,
+                         format=TensorFormat.SPARSE, extra=10**6)
+        with pytest.raises(ValueError, match="nnz"):
+            sparse_decode(hdr.pack() + b"\x00" * 64)
+
+    def test_sparse_0d(self):
+        scalar = np.array(3.0, dtype=np.float32)
+        out = sparse_decode(sparse_encode(scalar))
+        assert out.reshape(()) == scalar
+
+    def test_empty_dim_segment(self):
+        for bad in ["3::4", "3:224:224:1:", ":3"]:
+            with pytest.raises(ValueError, match="empty segment"):
+                parse_dim_string(bad)
+
+    def test_sparse_giant_shape_refused(self):
+        from nnstreamer_tpu.tensor.info import TensorFormat
+        hdr = MetaHeader(shape=(1 << 22, 1 << 22), dtype=DType.FLOAT32,
+                         format=TensorFormat.SPARSE, extra=0)
+        with pytest.raises(ValueError, match="decode limit"):
+            sparse_decode(hdr.pack())
+
+    def test_format_mismatch_incompatible(self):
+        stat = TensorsSpec.of(TensorInfo((4,)))
+        sp = TensorsSpec.of(TensorInfo((4,)), format=TensorFormat.SPARSE)
+        assert not stat.is_compatible(sp)
+
+    def test_meta_not_shared_across_derived(self):
+        buf = TensorBuffer.of(np.zeros(1), np.zeros(1))
+        d = buf.subset([0])
+        d.meta["x"] = 1
+        assert "x" not in buf.meta
+        d2 = buf.with_tensors([np.ones(1)])
+        d2.meta["y"] = 2
+        assert "y" not in buf.meta
+
+    def test_subset_rejects_negative(self):
+        buf = TensorBuffer.of(np.zeros(1), np.zeros(1))
+        with pytest.raises(IndexError):
+            buf.subset([-1])
+
+    def test_sparse_nbytes_matches_encode(self):
+        for arr in [np.array(3.0, np.float32),
+                    np.eye(5, dtype=np.float32)]:
+            sp, dn = sparse_nbytes(arr)
+            assert sp == len(sparse_encode(arr))
