@@ -37,5 +37,29 @@ __all__ = [
     "TensorsSpec",
     "TensorFormat",
     "TensorBuffer",
+    "Pipeline",
+    "parse_launch",
+    "run_pipeline",
+    "PipelineRunner",
+    "register_custom_easy",
     "__version__",
 ]
+
+_LAZY = {
+    "Pipeline": ("nnstreamer_tpu.graph.pipeline", "Pipeline"),
+    "parse_launch": ("nnstreamer_tpu.graph.parse", "parse_launch"),
+    "run_pipeline": ("nnstreamer_tpu.runtime.scheduler", "run_pipeline"),
+    "PipelineRunner": ("nnstreamer_tpu.runtime.scheduler", "PipelineRunner"),
+    "register_custom_easy": ("nnstreamer_tpu.backends.custom",
+                             "register_custom_easy"),
+}
+
+
+def __getattr__(name):
+    # lazy so `import nnstreamer_tpu` stays light for wire-codec-only use
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,25 @@
+"""Filter backends — the model-execution engines behind `tensor_filter`.
+
+The reference ships ~20 vendor subplugins implementing
+`GstTensorFilterFramework` (SURVEY.md §2.3). The TPU build replaces that
+zoo with three first-class backends:
+
+- ``xla``     — models as jax callables / flax modules / StableHLO,
+                jit-compiled and executed on TPU (backends/xla.py)
+- ``custom``  — in-process python callables (the custom-easy analog,
+                include/tensor_filter_custom_easy.h)
+- ``pallas``  — hand-written TPU kernels registered as filters
+
+Importing this package registers all built-in backends.
+"""
+
+from nnstreamer_tpu.backends.base import FilterBackend
+from nnstreamer_tpu.backends.custom import CustomBackend, register_custom_easy
+from nnstreamer_tpu.backends.xla import XLABackend
+
+__all__ = [
+    "FilterBackend",
+    "CustomBackend",
+    "XLABackend",
+    "register_custom_easy",
+]

@@ -1,0 +1,82 @@
+"""Filter backend interface.
+
+Reference parity: `GstTensorFilterFramework` v1 vtable
+(include/nnstreamer_plugin_api_filter.h:273 — open/close/invoke/
+getModelInfo/eventHandler). Differences, TPU-first:
+
+- `invoke` takes/returns tuples of arrays (numpy or jax.Array) instead of
+  raw memory chunks; a backend may return device arrays so downstream
+  elements stay zero-copy on device.
+- `fuse(pre, post)` lets the filter element hand the backend the
+  elementwise pre/post-processing chains adjacent to it in the graph, so
+  they compile **into the same XLA computation** (the north-star fusion;
+  no reference equivalent).
+- `reload(model)` is the is-updatable hot-swap hook
+  (plugin_api_filter.h:377 reloadModel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.core.registry import PluginKind, registry
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+ArrayTuple = Tuple[Any, ...]
+ElementwiseFn = Callable[[ArrayTuple], ArrayTuple]
+
+
+class FilterBackend:
+    """One model-execution engine instance (per tensor_filter element)."""
+
+    BACKEND_NAME: str = ""
+
+    def open(self, props: Dict[str, Any]) -> None:
+        """Load the model described by element properties (fw->open)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def get_model_info(self) -> Tuple[Optional[TensorsSpec], Optional[TensorsSpec]]:
+        """→ (input spec, output spec); either may be None if the model
+        adapts to the negotiated input (fw->getModelInfo)."""
+        raise NotImplementedError
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Fix the input spec for adaptive models → resulting output spec
+        (fw->getModelInfo(SET_INPUT_INFO) analog)."""
+        raise BackendError(
+            f"backend {self.BACKEND_NAME!r} does not support dynamic input "
+            f"reconfiguration; set the model's input dimensions explicitly"
+        )
+
+    def fuse(self, pre: Optional[ElementwiseFn], post: Optional[ElementwiseFn]) -> bool:
+        """Offer pre/post elementwise chains for compilation into the
+        model's computation. Return True if absorbed (the element then
+        skips host-side application). Default: not absorbed."""
+        return False
+
+    def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
+        """Run the model on one frame's tensors (the hot loop)."""
+        raise NotImplementedError
+
+    def reload(self, model: Any) -> None:
+        raise BackendError(
+            f"backend {self.BACKEND_NAME!r} does not support model reload"
+        )
+
+
+def register_backend(name: str):
+    """Class decorator registering a FilterBackend under `name`."""
+    def deco(cls):
+        cls.BACKEND_NAME = name
+        registry.register(PluginKind.FILTER, name, cls)
+        return cls
+    return deco
+
+
+def get_backend(name: str) -> FilterBackend:
+    cls = registry.get(PluginKind.FILTER, name)
+    return cls()

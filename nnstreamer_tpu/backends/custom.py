@@ -1,0 +1,146 @@
+"""Custom python-callable backend (the custom-easy analog).
+
+Reference parity: include/tensor_filter_custom_easy.h
+(`NNS_custom_easy_register` — register an in-process function + fixed
+in/out info under a name, then `framework=custom-easy model=<name>`), and
+tensor_filter_custom.c for loading user code by path.
+
+Two ways to name a model:
+- a registered name (``register_custom_easy("scaler", fn, in_spec,
+  out_spec)`` → ``framework=custom model=scaler``)
+- a python path ``pkg.module:callable`` imported on open (the .so-loading
+  analog); the callable may carry ``in_spec``/``out_spec`` attributes.
+
+These double as the **fake frameworks** of the test strategy (SURVEY.md §4
+takeaway a): deterministic element tests with no XLA in the loop.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from nnstreamer_tpu.backends.base import ArrayTuple, FilterBackend, register_backend
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+
+@dataclass
+class _CustomEntry:
+    fn: Callable[[ArrayTuple], ArrayTuple]
+    in_spec: Optional[TensorsSpec]
+    out_spec: Optional[TensorsSpec]
+    # optional: out spec as a function of in spec (adaptive models)
+    infer_out: Optional[Callable[[TensorsSpec], TensorsSpec]] = None
+
+
+_table: Dict[str, _CustomEntry] = {}
+_table_lock = threading.Lock()
+
+
+def register_custom_easy(
+    name: str,
+    fn: Callable[[ArrayTuple], ArrayTuple],
+    in_spec: Optional[TensorsSpec] = None,
+    out_spec: Optional[TensorsSpec] = None,
+    infer_out: Optional[Callable[[TensorsSpec], TensorsSpec]] = None,
+) -> Callable:
+    """Register `fn` as an invokable model under `name`.
+
+    `fn` maps a tuple of arrays to a tuple of arrays. Specs may be omitted
+    for passthrough-shaped models, or `infer_out` given for adaptive ones.
+    """
+    with _table_lock:
+        _table[name] = _CustomEntry(fn, in_spec, out_spec, infer_out)
+    return fn
+
+
+def unregister_custom_easy(name: str) -> bool:
+    with _table_lock:
+        return _table.pop(name, None) is not None
+
+
+@register_backend("custom")
+class CustomBackend(FilterBackend):
+    def __init__(self):
+        self._entry: Optional[_CustomEntry] = None
+        self._model_name = ""
+
+    def open(self, props: Dict[str, Any]) -> None:
+        model = props.get("model")
+        if not model:
+            raise BackendError(
+                "framework=custom requires model=<registered name or "
+                "python path 'pkg.module:callable'>"
+            )
+        self._model_name = model
+        with _table_lock:
+            entry = _table.get(model)
+        if entry is None and (":" in model):
+            entry = self._load_python_path(model)
+        if entry is None:
+            with _table_lock:
+                names = sorted(_table)
+            raise BackendError(
+                f"no custom model {model!r}; registered: {names or '(none)'}. "
+                f"Use register_custom_easy() or a 'pkg.module:callable' path."
+            )
+        self._entry = entry
+
+    def _load_python_path(self, path: str) -> _CustomEntry:
+        mod_name, _, attr = path.partition(":")
+        try:
+            mod = importlib.import_module(mod_name)
+            fn = getattr(mod, attr)
+        except (ImportError, AttributeError) as e:
+            raise BackendError(
+                f"cannot load custom model {path!r}: {e}"
+            ) from e
+        return _CustomEntry(
+            fn,
+            getattr(fn, "in_spec", None),
+            getattr(fn, "out_spec", None),
+            getattr(fn, "infer_out", None),
+        )
+
+    def get_model_info(self):
+        assert self._entry is not None, "open() not called"
+        return self._entry.in_spec, self._entry.out_spec
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        assert self._entry is not None
+        if self._entry.infer_out is not None:
+            return self._entry.infer_out(in_spec)
+        if self._entry.out_spec is not None:
+            return self._entry.out_spec
+        # No declared output spec: probe the callable once with zeros so
+        # negotiation reflects reality (custom fns must be side-effect-free
+        # or declare out_spec/infer_out explicitly).
+        import numpy as np
+
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        probe = tuple(
+            np.zeros(t.shape, t.dtype.np_dtype) for t in in_spec.tensors
+        )
+        try:
+            out = self.invoke(probe)
+        except Exception as e:
+            raise BackendError(
+                f"custom model {self._model_name!r} declares no output spec "
+                f"and probing it with zero input {in_spec} failed: {e}. "
+                f"Register it with out_spec= or infer_out= instead."
+            ) from e
+        return TensorBuffer.of(*out).spec()
+
+    def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
+        assert self._entry is not None
+        out = self._entry.fn(tensors)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return out
+
+    def reload(self, model: Any) -> None:
+        self.open({"model": model})

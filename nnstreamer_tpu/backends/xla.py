@@ -1,0 +1,243 @@
+"""XLA backend — the one first-class NN engine (replaces the reference's
+vendor subplugin zoo, SURVEY.md §2.3; the BASELINE.json north star).
+
+A model is a jax-traceable callable ``fn(params, *inputs) -> outputs``
+plus a params pytree. Sources of models:
+
+- the in-repo model zoo (``model=zoo://mobilenet_v2``) — models/zoo.py
+- a python path (``model=pkg.module:build``) whose callable returns a
+  `ModelBundle` or is itself the traced function
+- a `ModelBundle` passed programmatically to the element
+
+TPU-first properties:
+- **Fusion**: the tensor_transform chains adjacent to the filter are
+  absorbed via `fuse()` and traced into the *same* jit computation, so
+  normalization/typecast/argmax run on-device fused around the matmuls —
+  zero extra HBM round-trips (north star: "fold tensor_transform into the
+  same XLA computation").
+- **Negotiation via tracing**: output specs come from `jax.eval_shape`
+  (no device work at build time).
+- **Async dispatch**: `invoke` returns device arrays without blocking; the
+  scheduler's queues overlap host work with device execution. The D2H
+  sync happens once, at a sink/decoder (TensorBuffer.to_host) — the
+  anti-pattern this avoids is the reference's per-frame
+  cudaDeviceSynchronize (tensor_filter_tensorrt.cc:239).
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from nnstreamer_tpu.backends.base import (
+    ArrayTuple,
+    ElementwiseFn,
+    FilterBackend,
+    register_backend,
+)
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+log = get_logger("backend.xla")
+
+
+@dataclass
+class ModelBundle:
+    """A loadable model: traced function + params + optional fixed specs."""
+
+    fn: Callable[..., Any]            # fn(params, *inputs) -> output(s)
+    params: Any = None
+    in_spec: Optional[TensorsSpec] = None
+    out_spec: Optional[TensorsSpec] = None
+    name: str = ""
+
+
+def _to_tuple(x) -> Tuple:
+    if isinstance(x, tuple):
+        return x
+    if isinstance(x, list):
+        return tuple(x)
+    return (x,)
+
+
+def _spec_from_shapes(shapes) -> TensorsSpec:
+    infos = tuple(
+        TensorInfo(shape=tuple(s.shape), dtype=DType.from_np(s.dtype))
+        for s in shapes
+    )
+    return TensorsSpec(tensors=infos)
+
+
+@register_backend("xla")
+class XLABackend(FilterBackend):
+    def __init__(self):
+        self._bundle: Optional[ModelBundle] = None
+        self._pre: Optional[ElementwiseFn] = None
+        self._post: Optional[ElementwiseFn] = None
+        self._jitted = None
+        self._device = None
+        self._device_params = None
+        self._in_spec: Optional[TensorsSpec] = None
+        self._out_spec: Optional[TensorsSpec] = None
+
+    # -- open / model resolution ------------------------------------------
+    def open(self, props: Dict[str, Any]) -> None:
+        import jax
+
+        model = props.get("model")
+        if model is None:
+            raise BackendError(
+                "framework=xla requires model=<zoo://name | pkg.module:attr "
+                "| ModelBundle | jax callable>"
+            )
+        self._bundle = self._resolve(model)
+        accel = props.get("accelerator") or ""
+        self._device = self._pick_device(accel)
+        if self._bundle.params is not None:
+            self._device_params = jax.device_put(self._bundle.params, self._device)
+        else:
+            self._device_params = None
+        log.info("opened model %s on %s", self._bundle.name or model, self._device)
+
+    def _resolve(self, model) -> ModelBundle:
+        if isinstance(model, ModelBundle):
+            return model
+        if callable(model):
+            return ModelBundle(
+                fn=lambda params, *xs: model(*xs),
+                params=None,
+                in_spec=getattr(model, "in_spec", None),
+                out_spec=getattr(model, "out_spec", None),
+                name=getattr(model, "__name__", "callable"),
+            )
+        if isinstance(model, str) and model.startswith("zoo://"):
+            try:
+                from nnstreamer_tpu.models.zoo import build_model
+            except ImportError as e:
+                raise BackendError(f"model zoo unavailable: {e}") from e
+            return build_model(model[len("zoo://"):])
+        if isinstance(model, str) and ":" in model:
+            mod_name, _, attr = model.partition(":")
+            try:
+                obj = getattr(importlib.import_module(mod_name), attr)
+            except (ImportError, AttributeError) as e:
+                raise BackendError(f"cannot load model {model!r}: {e}") from e
+            built = obj() if not isinstance(obj, ModelBundle) else obj
+            if isinstance(built, ModelBundle):
+                return built
+            return self._resolve(built)
+        raise BackendError(
+            f"unrecognized model reference {model!r} for framework=xla; "
+            f"expected zoo://<name>, pkg.module:attr, a ModelBundle, or a "
+            f"jax callable"
+        )
+
+    def _pick_device(self, accelerator: str):
+        import jax
+
+        devices = jax.devices()
+        if accelerator:
+            # "tpu:2" / "tpu" / "cpu" (accl_hw-string analog, hw_accel.c)
+            kind, _, idx = accelerator.partition(":")
+            matching = [d for d in devices if d.platform.lower() == kind.lower()]
+            if not matching:
+                raise BackendError(
+                    f"accelerator={accelerator!r} but no {kind!r} device is "
+                    f"visible; available: "
+                    f"{sorted({d.platform for d in devices})}"
+                )
+            return matching[int(idx)] if idx else matching[0]
+        return devices[0]
+
+    def close(self) -> None:
+        self._jitted = None
+        self._device_params = None
+
+    # -- info / negotiation ------------------------------------------------
+    def get_model_info(self):
+        assert self._bundle is not None, "open() not called"
+        return self._bundle.in_spec, self._bundle.out_spec
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Shape-infer the model's own output via jax.eval_shape.
+
+        `in_spec` is what the *model* sees (the element already applied
+        fused pre-chain spec transfer); fused chains affect invoke()
+        only, so eval_shape runs on the bare bundle fn.
+        """
+        import jax
+
+        assert self._bundle is not None
+        self._in_spec = in_spec
+        bundle = self._bundle
+        bare = lambda params, *xs: _to_tuple(bundle.fn(params, *xs))
+        args = [
+            jax.ShapeDtypeStruct(t.shape, t.dtype.np_dtype)
+            for t in in_spec.tensors
+        ]
+        try:
+            out = jax.eval_shape(bare, self._abstract_params(), *args)
+        except Exception as e:
+            raise BackendError(
+                f"model {self._bundle.name!r} does not accept input "
+                f"{in_spec}: {e}"
+            ) from e
+        self._out_spec = _spec_from_shapes(_to_tuple(out))
+        return self._out_spec
+
+    def _abstract_params(self):
+        import jax
+
+        if self._device_params is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._device_params
+        )
+
+    # -- fusion ------------------------------------------------------------
+    def fuse(self, pre: Optional[ElementwiseFn], post: Optional[ElementwiseFn]) -> bool:
+        self._pre = pre
+        self._post = post
+        self._jitted = None  # recompile with the fused graph
+        return True
+
+    def _full_fn(self):
+        bundle = self._bundle
+        pre, post = self._pre, self._post
+
+        def full(params, *xs):
+            if pre is not None:
+                xs = pre(xs)
+            out = _to_tuple(bundle.fn(params, *xs))
+            if post is not None:
+                out = post(out)
+            return out
+
+        return full
+
+    # -- hot loop ----------------------------------------------------------
+    def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
+        import jax
+
+        if self._jitted is None:
+            self._jitted = jax.jit(self._full_fn())
+        staged = tuple(jax.device_put(t, self._device) for t in tensors)
+        out = self._jitted(self._device_params, *staged)
+        return _to_tuple(out)
+
+    def reload(self, model: Any) -> None:
+        """Hot model swap (is-updatable analog): double-buffered — the new
+        bundle is resolved and staged before the old one is dropped."""
+        import jax
+
+        new_bundle = self._resolve(model)
+        new_params = (
+            jax.device_put(new_bundle.params, self._device)
+            if new_bundle.params is not None
+            else None
+        )
+        self._bundle, self._device_params = new_bundle, new_params
+        self._jitted = None

@@ -1,0 +1,27 @@
+"""Core plumbing services — config, registry, logging, errors (reference L2).
+
+Reference parity: gst/nnstreamer/nnstreamer_conf.c (ini+env config),
+nnstreamer_subplugin.c (name→vtable registries), nnstreamer_log.c.
+"""
+
+from nnstreamer_tpu.core.errors import (
+    BackendError,
+    ConfigError,
+    NegotiationError,
+    PipelineError,
+    StreamError,
+)
+from nnstreamer_tpu.core.config import Config, get_config
+from nnstreamer_tpu.core.registry import PluginKind, registry
+
+__all__ = [
+    "BackendError",
+    "ConfigError",
+    "NegotiationError",
+    "PipelineError",
+    "StreamError",
+    "Config",
+    "get_config",
+    "PluginKind",
+    "registry",
+]

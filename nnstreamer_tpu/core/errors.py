@@ -1,0 +1,37 @@
+"""Framework exception taxonomy.
+
+The reference's UX rule — long, actionable error strings that tell the
+user exactly which property to fix (tensor_filter.c:558-628) — is a
+contract here: every raise should name the element, the property, and a
+suggested fix where known.
+"""
+
+from __future__ import annotations
+
+
+class NNStreamerTPUError(Exception):
+    """Base class for all framework errors."""
+
+
+class ConfigError(NNStreamerTPUError):
+    """Bad configuration file / env var / property value."""
+
+
+class NegotiationError(NNStreamerTPUError):
+    """Static shape/dtype negotiation failed between two linked elements.
+
+    Equivalent of a GStreamer caps-negotiation failure, raised at pipeline
+    build time — never in the steady-state loop.
+    """
+
+
+class PipelineError(NNStreamerTPUError):
+    """Malformed pipeline description or graph structure."""
+
+
+class BackendError(NNStreamerTPUError):
+    """A filter backend (XLA / custom / pallas) failed to open or invoke."""
+
+
+class StreamError(NNStreamerTPUError):
+    """Runtime dataflow failure (the GST_FLOW_ERROR analog)."""

@@ -1,0 +1,35 @@
+"""Logging (reference: nnstreamer_log.c/h ml_logi/w/e/d + stacktrace).
+
+Thin layer over python logging with one framework-wide logger tree
+(``nnstreamer_tpu.*``) and a fatal-path helper that attaches a formatted
+stack trace the way ml_loge_stacktrace does (nnstreamer_log.h:95-107).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import traceback
+
+_ROOT = "nnstreamer_tpu"
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
+
+
+def log_error_with_trace(logger: logging.Logger, msg: str, *args) -> None:
+    """Error + current stack (the ml_loge_stacktrace analog)."""
+    stack = "".join(traceback.format_stack()[:-1])
+    logger.error(msg + "\nstack:\n%s", *args, stack)
+
+
+def _init_from_env() -> None:
+    """NNSTREAMER_TPU_LOG=debug|info|warning|error sets the tree level."""
+    level = os.environ.get("NNSTREAMER_TPU_LOG", "").upper()
+    if level and hasattr(logging, level):
+        logging.basicConfig()
+        get_logger().setLevel(getattr(logging, level))
+
+
+_init_from_env()

@@ -1,0 +1,136 @@
+"""Subplugin registries (reference: gst/nnstreamer/nnstreamer_subplugin.c).
+
+One name→object table per plugin kind — the reference's per-type
+GHashTable (register_subplugin/get_subplugin, nnstreamer_subplugin.h:61-92)
+— with python-module loading in place of dlopen: a miss triggers a scan of
+the config's plugin paths for ``<name>.py`` / any module that registers
+the name at import (constructor-self-registration analog).
+
+Kinds follow the reference set {FILTER, DECODER, CONVERTER, TRAINER}
+(nnstreamer_subplugin.h) plus ELEMENT for pipeline-element classes used by
+the DSL parser.
+"""
+
+from __future__ import annotations
+
+import enum
+import importlib
+import importlib.util
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from nnstreamer_tpu.core.config import get_config
+from nnstreamer_tpu.core.errors import ConfigError, PipelineError
+from nnstreamer_tpu.core.log import get_logger
+
+log = get_logger("registry")
+
+
+class PluginKind(enum.Enum):
+    ELEMENT = "element"
+    FILTER = "filter"        # model-execution backends
+    DECODER = "decoder"      # tensor→media decoders
+    CONVERTER = "converter"  # media→tensor converters
+    TRAINER = "trainer"
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._tables: Dict[PluginKind, Dict[str, Any]] = {
+            k: {} for k in PluginKind
+        }
+        self._scanned = False
+
+    def register(self, kind: PluginKind, name: str, obj: Any = None):
+        """Register `obj` under (kind, name); usable as a decorator.
+
+        Re-registration of the same name replaces the entry (the reference
+        refuses duplicates; replacement is friendlier for notebook reload).
+        """
+        if obj is None:
+            def deco(o):
+                self.register(kind, name, o)
+                return o
+            return deco
+        with self._lock:
+            if name in self._tables[kind]:
+                log.warning("replacing %s plugin %r", kind.value, name)
+            self._tables[kind][name] = obj
+        return obj
+
+    def unregister(self, kind: PluginKind, name: str) -> bool:
+        with self._lock:
+            return self._tables[kind].pop(name, None) is not None
+
+    def get(self, kind: PluginKind, name: str) -> Any:
+        with self._lock:
+            obj = self._tables[kind].get(name)
+        if obj is not None:
+            return obj
+        # lazy path scan (the g_module_open-on-demand analog)
+        self._scan_plugin_paths()
+        with self._lock:
+            obj = self._tables[kind].get(name)
+        if obj is None:
+            raise PipelineError(
+                f"no {kind.value} plugin named {name!r}; registered "
+                f"{kind.value}s: {sorted(self._tables[kind]) or '(none)'}. "
+                f"Register one with registry.register(PluginKind."
+                f"{kind.name}, {name!r}, obj) or add its module directory "
+                f"to [common] plugin_paths / $NNSTREAMER_TPU_PLUGINS."
+            )
+        return obj
+
+    def find(self, kind: PluginKind, name: str) -> Optional[Any]:
+        with self._lock:
+            return self._tables[kind].get(name)
+
+    def names(self, kind: PluginKind) -> List[str]:
+        with self._lock:
+            return sorted(self._tables[kind])
+
+    # -- module scanning ---------------------------------------------------
+    def _scan_plugin_paths(self) -> None:
+        with self._lock:
+            if self._scanned:
+                return
+            self._scanned = True
+        for path in get_config().plugin_paths():
+            if not path.is_dir():
+                log.warning("plugin path %s does not exist", path)
+                continue
+            for mod_file in sorted(path.glob("*.py")):
+                self.load_module(str(mod_file))
+
+    def load_module(self, path: str) -> None:
+        """Import a plugin module by file path; importing registers it."""
+        mod_name = f"nnstreamer_tpu_plugin_{abs(hash(path)):x}"
+        if mod_name in sys.modules:
+            return
+        spec = importlib.util.spec_from_file_location(mod_name, path)
+        if spec is None or spec.loader is None:
+            raise ConfigError(f"cannot load plugin module {path}")
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[mod_name] = module
+        try:
+            spec.loader.exec_module(module)
+            log.info("loaded plugin module %s", path)
+        except Exception:
+            del sys.modules[mod_name]
+            raise
+
+    def rescan(self) -> None:
+        with self._lock:
+            self._scanned = False
+        self._scan_plugin_paths()
+
+
+#: process-wide registry (the reference's static per-type tables)
+registry = Registry()
+
+
+def register_element(name: str) -> Callable:
+    """Class decorator: `@register_element("tensor_mux")`."""
+    return registry.register(PluginKind.ELEMENT, name)

@@ -1,0 +1,8 @@
+"""Decoder subplugins (reference: ext/nnstreamer/tensor_decoder/).
+
+Importing this package registers all built-in decoders.
+"""
+
+from nnstreamer_tpu.decoders import label  # noqa: F401
+
+__all__ = ["label"]

@@ -1,0 +1,70 @@
+"""image_labeling decoder — argmax over class scores → text label.
+
+Reference parity: ext/nnstreamer/tensor_decoder/tensordec-imagelabel.c
+(271 LoC): option1 = labels file (one label per line), output text/x-raw.
+Output buffer: meta["label"], meta["label_index"], meta["score"], payload
+= utf-8 bytes of the label.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import PipelineError
+from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
+from nnstreamer_tpu.graph.media import TextSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+
+@register_decoder("image_labeling")
+class ImageLabeling(DecoderSubplugin):
+    def __init__(self):
+        self.labels: List[str] = []
+
+    def init(self, props: dict) -> None:
+        path = props.get("option1", "")
+        if path:
+            p = Path(path)
+            if not p.is_file():
+                raise PipelineError(
+                    f"image_labeling: labels file {path!r} not found "
+                    f"(option1 must point at a one-label-per-line text file)"
+                )
+            self.labels = [
+                line.strip() for line in p.read_text().splitlines() if line.strip()
+            ]
+
+    def negotiate(self, in_spec: TensorsSpec) -> TextSpec:
+        if in_spec.num_tensors != 1:
+            raise ValueError(
+                f"expects exactly one scores tensor, got {in_spec.num_tensors}"
+            )
+        n_classes = in_spec.tensors[0].num_elements
+        if self.labels and len(self.labels) not in (n_classes, n_classes - 1):
+            raise ValueError(
+                f"labels file has {len(self.labels)} entries but the scores "
+                f"tensor has {n_classes} classes"
+            )
+        return TextSpec(rate=in_spec.rate)
+
+    def decode(self, buf: TensorBuffer) -> TensorBuffer:
+        scores = np.asarray(buf.tensors[0]).reshape(-1)
+        idx = int(scores.argmax())
+        # background-class offset when labels == classes-1 (imagenet quant
+        # models with class 0 = background, as in the reference test models)
+        label_idx = idx
+        if self.labels and len(self.labels) == scores.size - 1:
+            label_idx = idx - 1
+        label = (
+            self.labels[label_idx]
+            if self.labels and 0 <= label_idx < len(self.labels)
+            else str(idx)
+        )
+        payload = np.frombuffer(label.encode("utf-8"), np.uint8).copy()
+        out = buf.with_tensors((payload,))
+        return out.with_meta(label=label, label_index=idx,
+                             score=float(scores[idx]))

@@ -1,0 +1,36 @@
+"""Pipeline elements (reference L3: gst/nnstreamer/elements/).
+
+Importing this package registers every built-in element with the ELEMENT
+registry — the plugin_init analog (registerer/nnstreamer.c:91-119).
+"""
+
+from nnstreamer_tpu.elements import (  # noqa: F401
+    converter,
+    decoder,
+    filter as filter_element,
+    sinks,
+    sources,
+    transform,
+)
+
+from nnstreamer_tpu.elements.converter import TensorConverter, register_converter
+from nnstreamer_tpu.elements.decoder import TensorDecoder, register_decoder
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sinks import FakeSink, TensorSink
+from nnstreamer_tpu.elements.sources import AppSrc, TensorSrc, VideoTestSrc
+from nnstreamer_tpu.elements.transform import TensorTransform, TransformProgram
+
+__all__ = [
+    "TensorConverter",
+    "TensorDecoder",
+    "TensorFilter",
+    "TensorSink",
+    "FakeSink",
+    "AppSrc",
+    "TensorSrc",
+    "VideoTestSrc",
+    "TensorTransform",
+    "TransformProgram",
+    "register_converter",
+    "register_decoder",
+]

@@ -1,0 +1,79 @@
+"""tensor_decoder — tensor→media egress via decoder subplugins.
+
+Reference parity: gst/nnstreamer/elements/gsttensor_decoder.c dispatching
+to `GstTensorDecoderDef` subplugins (include/nnstreamer_plugin_api_decoder.h:39).
+Decoder subplugins live in nnstreamer_tpu/decoders/ (image_labeling,
+bounding_boxes, image_segment, pose_estimation, direct_video, …).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from nnstreamer_tpu.core.errors import PipelineError
+from nnstreamer_tpu.core.registry import PluginKind, register_element, registry
+from nnstreamer_tpu.graph.pipeline import Element, Emission, PropDef, StreamSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+
+class DecoderSubplugin:
+    """tensor→media decoder API (GstTensorDecoderDef analog)."""
+
+    MODE = ""
+
+    def init(self, props: dict) -> None:
+        """Receive the decoder element's option properties."""
+
+    def negotiate(self, in_spec: TensorsSpec) -> StreamSpec:
+        """Validate the tensor input and declare the output media spec
+        (getOutCaps analog)."""
+        raise NotImplementedError
+
+    def decode(self, buf: TensorBuffer) -> TensorBuffer:
+        raise NotImplementedError
+
+
+def register_decoder(mode: str):
+    def deco(cls):
+        cls.MODE = mode
+        registry.register(PluginKind.DECODER, mode, cls)
+        return cls
+    return deco
+
+
+@register_element("tensor_decoder")
+class TensorDecoder(Element):
+    ELEMENT_NAME = "tensor_decoder"
+    PROPS = {
+        "mode": PropDef(str, None, "decoder subplugin name"),
+        # reference passes up to 9 positional option strings; we accept
+        # those plus named passthrough props via option_fields
+        **{f"option{i}": PropDef(str, "") for i in range(1, 10)},
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.props["mode"]:
+            raise PipelineError(
+                f"tensor_decoder ({self.name}) requires mode=<subplugin>; "
+                f"available: {registry.names(PluginKind.DECODER)}"
+            )
+        import nnstreamer_tpu.decoders  # noqa: F401 (registers built-ins)
+        cls = registry.get(PluginKind.DECODER, self.props["mode"])
+        self.sub: DecoderSubplugin = cls()
+        self.sub.init(dict(self.props))
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        spec = self.expect_tensors(in_specs[0])
+        try:
+            out = self.sub.negotiate(spec)
+        except (ValueError, PipelineError) as e:
+            self.fail_negotiation(
+                f"decoder mode={self.props['mode']} rejected input "
+                f"{spec}: {e}"
+            )
+        return [out]
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        return [(0, self.sub.decode(buf.to_host()))]

@@ -1,0 +1,274 @@
+"""tensor_filter — the model-execution element.
+
+Reference parity: gst/nnstreamer/tensor_filter/tensor_filter.c +
+tensor_filter_common.c (§3.1/§3.2 call stacks): backend open at start,
+model-info-driven negotiation (load_tensor_info / setInputDimension for
+adaptive models), input/output-combination subset routing, per-invoke
+latency/throughput stats (:354-460), invoke error propagation.
+
+TPU-first differences:
+- One backend family (xla/custom/pallas) instead of 20 vendor subplugins;
+  `framework=` defaults from config ([filter] default_backend) with
+  extension-based auto-detect parity (detect_framework:1208 analog).
+- **Fusion**: `set_fusion()` receives the elementwise programs of
+  adjacent tensor_transform elements removed by the graph optimizer
+  (graph/optimize.py); an accepting backend compiles them into the model
+  computation. Refusing backends get them applied host-side here, so
+  correctness never depends on fusion.
+- Invoke is non-blocking: device outputs flow downstream as jax.Arrays
+  (see backends/xla.py). Latency stats therefore measure *dispatch* by
+  default; `latency-mode=sync` blocks for true per-frame latency.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import replace
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from nnstreamer_tpu.backends.base import FilterBackend, get_backend
+from nnstreamer_tpu.core.config import get_config
+from nnstreamer_tpu.core.errors import BackendError, PipelineError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.pipeline import Element, Emission, PropDef, StreamSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+log = get_logger("filter")
+
+
+def _parse_combination(s: str) -> Optional[List[int]]:
+    if not s:
+        return None
+    try:
+        return [int(x) for x in s.split(",")]
+    except ValueError:
+        raise PipelineError(
+            f"bad combination list {s!r}; expected comma-separated tensor "
+            f"indices like '0,2'"
+        ) from None
+
+
+@register_element("tensor_filter")
+class TensorFilter(Element):
+    ELEMENT_NAME = "tensor_filter"
+    PROPS = {
+        "framework": PropDef(str, "", "backend name (xla|custom|pallas|…)"),
+        "model": PropDef(lambda s: s, None, "model reference (backend-specific)"),
+        "custom": PropDef(str, "", "opaque backend option string"),
+        "accelerator": PropDef(str, "", "device selector, e.g. tpu:0"),
+        "input": PropDef(str, "", "override input dims (dim string list)"),
+        "inputtype": PropDef(str, "", "override input types"),
+        "output": PropDef(str, "", "override output dims"),
+        "outputtype": PropDef(str, "", "override output types"),
+        "input_combination": PropDef(str, "", "sink-tensor subset, e.g. 0,2"),
+        "output_combination": PropDef(str, "",
+                                      "i<n>=input passthrough / o<n>=output picks"),
+        "latency_mode": PropDef(str, "async", "async|sync stats timing"),
+        "is_updatable": PropDef(lambda s: str(s).lower() in ("1", "true"), False),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.backend: Optional[FilterBackend] = None
+        self._pre: Optional[Callable] = None   # fused pre chain
+        self._post: Optional[Callable] = None
+        self._pre_programs: list = []
+        self._post_programs: list = []
+        self._fused_in_backend = False
+        self._in_combination = _parse_combination(self.props["input_combination"])
+        self._out_combination = self._parse_out_combination(
+            self.props["output_combination"]
+        )
+        self._lat_window = deque(maxlen=10)   # last-10 window, ref :443-455
+        self._invoke_count = 0
+        self._t_start = None
+
+    # -- combination parsing ----------------------------------------------
+    @staticmethod
+    def _parse_out_combination(s: str) -> Optional[List[Tuple[str, int]]]:
+        """'i0,o1' → [('i',0),('o',1)] — pass input 0 through + output 1
+        (reference output-combination, tensor_filter.c:820-877)."""
+        if not s:
+            return None
+        out = []
+        for part in s.split(","):
+            part = part.strip()
+            if len(part) < 2 or part[0] not in "io" or not part[1:].isdigit():
+                raise PipelineError(
+                    f"bad output-combination entry {part!r}; entries are "
+                    f"i<idx> (pass input) or o<idx> (model output)"
+                )
+            out.append((part[0], int(part[1:])))
+        return out
+
+    # -- fusion (called by graph/optimize.py) ------------------------------
+    def set_fusion(self, pre_programs, post_programs) -> None:
+        """Absorb removed transform elements' compiled programs."""
+        from nnstreamer_tpu.graph.optimize import chain_fn
+
+        self._pre_programs = pre_programs or []
+        self._post_programs = post_programs or []
+        self._pre = chain_fn(self._pre_programs)
+        self._post = chain_fn(self._post_programs)
+
+    # -- negotiation / backend open ---------------------------------------
+    def _framework_name(self) -> str:
+        fw = self.props["framework"]
+        if fw:
+            return fw
+        model = self.props["model"]
+        cfg = get_config()
+        if isinstance(model, str):
+            ext = model.rsplit(".", 1)[-1].lower() if "." in model else ""
+            if ext:
+                by_ext = cfg.get("filter", f"priority_{ext}")
+                if by_ext:
+                    return by_ext.split(",")[0]
+            if model.startswith("zoo://"):
+                return "xla"
+        if callable(model) or type(model).__name__ == "ModelBundle":
+            return "xla"
+        return cfg.get("filter", "default_backend") or "xla"
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        spec = self.expect_tensors(in_specs[0])
+        fw = self._framework_name()
+        try:
+            self.backend = get_backend(fw)
+        except PipelineError as e:
+            self.fail_negotiation(str(e))
+        props = dict(self.props)
+        try:
+            self.backend.open(props)
+        except BackendError as e:
+            self.fail_negotiation(f"backend {fw!r} failed to open model: {e}")
+
+        if self._pre is not None or self._post is not None:
+            self._fused_in_backend = self.backend.fuse(self._pre, self._post)
+
+        from nnstreamer_tpu.graph.optimize import transfer_spec
+
+        model_in = self._override_spec(
+            self.props["input"], self.props["inputtype"],
+            self.backend.get_model_info()[0],
+        )
+        model_out = self._override_spec(
+            self.props["output"], self.props["outputtype"],
+            self.backend.get_model_info()[1],
+        )
+
+        fed = spec if self._in_combination is None else self._subset_spec(spec)
+        # what the model itself sees after any fused pre-chain
+        model_sees = transfer_spec(self._pre_programs, fed)
+        if model_in is not None and not model_in.is_compatible(model_sees):
+            self.fail_negotiation(
+                f"model expects input {model_in} but receives {model_sees}"
+                + (f" (= {fed} after fused pre-transforms)"
+                   if self._pre_programs else "")
+                + ". Fix the upstream pipeline (converter/transform dims) or "
+                  "override with input=/inputtype= properties"
+            )
+        if model_out is None:
+            try:
+                model_out = self.backend.set_input_info(model_sees)
+            except BackendError as e:
+                self.fail_negotiation(str(e))
+        # fused post-chain spec transfer
+        model_out = transfer_spec(self._post_programs, model_out)
+        out = model_out.with_rate(spec.rate)
+        if self._out_combination is not None:
+            infos = []
+            for kind, idx in self._out_combination:
+                pool = spec.tensors if kind == "i" else out.tensors
+                if idx >= len(pool):
+                    self.fail_negotiation(
+                        f"output-combination {kind}{idx} out of range "
+                        f"({'input' if kind == 'i' else 'output'} has "
+                        f"{len(pool)} tensors)"
+                    )
+                infos.append(pool[idx])
+            out = replace(out, tensors=tuple(infos))
+        return [out]
+
+    def _subset_spec(self, spec: TensorsSpec) -> TensorsSpec:
+        idxs = self._in_combination
+        if any(i >= spec.num_tensors for i in idxs):
+            self.fail_negotiation(
+                f"input-combination {idxs} out of range for {spec.num_tensors}"
+                f"-tensor input"
+            )
+        return replace(spec, tensors=tuple(spec.tensors[i] for i in idxs))
+
+    @staticmethod
+    def _override_spec(dims: str, types: str, fallback) -> Optional[TensorsSpec]:
+        if dims:
+            return TensorsSpec.from_strings(dims, types or "float32")
+        return fallback
+
+    def start(self) -> None:
+        self._t_start = time.monotonic()
+
+    def stop(self) -> None:
+        if self.backend is not None:
+            self.backend.close()
+
+    # -- hot loop (reference §3.2) -----------------------------------------
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        inputs = buf.tensors
+        if self._in_combination is not None:
+            inputs = tuple(inputs[i] for i in self._in_combination)
+        t0 = time.perf_counter()
+        if self._pre is not None and not self._fused_in_backend:
+            inputs = self._pre(inputs)
+        try:
+            outputs = self.backend.invoke(inputs)
+        except Exception as e:
+            raise BackendError(
+                f"tensor_filter {self.name}: invoke failed on frame "
+                f"pts={buf.pts}: {e}"
+            ) from e
+        if self._post is not None and not self._fused_in_backend:
+            outputs = self._post(outputs)
+        if self.props["latency_mode"] == "sync":
+            outputs = tuple(_block(o) for o in outputs)
+        dt = time.perf_counter() - t0
+        self._lat_window.append(dt)
+        self._invoke_count += 1
+        if self._out_combination is not None:
+            sel = []
+            for kind, idx in self._out_combination:
+                sel.append(buf.tensors[idx] if kind == "i" else outputs[idx])
+            outputs = tuple(sel)
+        return [(0, buf.with_tensors(outputs))]
+
+    # -- stats (reference latency/throughput props) ------------------------
+    @property
+    def latency_us(self) -> float:
+        """avg invoke latency, µs, last-10 window (prop `latency`)."""
+        if not self._lat_window:
+            return 0.0
+        return 1e6 * sum(self._lat_window) / len(self._lat_window)
+
+    @property
+    def throughput(self) -> float:
+        """invokes/sec since start (prop `throughput`)."""
+        if not self._invoke_count or self._t_start is None:
+            return 0.0
+        dt = time.monotonic() - self._t_start
+        return self._invoke_count / dt if dt > 0 else 0.0
+
+    def reload_model(self, model) -> None:
+        """Hot swap (is-updatable + model property update analog)."""
+        if not self.props["is_updatable"]:
+            raise PipelineError(
+                f"tensor_filter {self.name} is not reloadable; construct it "
+                f"with is-updatable=true to allow hot model swaps"
+            )
+        self.backend.reload(model)
+
+
+def _block(x):
+    return x.block_until_ready() if hasattr(x, "block_until_ready") else x

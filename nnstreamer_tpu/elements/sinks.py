@@ -1,0 +1,84 @@
+"""Sink elements.
+
+Reference parity: gsttensor_sink.c (appsink-like `new-data`/`eos` signals
+with signal-rate limiting :56-109,168-171) and fakesink.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.pipeline import PropDef, SinkElement, prop_bool
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+
+@register_element("tensor_sink")
+class TensorSink(SinkElement):
+    """Collects buffers and fires a `new_data` callback.
+
+    signal-rate (signals/sec, 0 = every buffer) rate-limits the callback
+    exactly like the reference's signal-rate property; collection into
+    `.results` is always unthrottled (appsink pull analog).
+    """
+
+    ELEMENT_NAME = "tensor_sink"
+    PROPS = {
+        "new_data": PropDef(lambda s: s, None, "callback(buffer) (programmatic)"),
+        "signal_rate": PropDef(int, 0, "max callbacks/sec, 0=all"),
+        "collect": PropDef(prop_bool, True, "keep buffers in .results"),
+        "max_results": PropDef(int, 0, "cap .results length, 0=unbounded"),
+        "to_host": PropDef(prop_bool, True, "D2H-sync buffers at the sink"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.results: List[TensorBuffer] = []
+        self._lock = threading.Lock()
+        self._last_signal = 0.0
+        self.eos = threading.Event()
+
+    def render(self, buf: TensorBuffer) -> None:
+        if self.props["to_host"]:
+            buf = buf.to_host()  # the single D2H point of the pipeline
+        with self._lock:
+            if self.props["collect"]:
+                self.results.append(buf)
+                cap = self.props["max_results"]
+                if cap and len(self.results) > cap:
+                    del self.results[: len(self.results) - cap]
+        cb = self.props["new_data"]
+        if cb is not None:
+            rate = self.props["signal_rate"]
+            now = time.monotonic()
+            if not rate or (now - self._last_signal) >= 1.0 / rate:
+                self._last_signal = now
+                cb(buf)
+
+    def flush(self):
+        self.eos.set()
+        return []
+
+
+@register_element("fakesink")
+class FakeSink(SinkElement):
+    """Discards everything (terminates unused branches)."""
+
+    ELEMENT_NAME = "fakesink"
+    PROPS = {
+        "sync_device": PropDef(prop_bool, False,
+                               "block on device arrays (bench timing)"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.count = 0
+
+    def render(self, buf: TensorBuffer) -> None:
+        if self.props["sync_device"]:
+            for t in buf.tensors:
+                if hasattr(t, "block_until_ready"):
+                    t.block_until_ready()
+        self.count += 1

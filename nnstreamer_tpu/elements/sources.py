@@ -1,0 +1,169 @@
+"""Source elements (reference: videotestsrc/appsrc/filesrc from GStreamer
+core, plus tensor_src_* — the framework needs its own since there is no
+GStreamer underneath).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import time
+from fractions import Fraction
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import PipelineError
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.media import VideoSpec
+from nnstreamer_tpu.graph.pipeline import PropDef, SourceElement, StreamSpec, prop_bool
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+NS = 1_000_000_000
+
+
+@register_element("videotestsrc")
+class VideoTestSrc(SourceElement):
+    """Deterministic video pattern generator (videotestsrc analog).
+
+    Patterns: `gradient` (default; per-frame-varying diagonal ramp),
+    `random` (seeded uniform noise), `solid` (option: solid-color).
+    Deterministic given (pattern, seed) so golden tests are exact.
+    """
+
+    ELEMENT_NAME = "videotestsrc"
+    PROPS = {
+        "width": PropDef(int, 224),
+        "height": PropDef(int, 224),
+        "format": PropDef(str, "RGB"),
+        "num_buffers": PropDef(int, 10, "frames to emit before EOS"),
+        "framerate": PropDef(str, "30/1"),
+        "pattern": PropDef(str, "gradient", "gradient|random|solid"),
+        "solid_color": PropDef(int, 127),
+        "seed": PropDef(int, 0),
+        "is_live": PropDef(prop_bool, False, "pace emission to framerate"),
+    }
+
+    def output_spec(self) -> StreamSpec:
+        rate = Fraction(self.props["framerate"].replace("/", "/"))
+        return VideoSpec(
+            width=self.props["width"],
+            height=self.props["height"],
+            format=self.props["format"],
+            rate=rate,
+        )
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        spec: VideoSpec = self.out_specs[0]
+        h, w, c = spec.frame_shape
+        rate = spec.rate or Fraction(30, 1)
+        frame_ns = int(NS / rate) if rate else 0
+        pattern = self.props["pattern"]
+        rng = np.random.default_rng(self.props["seed"])
+        for i in range(self.props["num_buffers"]):
+            if pattern == "random":
+                frame = rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
+            elif pattern == "solid":
+                frame = np.full((h, w, c), self.props["solid_color"], np.uint8)
+            elif pattern == "gradient":
+                yy, xx = np.mgrid[0:h, 0:w]
+                base = (xx + yy + 7 * i) % 256
+                frame = np.stack(
+                    [(base + 85 * ch) % 256 for ch in range(c)], axis=-1
+                ).astype(np.uint8)
+            else:
+                raise PipelineError(
+                    f"videotestsrc pattern {pattern!r} unknown "
+                    f"(gradient|random|solid)"
+                )
+            if self.props["is_live"] and frame_ns:
+                time.sleep(frame_ns / NS)
+            yield TensorBuffer.of(frame, pts=i * frame_ns,
+                                  duration=frame_ns or None)
+
+
+@register_element("appsrc")
+class AppSrc(SourceElement):
+    """Programmatic ingress: the application pushes buffers (appsrc analog).
+
+    Usage:
+        src = AppSrc(spec=TensorsSpec...)   # or any MediaSpec
+        src.push(buf); ...; src.end()
+    In the DSL, give dims/types: `appsrc dims=3:4 types=float32`.
+    """
+
+    ELEMENT_NAME = "appsrc"
+    PROPS = {
+        "spec": PropDef(lambda s: s, None, "StreamSpec object (programmatic)"),
+        "dims": PropDef(str, "", "tensor dims string, e.g. 3:224:224:1"),
+        "types": PropDef(str, "float32"),
+        "rate": PropDef(str, "0/1"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._closed = False
+
+    def output_spec(self) -> StreamSpec:
+        if self.props["spec"] is not None:
+            return self.props["spec"]
+        if self.props["dims"]:
+            return TensorsSpec.from_strings(
+                self.props["dims"], self.props["types"],
+                rate=Fraction(self.props["rate"]),
+            )
+        raise PipelineError(
+            f"appsrc ({self.name}) needs spec=<StreamSpec> (programmatic) "
+            f"or dims=/types= properties"
+        )
+
+    def push(self, buf) -> None:
+        if self._closed:
+            raise PipelineError(f"appsrc {self.name}: push after end()")
+        if isinstance(buf, np.ndarray):
+            buf = TensorBuffer.of(buf)
+        self._q.put(buf)
+
+    def end(self) -> None:
+        self._closed = True
+        self._q.put(None)
+
+    def interrupt(self) -> None:
+        self._q.put(None)
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            yield item
+
+
+@register_element("tensor_src")
+class TensorSrc(SourceElement):
+    """Emit a fixed iterable of arrays/buffers (test + replay source)."""
+
+    ELEMENT_NAME = "tensor_src"
+    PROPS = {
+        "data": PropDef(lambda s: s, None, "iterable of arrays or buffers"),
+        "spec": PropDef(lambda s: s, None, "TensorsSpec (else inferred)"),
+        "rate": PropDef(str, "0/1"),
+    }
+
+    def output_spec(self) -> StreamSpec:
+        if self.props["spec"] is not None:
+            return self.props["spec"]
+        data = self.props["data"]
+        if not data:
+            raise PipelineError(f"tensor_src ({self.name}) needs data= items")
+        first = data[0]
+        arrs = first.tensors if isinstance(first, TensorBuffer) else (first,)
+        return TensorBuffer.of(*arrs).spec().with_rate(Fraction(self.props["rate"]))
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        for i, item in enumerate(self.props["data"] or []):
+            if isinstance(item, TensorBuffer):
+                yield item
+            else:
+                yield TensorBuffer.of(item, pts=i)

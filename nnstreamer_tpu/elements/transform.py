@@ -1,0 +1,313 @@
+"""tensor_transform — elementwise op engine.
+
+Reference parity: gst/nnstreamer/elements/gsttensor_transform.c (2141 LoC;
+modes dimchg/typecast/arithmetic/transpose/stand/clamp :181-199, op-chain
+parser :117-122, Orc SIMD kernels). TPU-first redesign: a transform
+compiles its option string **once** into a chain of array ops that runs
+either
+
+- host-side via numpy (standalone use), or
+- traced into an adjacent ``tensor_filter``'s XLA computation (fusion —
+  the SIMD-kernel analog is simply XLA fusing these into the model's
+  HLO; see elements/filter.py which collects neighbouring transforms).
+
+Option syntax (reference-compatible):
+  mode=typecast    option=float32
+  mode=arithmetic  option=typecast:float32,add:-127.5,div:127.5
+                   (per-channel values ':'-separated: add:1:2:3)
+  mode=transpose   option=1:0:2:3   (reference innermost-first indices)
+  mode=dimchg      option=0:2       (move reference dim 0 to position 2)
+  mode=clamp       option=min:max
+  mode=stand       option=default|dc-average[:per-channel]
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Callable, List, Sequence, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import PipelineError
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.pipeline import Element, Emission, PropDef, StreamSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+#: one compiled step: (fn(xp, array) -> array, out_info_fn(TensorInfo) -> TensorInfo)
+Step = Tuple[Callable, Callable]
+
+MODES = ("typecast", "arithmetic", "transpose", "dimchg", "clamp", "stand")
+_ARITH_OPS = ("add", "sub", "mul", "div", "typecast")
+
+
+def _ref_perm_to_row_major(perm_ref: Sequence[int], rank: int) -> Tuple[int, ...]:
+    """Reference transpose indices (innermost-first) → row-major axes perm."""
+    return tuple(rank - 1 - perm_ref[rank - 1 - k] for k in range(rank))
+
+
+class TransformProgram:
+    """A compiled option string: a pure function over one array, plus the
+    static shape/dtype transfer used at negotiation time."""
+
+    def __init__(self, mode: str, option: str):
+        if mode not in MODES:
+            raise PipelineError(
+                f"unknown tensor_transform mode {mode!r}; valid: {MODES}"
+            )
+        self.mode = mode
+        self.option = option or ""
+        self._steps: List[Step] = self._compile()
+
+    # -- public ------------------------------------------------------------
+    def apply(self, xp, arr):
+        """Run on one array with module `xp` (numpy or jax.numpy)."""
+        for fn, _ in self._steps:
+            arr = fn(xp, arr)
+        return arr
+
+    def out_info(self, info: TensorInfo) -> TensorInfo:
+        for _, transfer in self._steps:
+            info = transfer(info)
+        return info
+
+    # -- compilation -------------------------------------------------------
+    def _compile(self) -> List[Step]:
+        mode, option = self.mode, self.option
+        if mode == "typecast":
+            return [self._step_typecast(option)]
+        if mode == "arithmetic":
+            return self._compile_arith_chain(option)
+        if mode == "transpose":
+            return [self._step_transpose(option)]
+        if mode == "dimchg":
+            return [self._step_dimchg(option)]
+        if mode == "clamp":
+            return [self._step_clamp(option)]
+        if mode == "stand":
+            return [self._step_stand(option)]
+        raise AssertionError(mode)
+
+    def _parse_dtype(self, s: str) -> DType:
+        try:
+            return DType.from_name(s)
+        except ValueError as e:
+            raise PipelineError(f"tensor_transform: {e}") from None
+
+    def _step_typecast(self, option: str) -> Step:
+        dt = self._parse_dtype(option)
+        np_dt = dt.np_dtype
+
+        def fn(xp, a):
+            return a.astype(np_dt)
+
+        return fn, lambda info: replace(info, dtype=dt)
+
+    def _compile_arith_chain(self, option: str) -> List[Step]:
+        if not option:
+            raise PipelineError(
+                "tensor_transform mode=arithmetic requires option="
+                "<op:value[,op:value...]>, e.g. "
+                "option=typecast:float32,add:-127.5,div:127.5"
+            )
+        steps: List[Step] = []
+        for chunk in option.split(","):
+            op, _, valstr = chunk.strip().partition(":")
+            if op not in _ARITH_OPS:
+                raise PipelineError(
+                    f"unknown arithmetic op {op!r} in option {option!r}; "
+                    f"valid ops: {_ARITH_OPS}"
+                )
+            if op == "typecast":
+                steps.append(self._step_typecast(valstr))
+                continue
+            try:
+                vals = [float(v) for v in valstr.split(":")]
+            except ValueError:
+                raise PipelineError(
+                    f"bad operand {valstr!r} for arithmetic op {op!r}"
+                ) from None
+            operand = vals[0] if len(vals) == 1 else np.asarray(vals, np.float32)
+            # Whether this op promotes integer inputs to float32. The
+            # declared spec and the runtime result are forced to agree
+            # (numpy NEP-50 / jnp weak-typing differences are cast away):
+            # div or a non-integral operand promotes; otherwise the input
+            # dtype is preserved (reference arithmetic semantics: ops run
+            # in the tensor's own type unless a typecast is chained).
+            if isinstance(operand, float):
+                promotes = (op == "div") or not operand.is_integer()
+            else:
+                promotes = (op == "div") or bool(
+                    np.any(operand != np.round(operand))
+                )
+
+            def fn(xp, a, op=op, operand=operand, promotes=promotes):
+                in_dt = a.dtype
+                is_float = np.issubdtype(np.dtype(str(in_dt)), np.floating) or (
+                    str(in_dt) == "bfloat16"
+                )
+                if promotes and not is_float:
+                    a = a.astype(np.float32)
+                operand_c = operand
+                if not isinstance(operand, float):
+                    operand_c = operand.astype(a.dtype)
+                # per-channel vectors broadcast along the last axis
+                if op == "add":
+                    r = a + operand_c
+                elif op == "sub":
+                    r = a - operand_c
+                elif op == "mul":
+                    r = a * operand_c
+                else:
+                    r = a / operand_c
+                # pin the result to the declared dtype on every path
+                return r.astype(a.dtype)
+
+            def transfer(info, promotes=promotes):
+                is_float = info.dtype in (DType.FLOAT64, DType.FLOAT32,
+                                          DType.FLOAT16, DType.BFLOAT16)
+                if promotes and not is_float:
+                    return replace(info, dtype=DType.FLOAT32)
+                return info
+
+            steps.append((fn, transfer))
+        return steps
+
+    def _step_transpose(self, option: str) -> Step:
+        try:
+            perm_ref = [int(v) for v in option.split(":")]
+        except ValueError:
+            raise PipelineError(
+                f"tensor_transform mode=transpose needs option=i:j:k:… "
+                f"(reference innermost-first indices), got {option!r}"
+            ) from None
+
+        def fn(xp, a):
+            return xp.transpose(a, _ref_perm_to_row_major(perm_ref, a.ndim))
+
+        def transfer(info: TensorInfo) -> TensorInfo:
+            rank = len(info.shape)
+            if sorted(perm_ref) != list(range(rank)):
+                raise PipelineError(
+                    f"transpose option {option!r} is not a permutation of "
+                    f"0..{rank - 1} for input shape {info.shape}"
+                )
+            perm = _ref_perm_to_row_major(perm_ref, rank)
+            return replace(info, shape=tuple(info.shape[p] for p in perm))
+
+        return fn, transfer
+
+    def _step_dimchg(self, option: str) -> Step:
+        try:
+            frm, to = (int(v) for v in option.split(":"))
+        except ValueError:
+            raise PipelineError(
+                f"tensor_transform mode=dimchg needs option=from:to "
+                f"(reference dim indices), got {option!r}"
+            ) from None
+
+        def fn(xp, a):
+            rank = a.ndim
+            return xp.moveaxis(a, rank - 1 - frm, rank - 1 - to)
+
+        def transfer(info: TensorInfo) -> TensorInfo:
+            rank = len(info.shape)
+            if not (0 <= frm < rank and 0 <= to < rank):
+                raise PipelineError(
+                    f"dimchg option {option!r} out of range for shape "
+                    f"{info.shape}"
+                )
+            shape = list(info.shape)
+            v = shape.pop(rank - 1 - frm)
+            shape.insert(rank - 1 - to, v)
+            return replace(info, shape=tuple(shape))
+
+        return fn, transfer
+
+    def _step_clamp(self, option: str) -> Step:
+        try:
+            lo, hi = (float(v) for v in option.split(":"))
+        except ValueError:
+            raise PipelineError(
+                f"tensor_transform mode=clamp needs option=min:max, got "
+                f"{option!r}"
+            ) from None
+        if lo > hi:
+            raise PipelineError(f"clamp min {lo} > max {hi}")
+
+        def fn(xp, a):
+            return xp.clip(a, lo, hi)
+
+        return fn, lambda info: info
+
+    def _step_stand(self, option: str) -> Step:
+        parts = (option or "default").split(":")
+        kind = parts[0] or "default"
+        per_channel = len(parts) > 1 and parts[1] == "per-channel"
+        if kind not in ("default", "dc-average"):
+            raise PipelineError(
+                f"tensor_transform mode=stand supports "
+                f"default|dc-average[:per-channel], got {option!r}"
+            )
+
+        def fn(xp, a):
+            f = a.astype(np.float32)
+            axes = tuple(range(f.ndim - 1)) if per_channel else None
+            mean = f.mean(axis=axes, keepdims=per_channel)
+            if kind == "dc-average":
+                return f - mean
+            std = f.std(axis=axes, keepdims=per_channel)
+            return (f - mean) / (std + 1e-10)
+
+        return fn, lambda info: replace(info, dtype=DType.FLOAT32)
+
+
+@register_element("tensor_transform")
+class TensorTransform(Element):
+    ELEMENT_NAME = "tensor_transform"
+    PROPS = {
+        "mode": PropDef(str, None, "transform mode: " + "|".join(MODES)),
+        "option": PropDef(str, "", "mode-specific option string"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.props["mode"]:
+            raise PipelineError(
+                f"tensor_transform ({self.name}) requires mode=<"
+                + "|".join(MODES) + ">"
+            )
+        self.program = TransformProgram(self.props["mode"], self.props["option"])
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        spec = self.expect_tensors(in_specs[0])
+        try:
+            infos = tuple(self.program.out_info(t) for t in spec.tensors)
+        except PipelineError as e:
+            self.fail_negotiation(str(e))
+        return [replace(spec, tensors=infos)]
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        xp = _array_module(buf)
+        out = tuple(self.program.apply(xp, t) for t in buf.tensors)
+        return [(0, buf.with_tensors(out))]
+
+    # fusion hook: elements/filter.py calls this to absorb the program
+    def as_elementwise(self):
+        program = self.program
+
+        def apply_all(tensors):
+            import jax.numpy as jnp
+
+            return tuple(program.apply(jnp, t) for t in tensors)
+
+        return apply_all
+
+
+def _array_module(buf: TensorBuffer):
+    if buf.on_device:
+        import jax.numpy as jnp
+
+        return jnp
+    return np

@@ -1,0 +1,26 @@
+"""Pipeline graph: elements, links, static negotiation, string DSL.
+
+The reference delegates graph topology, caps negotiation and scheduling to
+GStreamer core; this package is our replacement substrate. Key design
+choice (TPU-first): negotiation runs **once at build time** over the whole
+graph and produces a static `TensorsSpec` per link — so the steady-state
+loop has zero type checks and every filter sees static shapes, which is
+exactly what XLA tracing needs.
+"""
+
+from nnstreamer_tpu.graph.media import AudioSpec, MediaSpec, OctetSpec, TextSpec, VideoSpec
+from nnstreamer_tpu.graph.pipeline import Element, Pipeline, SinkElement, SourceElement
+from nnstreamer_tpu.graph.parse import parse_launch
+
+__all__ = [
+    "MediaSpec",
+    "VideoSpec",
+    "AudioSpec",
+    "TextSpec",
+    "OctetSpec",
+    "Element",
+    "SourceElement",
+    "SinkElement",
+    "Pipeline",
+    "parse_launch",
+]

@@ -1,0 +1,141 @@
+"""Graph optimization: transform→filter fusion.
+
+The north-star optimization (BASELINE.json): linear chains of
+`tensor_transform` elements adjacent to a `tensor_filter` are removed from
+the graph and their compiled programs handed to the filter, whose backend
+traces them into the *same* jit computation as the model. Pre/post
+elementwise work then fuses with the model's HLO — no per-element hops, no
+extra HBM round trips. The reference instead runs each transform as a
+separate GstBaseTransform pass with its own memcpy (gsttensor_transform.c).
+
+Fusion is semantics-preserving: negotiation runs after rewriting, and a
+backend that declines fusion gets the chains applied host-side by the
+filter element (elements/filter.py), so results are identical either way.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.graph.pipeline import Pipeline
+
+log = get_logger("optimize")
+
+
+def _is_fusable_transform(pipe: Pipeline, elem) -> bool:
+    from nnstreamer_tpu.elements.transform import TensorTransform
+
+    return (
+        isinstance(elem, TensorTransform)
+        and len(pipe.links_to(elem)) == 1
+        and len(pipe.links_from(elem)) == 1
+    )
+
+
+def chain_fn(programs) -> Optional[Callable]:
+    """Tuple-to-tuple elementwise fn applying `programs` in dataflow order.
+
+    Picks numpy for host arrays and jax.numpy for device arrays/tracers,
+    so the same chain works host-side and inside a jit trace.
+    """
+    if not programs:
+        return None
+
+    def chain(tensors: Tuple) -> Tuple:
+        out = []
+        for t in tensors:
+            xp = np if isinstance(t, np.ndarray) else _jnp()
+            for prog in programs:
+                t = prog.apply(xp, t)
+            out.append(t)
+        return tuple(out)
+
+    return chain
+
+
+def transfer_spec(programs, spec):
+    """Static shape/dtype transfer of a program chain over a TensorsSpec."""
+    from dataclasses import replace
+
+    if not programs:
+        return spec
+    infos = []
+    for info in spec.tensors:
+        for prog in programs:
+            info = prog.out_info(info)
+        infos.append(info)
+    return replace(spec, tensors=tuple(infos))
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def fuse_transforms(pipe: Pipeline) -> int:
+    """Rewrite the graph in place; → number of transforms fused."""
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    fused = 0
+    for f in [e for e in list(pipe.elements.values()) if isinstance(e, TensorFilter)]:
+        pre_programs = []
+        # walk upstream: ... -> t2 -> t1 -> filter   (apply order t2, t1? no:
+        # dataflow order is t2 then t1; collect from filter upward, reverse)
+        up: List = []
+        cur = f
+        while True:
+            in_links = pipe.links_to(cur)
+            if len(in_links) != 1:
+                break
+            prev = in_links[0].src
+            if not _is_fusable_transform(pipe, prev):
+                break
+            up.append(prev)
+            cur = prev
+        up.reverse()  # dataflow order
+        pre_programs = [t.program for t in up]
+
+        down: List = []
+        cur = f
+        while True:
+            out_links = pipe.links_from(cur)
+            if len(out_links) != 1:
+                break
+            nxt = out_links[0].dst
+            if not _is_fusable_transform(pipe, nxt):
+                break
+            down.append(nxt)
+            cur = nxt
+        post_programs = [t.program for t in down]
+
+        if not pre_programs and not post_programs:
+            continue
+        for t in up + down:
+            _remove_linear_element(pipe, t)
+            fused += 1
+        f.set_fusion(pre_programs, post_programs)
+        log.info(
+            "fused %d pre + %d post transform(s) into %s",
+            len(pre_programs), len(post_programs), f.name,
+        )
+    return fused
+
+
+def _remove_linear_element(pipe: Pipeline, elem) -> None:
+    """Remove a 1-in/1-out element, splicing its neighbours together."""
+    (in_link,) = pipe.links_to(elem)
+    (out_link,) = pipe.links_from(elem)
+    pipe.links.remove(in_link)
+    pipe.links.remove(out_link)
+    del pipe.elements[elem.name]
+    pipe._negotiated = False
+    # splice: src pad of upstream → sink pad of downstream
+    from nnstreamer_tpu.graph.pipeline import Link
+
+    pipe.links.append(
+        Link(in_link.src, in_link.src_pad, out_link.dst, out_link.dst_pad)
+    )

@@ -1,0 +1,163 @@
+"""gst-launch-style pipeline description parser.
+
+CLI parity with the reference's user surface (`gst-launch-1.0 ... !
+tensor_converter ! tensor_filter framework=... ! ...`, SURVEY.md §1 L6).
+Supported grammar subset:
+
+  pipeline   := chain (whitespace chain)*
+  chain      := node ('!' node)*
+  node       := element | ref
+  element    := NAME (prop)*
+  prop       := KEY '=' VALUE        (VALUE may be "quoted with spaces")
+  ref        := NAME '.'             (links to/from a named element's next
+                                      free pad — mux/demux/tee branches)
+
+Examples:
+
+  videotestsrc num-buffers=10 ! tensor_converter ! tensor_sink name=out
+
+  appsrc name=a ! mux.  appsrc name=b ! mux.
+  tensor_mux name=mux ! tensor_filter model=m.msgpack ! tensor_sink
+
+Element names resolve through the ELEMENT registry, so user plugins are
+first-class in the DSL exactly like built-ins (reference: element names
+registered in registerer/nnstreamer.c:91-119).
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+from typing import Dict, List, Optional, Tuple
+
+from nnstreamer_tpu.core.errors import PipelineError
+from nnstreamer_tpu.core.registry import PluginKind, registry
+from nnstreamer_tpu.graph.pipeline import Element, Pipeline
+
+_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_\-]*$")
+
+
+def parse_launch(description: str, name: str = "pipeline") -> Pipeline:
+    """Build a Pipeline from a description string.
+
+    Import of `nnstreamer_tpu.elements` is implicit so built-in element
+    names are always available (the plugin_init analog).
+    """
+    import nnstreamer_tpu.elements  # noqa: F401  (registers built-ins)
+
+    tokens = _tokenize(description)
+    if not tokens:
+        raise PipelineError("empty pipeline description")
+
+    pipe = Pipeline(name)
+    chains = _split_chains(tokens)
+
+    # pass 1: instantiate every element so refs may point forward
+    # (gst-launch allows `appsrc ! mux.` before `tensor_mux name=mux`)
+    for chain in chains:
+        for node in chain:
+            if node["kind"] == "element":
+                node["instance"] = _instantiate(node)
+                pipe.add(node["instance"])
+
+    # pass 2: create links chain by chain
+    for chain in chains:
+        prev: Optional[Element] = None
+        for node in chain:
+            cur = (
+                node["instance"]
+                if node["kind"] == "element"
+                else pipe.get(node["name"])
+            )
+            if prev is not None:
+                pipe.link(prev, cur)
+            prev = cur
+    return pipe
+
+
+def _tokenize(description: str) -> List[str]:
+    try:
+        lex = shlex.shlex(description, posix=True)
+        lex.whitespace_split = True
+        lex.commenters = "#"
+        return list(lex)
+    except ValueError as e:
+        raise PipelineError(f"cannot tokenize pipeline description: {e}") from e
+
+
+def _split_chains(tokens: List[str]) -> List[List[Dict]]:
+    """Group tokens into chains of element/ref nodes."""
+    chains: List[List[Dict]] = []
+    current: List[Dict] = []
+    node: Optional[Dict] = None
+    expect_node = True  # True right after '!' or at a chain boundary
+
+    def finish_node():
+        nonlocal node
+        if node is not None:
+            current.append(node)
+            node = None
+
+    def finish_chain():
+        nonlocal current
+        finish_node()
+        if current:
+            chains.append(current)
+            current = []
+
+    for tok in tokens:
+        if tok == "!":
+            if node is None and not current:
+                raise PipelineError("pipeline description starts with '!'")
+            finish_node()
+            expect_node = True
+            continue
+        if "=" in tok and not expect_node and node is not None:
+            key, _, value = tok.partition("=")
+            if not key:
+                raise PipelineError(f"malformed property token {tok!r}")
+            if node["kind"] != "element":
+                raise PipelineError(
+                    f"property {tok!r} follows pad reference "
+                    f"{node['name']!r}.; properties can only be set on the "
+                    f"element's own declaration (where name= is given)"
+                )
+            if key == "name":
+                node["name"] = value
+            else:
+                node["props"][key] = value
+            continue
+        # a bare name token: starts a new node; if we weren't expecting one,
+        # it also starts a new chain (whitespace-separated chains)
+        if not expect_node:
+            finish_chain()
+        if tok.endswith(".") and _NAME_RE.match(tok[:-1] or ""):
+            finish_node()
+            node = {"kind": "ref", "name": tok[:-1]}
+        elif _NAME_RE.match(tok):
+            finish_node()
+            node = {"kind": "element", "type": tok, "name": None, "props": {}}
+        else:
+            raise PipelineError(
+                f"unexpected token {tok!r} in pipeline description (element "
+                f"names match [A-Za-z_][A-Za-z0-9_-]*; properties are "
+                f"key=value; links are '!')"
+            )
+        expect_node = False
+    finish_chain()
+    return chains
+
+
+def _instantiate(node: Dict) -> Element:
+    type_name = node["type"]
+    cls = registry.find(PluginKind.ELEMENT, type_name)
+    if cls is None:
+        registry.get(PluginKind.ELEMENT, type_name)  # raises with the full list
+    try:
+        return cls(name=node["name"], **node["props"])
+    except PipelineError:
+        raise
+    except (TypeError, ValueError) as e:
+        raise PipelineError(
+            f"cannot construct element {type_name!r}: {e}"
+        ) from e

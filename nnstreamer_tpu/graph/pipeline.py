@@ -1,0 +1,376 @@
+"""Pipeline graph model and build-time negotiation.
+
+Replaces the GStreamer substrate the reference leans on: elements, pads,
+links, and a single-pass static negotiation that assigns every link a
+`TensorsSpec`/`MediaSpec` before any data flows (the caps-negotiation
+analog, run once — SURVEY.md §1 property 1).
+
+Element model (push-based, mirrors §3.2's hot loop without BaseTransform):
+
+- `SourceElement.generate()` yields buffers (driven by the scheduler).
+- `Element.process(pad, buf)` → list of (src_pad, buffer) to emit.
+  Multi-sink elements buffer internally and emit when their sync policy
+  fires (elements/routing.py).
+- `Element.negotiate(in_specs)` → out_specs, raising NegotiationError
+  with reference-grade actionable messages.
+
+Properties are plain constructor kwargs; string values arrive from the
+DSL and are coerced by each element's `PROPS` declaration — the GObject
+property-table analog (tensor_filter_common.c:899-1017).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from fractions import Fraction
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+from nnstreamer_tpu.core.errors import NegotiationError, PipelineError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.graph.media import MediaSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+log = get_logger("graph")
+
+StreamSpec = Union[TensorsSpec, MediaSpec]
+Emission = Tuple[int, TensorBuffer]  # (src pad index, buffer)
+
+#: marker for elements whose pad count is set per-instance (mux/demux…)
+DYNAMIC = -1
+
+
+@dataclass
+class PropDef:
+    """One declared element property: name, parser, default, doc."""
+
+    parse: Callable[[str], Any]
+    default: Any = None
+    doc: str = ""
+
+
+def prop_bool(s) -> bool:
+    if isinstance(s, bool):
+        return s
+    return str(s).strip().lower() in ("1", "true", "yes", "on")
+
+
+class Element:
+    """Base pipeline element.
+
+    Subclasses declare ELEMENT_NAME (DSL name), sink/src pad counts, a
+    PROPS table, and implement negotiate()/process().
+    """
+
+    ELEMENT_NAME: str = ""
+    NUM_SINK_PADS: int = 1
+    NUM_SRC_PADS: int = 1
+    PROPS: Dict[str, PropDef] = {}
+
+    def __init__(self, name: Optional[str] = None, **props):
+        self.name = name or f"{self.ELEMENT_NAME}{id(self) & 0xFFFF:x}"
+        self.props: Dict[str, Any] = {
+            k: d.default for k, d in self.PROPS.items()
+        }
+        self.set_props(**props)
+        self.in_specs: List[Optional[StreamSpec]] = []
+        self.out_specs: List[Optional[StreamSpec]] = []
+        self._pipeline: Optional["Pipeline"] = None
+
+    # -- properties --------------------------------------------------------
+    def set_props(self, **props) -> None:
+        for key, value in props.items():
+            k = key.replace("-", "_")
+            if k not in self.PROPS:
+                raise PipelineError(
+                    f"element {self.ELEMENT_NAME!r} ({self.name}) has no "
+                    f"property {key!r}; valid properties: "
+                    f"{sorted(p.replace('_', '-') for p in self.PROPS)}"
+                )
+            pd = self.PROPS[k]
+            try:
+                self.props[k] = (
+                    pd.parse(value) if isinstance(value, str) else value
+                )
+            except (ValueError, TypeError) as e:
+                raise PipelineError(
+                    f"bad value {value!r} for property {key!r} of element "
+                    f"{self.name}: {e}"
+                ) from e
+
+    # -- pads --------------------------------------------------------------
+    @property
+    def num_sink_pads(self) -> int:
+        return self.NUM_SINK_PADS
+
+    @property
+    def num_src_pads(self) -> int:
+        return self.NUM_SRC_PADS
+
+    # -- negotiation -------------------------------------------------------
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        """Compute output specs from input specs. Runs once, build time."""
+        raise NotImplementedError
+
+    def fail_negotiation(self, msg: str) -> None:
+        raise NegotiationError(f"element {self.name} ({self.ELEMENT_NAME}): {msg}")
+
+    def expect_tensors(self, spec: StreamSpec, pad: int = 0) -> TensorsSpec:
+        if not isinstance(spec, TensorsSpec):
+            self.fail_negotiation(
+                f"sink pad {pad} requires a tensor stream but got "
+                f"{type(spec).__name__} ({spec}); insert a tensor_converter "
+                f"upstream to turn media into tensors"
+            )
+        return spec
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Called after negotiation, before data flows (open backends…)."""
+
+    def stop(self) -> None:
+        """Called at teardown."""
+
+    # -- dataflow ----------------------------------------------------------
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        raise NotImplementedError
+
+    def flush(self) -> List[Emission]:
+        """Drain internal state at EOS (aggregators, adapters)."""
+        return []
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class SourceElement(Element):
+    NUM_SINK_PADS = 0
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        return [self.output_spec()]
+
+    def output_spec(self) -> StreamSpec:
+        raise NotImplementedError
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        raise NotImplementedError
+
+    def interrupt(self) -> None:
+        """Unblock generate() for teardown (called by the scheduler's
+        stop(); sources that block on external input must override)."""
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        raise PipelineError(f"source {self.name} cannot receive buffers")
+
+
+class SinkElement(Element):
+    NUM_SRC_PADS = 0
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        return []
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        self.render(buf)
+        return []
+
+    def render(self, buf: TensorBuffer) -> None:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class Link:
+    src: Element
+    src_pad: int
+    dst: Element
+    dst_pad: int
+
+    def __str__(self):
+        return (f"{self.src.name}:src{self.src_pad} → "
+                f"{self.dst.name}:sink{self.dst_pad}")
+
+
+class Pipeline:
+    """A DAG of elements + links, negotiated then run by the scheduler.
+
+    (Cycles are supported only via the out-of-band tensor_repo pair, as in
+    the reference — the link graph itself must be acyclic.)
+    """
+
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.elements: Dict[str, Element] = {}
+        self.links: List[Link] = []
+        self._negotiated = False
+
+    # -- construction ------------------------------------------------------
+    def add(self, element: Element) -> Element:
+        if element.name in self.elements:
+            raise PipelineError(
+                f"duplicate element name {element.name!r} in pipeline"
+            )
+        self.elements[element.name] = element
+        element._pipeline = self
+        return element
+
+    def link(self, src: Element, dst: Element,
+             src_pad: Optional[int] = None, dst_pad: Optional[int] = None) -> Link:
+        for e in (src, dst):
+            if e.name not in self.elements or self.elements[e.name] is not e:
+                raise PipelineError(
+                    f"element {e.name!r} is not in pipeline {self.name!r}; "
+                    f"add() it before linking"
+                )
+        if src_pad is None:
+            src_pad = self._next_free_src_pad(src)
+        if dst_pad is None:
+            dst_pad = self._next_free_sink_pad(dst)
+        if src.NUM_SRC_PADS != DYNAMIC and src_pad >= src.num_src_pads:
+            raise PipelineError(
+                f"{src.name} has {src.num_src_pads} src pad(s); "
+                f"cannot link pad {src_pad}"
+            )
+        if dst.NUM_SINK_PADS != DYNAMIC and dst_pad >= dst.num_sink_pads:
+            raise PipelineError(
+                f"{dst.name} has {dst.num_sink_pads} sink pad(s); "
+                f"cannot link pad {dst_pad}"
+            )
+        for l in self.links:
+            if l.src is src and l.src_pad == src_pad:
+                raise PipelineError(f"src pad already linked: {l}")
+            if l.dst is dst and l.dst_pad == dst_pad:
+                raise PipelineError(f"sink pad already linked: {l}")
+        link = Link(src, src_pad, dst, dst_pad)
+        self.links.append(link)
+        self._negotiated = False
+        return link
+
+    def _next_free_src_pad(self, e: Element) -> int:
+        used = {l.src_pad for l in self.links if l.src is e}
+        pad = 0
+        while pad in used:
+            pad += 1
+        return pad
+
+    def _next_free_sink_pad(self, e: Element) -> int:
+        used = {l.dst_pad for l in self.links if l.dst is e}
+        pad = 0
+        while pad in used:
+            pad += 1
+        return pad
+
+    # -- queries -----------------------------------------------------------
+    def sources(self) -> List[SourceElement]:
+        return [e for e in self.elements.values() if isinstance(e, SourceElement)]
+
+    def links_from(self, e: Element) -> List[Link]:
+        return sorted((l for l in self.links if l.src is e),
+                      key=lambda l: l.src_pad)
+
+    def links_to(self, e: Element) -> List[Link]:
+        return sorted((l for l in self.links if l.dst is e),
+                      key=lambda l: l.dst_pad)
+
+    def get(self, name: str) -> Element:
+        try:
+            return self.elements[name]
+        except KeyError:
+            raise PipelineError(
+                f"no element named {name!r} in pipeline; elements: "
+                f"{sorted(self.elements)}"
+            ) from None
+
+    # -- negotiation -------------------------------------------------------
+    def negotiate(self) -> None:
+        """Single-pass static negotiation in topological order.
+
+        After this, every element has in_specs/out_specs and every link
+        carries exactly one immutable spec — the zero-negotiation
+        steady-state the reference gets from one-shot caps negotiation.
+        """
+        self._validate_topology()
+        order = self._topo_order()
+        link_spec: Dict[Tuple[str, int], StreamSpec] = {}
+        for e in order:
+            in_links = self.links_to(e)
+            n_sink = len(in_links) if e.NUM_SINK_PADS == DYNAMIC else e.num_sink_pads
+            in_specs: List[StreamSpec] = [None] * n_sink  # type: ignore
+            for l in in_links:
+                in_specs[l.dst_pad] = link_spec[(l.src.name, l.src_pad)]
+            if any(s is None for s in in_specs):
+                missing = [i for i, s in enumerate(in_specs) if s is None]
+                raise NegotiationError(
+                    f"element {e.name} has unlinked sink pad(s) {missing}"
+                )
+            out_specs = e.negotiate(in_specs)
+            e.in_specs = list(in_specs)
+            e.out_specs = list(out_specs)
+            out_links = self.links_from(e)
+            n_src = len(out_links) if e.NUM_SRC_PADS == DYNAMIC else e.num_src_pads
+            if len(out_specs) != n_src:
+                raise NegotiationError(
+                    f"element {e.name} produced {len(out_specs)} output "
+                    f"spec(s) but has {n_src} src pad(s)"
+                )
+            for l in out_links:
+                link_spec[(l.src.name, l.src_pad)] = out_specs[l.src_pad]
+        self._link_specs = link_spec
+        self._negotiated = True
+        for e in order:
+            log.debug("negotiated %s: in=%s out=%s", e.name, e.in_specs, e.out_specs)
+
+    def spec_of_link(self, link: Link) -> StreamSpec:
+        if not self._negotiated:
+            raise PipelineError("pipeline not negotiated yet")
+        return self._link_specs[(link.src.name, link.src_pad)]
+
+    def _validate_topology(self) -> None:
+        if not self.elements:
+            raise PipelineError("empty pipeline")
+        if not self.sources():
+            raise PipelineError(
+                "pipeline has no source element; every pipeline needs at "
+                "least one (appsrc, videotestsrc, filesrc, …)"
+            )
+        for e in self.elements.values():
+            n_in = len(self.links_to(e))
+            n_out = len(self.links_from(e))
+            if e.NUM_SINK_PADS != DYNAMIC and n_in != e.num_sink_pads:
+                raise PipelineError(
+                    f"element {e.name} needs {e.num_sink_pads} sink link(s), "
+                    f"has {n_in}"
+                )
+            if e.NUM_SRC_PADS != DYNAMIC and n_out != e.num_src_pads:
+                raise PipelineError(
+                    f"element {e.name} needs {e.num_src_pads} src link(s), "
+                    f"has {n_out} — every src pad must be linked (terminate "
+                    f"unused branches with a sink such as fakesink)"
+                )
+
+    def _topo_order(self) -> List[Element]:
+        indeg = {name: len(self.links_to(e)) for name, e in self.elements.items()}
+        ready = [e for n, e in self.elements.items() if indeg[n] == 0]
+        order: List[Element] = []
+        while ready:
+            e = ready.pop()
+            order.append(e)
+            for l in self.links_from(e):
+                indeg[l.dst.name] -= 1
+                if indeg[l.dst.name] == 0:
+                    ready.append(l.dst)
+        if len(order) != len(self.elements):
+            cyclic = sorted(n for n, d in indeg.items() if d > 0)
+            raise PipelineError(
+                f"pipeline graph has a cycle involving {cyclic}; direct "
+                f"cycles are not allowed — use a tensor_repo_sink/"
+                f"tensor_repo_src pair for feedback loops"
+            )
+        return order
+
+    def describe(self) -> str:
+        lines = [f"pipeline {self.name!r}:"]
+        for e in self.elements.values():
+            lines.append(f"  {e!r} in={e.in_specs} out={e.out_specs}")
+        for l in self.links:
+            lines.append(f"  {l}")
+        return "\n".join(lines)

@@ -1,0 +1,10 @@
+"""Flagship model zoo — jax/flax models loadable as `model=zoo://<name>`.
+
+The reference ships tiny test models per vendor framework
+(tests/test_models/models/). Here the zoo is first-class: each entry
+builds a `ModelBundle` (fn + params + specs) ready for the xla backend.
+"""
+
+from nnstreamer_tpu.models.zoo import build_model, list_models, register_model
+
+__all__ = ["build_model", "list_models", "register_model"]

@@ -1,0 +1,82 @@
+"""Model zoo registry.
+
+`zoo://<name>[?k=v&k2=v2]` references resolve here. Builders are
+registered lazily (import side effects of nnstreamer_tpu.models.*) and
+return `backends.xla.ModelBundle` objects.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List
+from urllib.parse import parse_qsl
+
+from nnstreamer_tpu.core.errors import BackendError
+
+_builders: Dict[str, Callable] = {}
+_lock = threading.Lock()
+
+
+def register_model(name: str):
+    """`@register_model("mobilenet_v2")` on a builder(**kwargs)->ModelBundle."""
+    def deco(fn):
+        with _lock:
+            _builders[name] = fn
+        return fn
+    return deco
+
+
+def list_models() -> List[str]:
+    _load_builtins()
+    with _lock:
+        return sorted(_builders)
+
+
+def build_model(ref: str):
+    """Build a bundle from a zoo reference (name + optional ?query args)."""
+    _load_builtins()
+    name, _, query = ref.partition("?")
+    kwargs = {}
+    for k, v in parse_qsl(query):
+        kwargs[k.replace("-", "_")] = _coerce(v)
+    with _lock:
+        builder = _builders.get(name)
+    if builder is None:
+        raise BackendError(
+            f"no zoo model named {name!r}; available: "
+            f"{list_models() or '(none)'}"
+        )
+    try:
+        return builder(**kwargs)
+    except TypeError as e:
+        raise BackendError(f"bad zoo model arguments in {ref!r}: {e}") from e
+
+
+def _coerce(v: str):
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            continue
+    if v.lower() in ("true", "false"):
+        return v.lower() == "true"
+    return v
+
+
+_loaded = False
+
+
+def _load_builtins() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    # import for registration side effects; keep failures actionable but
+    # non-fatal so one broken model doesn't take down the zoo
+    import importlib
+
+    for mod in ("mobilenet_v2", "ssd_mobilenet", "posenet"):
+        try:
+            importlib.import_module(f"nnstreamer_tpu.models.{mod}")
+        except ImportError:
+            pass

@@ -1,0 +1,6 @@
+"""Streaming runtime — the scheduler substrate GStreamer provides the
+reference (threads, queues, backpressure, EOS/error propagation)."""
+
+from nnstreamer_tpu.runtime.scheduler import EOS, PipelineRunner, run_pipeline
+
+__all__ = ["PipelineRunner", "run_pipeline", "EOS"]

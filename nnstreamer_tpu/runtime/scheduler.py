@@ -1,0 +1,218 @@
+"""Push-model streaming scheduler.
+
+Design (SURVEY.md §7 step 3): one worker thread per element with a bounded
+input queue per element — the analog of GStreamer's streaming threads +
+queue elements, but uniform: every link is naturally double-buffered, so a
+filter's device dispatch overlaps upstream conversion (the async-dispatch
+property the reference loses to per-frame cudaDeviceSynchronize,
+tensor_filter_tensorrt.cc:239).
+
+Dataflow rules:
+- Sources run a pump thread iterating `generate()`.
+- Every buffer delivered to `Element.process(pad, buf)`; emissions are
+  routed by (element, src_pad) → link → destination queue.
+- EOS: a sentinel per pad; when all sink pads of an element saw EOS, the
+  element's `flush()` drains (aggregation windows…), then EOS cascades.
+- Errors: any exception in a worker stops the pipeline and re-raises from
+  `wait()` (GST_FLOW_ERROR analog: fail loud, never hang).
+- Backpressure: bounded queues block the producer ([runtime]
+  queue_capacity), or drop oldest when an element opts into leaky mode.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from nnstreamer_tpu.core.config import get_config
+from nnstreamer_tpu.core.errors import PipelineError, StreamError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.graph.pipeline import Element, Link, Pipeline, SourceElement
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+log = get_logger("runtime")
+
+
+class _EOSType:
+    def __repr__(self):
+        return "EOS"
+
+
+#: end-of-stream sentinel
+EOS = _EOSType()
+
+
+class PipelineRunner:
+    def __init__(self, pipeline: Pipeline, queue_capacity: Optional[int] = None,
+                 optimize: bool = True):
+        self.pipeline = pipeline
+        self._optimize = optimize
+        cap = queue_capacity or get_config().get_int("runtime", "queue_capacity", 4)
+        self._cap = max(1, cap)
+        self._queues: Dict[str, "queue.Queue"] = {}
+        self._threads: List[threading.Thread] = []
+        self._stop_evt = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._error_lock = threading.Lock()
+        self._started = False
+        self._route: Dict[Tuple[str, int], Link] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "PipelineRunner":
+        if self._started:
+            raise PipelineError("runner already started")
+        pipe = self.pipeline
+        if not pipe._negotiated:
+            if self._optimize:
+                from nnstreamer_tpu.graph.optimize import fuse_transforms
+
+                fuse_transforms(pipe)
+            pipe.negotiate()
+        for e in pipe.elements.values():
+            e.start()
+        for l in pipe.links:
+            self._route[(l.src.name, l.src_pad)] = l
+        for e in pipe.elements.values():
+            if not isinstance(e, SourceElement):
+                self._queues[e.name] = queue.Queue(maxsize=self._cap)
+        for e in pipe.elements.values():
+            if isinstance(e, SourceElement):
+                t = threading.Thread(target=self._pump, args=(e,),
+                                     name=f"src:{e.name}", daemon=True)
+            else:
+                t = threading.Thread(target=self._work, args=(e,),
+                                     name=f"elem:{e.name}", daemon=True)
+            self._threads.append(t)
+        self._started = True
+        for t in self._threads:
+            t.start()
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until every element finished (EOS fully propagated)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._threads:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            t.join(remaining)
+            if t.is_alive():
+                self.stop()
+                raise StreamError(
+                    f"pipeline {self.pipeline.name!r} did not finish within "
+                    f"{timeout}s (thread {t.name} still running)"
+                )
+        if self._error is not None:
+            raise StreamError(
+                f"pipeline {self.pipeline.name!r} failed: {self._error}"
+            ) from self._error
+
+    def stop(self) -> None:
+        """Request teardown; safe to call multiple times."""
+        self._stop_evt.set()
+        # unblock sources stuck in generate() (e.g. appsrc waiting for push)
+        for e in self.pipeline.elements.values():
+            if isinstance(e, SourceElement):
+                try:
+                    e.interrupt()
+                except Exception:
+                    log.exception("error interrupting %s", e.name)
+        # unblock workers waiting on get()
+        for q in self._queues.values():
+            try:
+                q.put_nowait((None, EOS))
+            except queue.Full:
+                pass
+        for e in self.pipeline.elements.values():
+            try:
+                e.stop()
+            except Exception:  # teardown must not mask the first error
+                log.exception("error stopping %s", e.name)
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        self.start()
+        try:
+            self.wait(timeout)
+        finally:
+            self.stop()
+
+    # -- internals ---------------------------------------------------------
+    def _fail(self, elem: Element, exc: BaseException) -> None:
+        with self._error_lock:
+            if self._error is None:
+                self._error = exc
+        log.error("element %s failed: %s", elem.name, exc)
+        self._stop_evt.set()
+        for q in self._queues.values():
+            try:
+                q.put_nowait((None, EOS))
+            except queue.Full:
+                pass
+
+    def _emit(self, elem: Element, src_pad: int, item) -> None:
+        link = self._route.get((elem.name, src_pad))
+        if link is None:
+            raise PipelineError(
+                f"element {elem.name} emitted on unlinked src pad {src_pad}"
+            )
+        q = self._queues[link.dst.name]
+        while not self._stop_evt.is_set():
+            try:
+                q.put((link.dst_pad, item), timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def _broadcast_eos(self, elem: Element) -> None:
+        for l in self.pipeline.links_from(elem):
+            self._emit(elem, l.src_pad, EOS)
+
+    def _pump(self, src: SourceElement) -> None:
+        try:
+            for buf in src.generate():
+                if self._stop_evt.is_set():
+                    break
+                self._emit(src, 0, buf)
+            self._broadcast_eos(src)
+        except Exception as e:
+            self._fail(src, e)
+            try:
+                self._broadcast_eos(src)
+            except Exception:
+                pass
+
+    def _work(self, elem: Element) -> None:
+        q = self._queues[elem.name]
+        n_pads = max(1, len(self.pipeline.links_to(elem)))
+        eos_pads = set()
+        try:
+            while not self._stop_evt.is_set():
+                try:
+                    pad, item = q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if item is EOS:
+                    if pad is None:  # teardown wakeup
+                        return
+                    eos_pads.add(pad)
+                    if len(eos_pads) >= n_pads:
+                        for sp, b in elem.flush():
+                            self._emit(elem, sp, b)
+                        self._broadcast_eos(elem)
+                        return
+                    continue
+                for sp, b in elem.process(pad, item):
+                    self._emit(elem, sp, b)
+        except Exception as e:
+            self._fail(elem, e)
+            try:
+                self._broadcast_eos(elem)
+            except Exception:
+                pass
+
+
+def run_pipeline(pipeline: Pipeline, timeout: Optional[float] = None,
+                 optimize: bool = True) -> None:
+    """Negotiate (with transform fusion by default), run to EOS, tear
+    down. The gst-launch behavior."""
+    PipelineRunner(pipeline, optimize=optimize).run(timeout)

@@ -1,0 +1,178 @@
+"""Graph, DSL parsing, and negotiation tests
+(reference: tests/nnstreamer_plugins/unittest_plugins.cc pipeline-parse
+and caps-negotiation suites)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import TensorsSpec, parse_launch
+from nnstreamer_tpu.core.errors import NegotiationError, PipelineError
+from nnstreamer_tpu.elements.sources import AppSrc, VideoTestSrc
+from nnstreamer_tpu.elements.sinks import TensorSink
+from nnstreamer_tpu.elements.transform import TensorTransform
+from nnstreamer_tpu.graph.media import VideoSpec
+from nnstreamer_tpu.graph.pipeline import Pipeline
+from nnstreamer_tpu.tensor.dtypes import DType
+
+
+class TestDSL:
+    def test_linear_parse(self):
+        p = parse_launch(
+            "videotestsrc width=8 height=8 num-buffers=2 ! tensor_converter "
+            "! tensor_sink name=out"
+        )
+        assert len(p.elements) == 3
+        assert len(p.links) == 2
+        assert "out" in p.elements
+
+    def test_props_with_quotes(self):
+        p = parse_launch(
+            'appsrc dims=3:4 types=float32 name=a ! tensor_sink name=s'
+        )
+        assert p.get("a").props["dims"] == "3:4"
+
+    def test_named_ref_forward(self):
+        # refs may point at elements defined later (gst-launch parity)
+        p = parse_launch(
+            "appsrc dims=2:2 name=a ! m.  appsrc dims=2:2 name=b ! m.  "
+            "tensor_mux name=m ! tensor_sink name=s",
+        ) if _has_mux() else None
+        if p is None:
+            pytest.skip("tensor_mux not yet implemented")
+
+    def test_unknown_element(self):
+        with pytest.raises(PipelineError, match="no element plugin"):
+            parse_launch("videotestsrc ! not_an_element ! tensor_sink")
+
+    def test_unknown_property(self):
+        with pytest.raises(PipelineError, match="no\\s+property"):
+            parse_launch("videotestsrc bogus_prop=1 ! tensor_sink")
+
+    def test_empty(self):
+        with pytest.raises(PipelineError):
+            parse_launch("   ")
+
+    def test_starts_with_bang(self):
+        with pytest.raises(PipelineError):
+            parse_launch("! tensor_sink")
+
+
+def _has_mux():
+    from nnstreamer_tpu.core.registry import PluginKind, registry
+
+    return registry.find(PluginKind.ELEMENT, "tensor_mux") is not None
+
+
+class TestNegotiation:
+    def test_video_chain(self):
+        p = parse_launch(
+            "videotestsrc width=16 height=8 format=RGB ! tensor_converter "
+            "! tensor_sink name=s"
+        )
+        p.negotiate()
+        conv = next(e for e in p.elements.values()
+                    if e.ELEMENT_NAME == "tensor_converter")
+        out = conv.out_specs[0]
+        assert out.tensors[0].shape == (1, 8, 16, 3)
+        assert out.tensors[0].dtype == DType.UINT8
+
+    def test_transform_typecast_spec(self):
+        p = parse_launch(
+            "videotestsrc width=4 height=4 ! tensor_converter ! "
+            "tensor_transform mode=typecast option=float32 ! tensor_sink name=s"
+        )
+        p.negotiate()
+        t = next(e for e in p.elements.values()
+                 if e.ELEMENT_NAME == "tensor_transform")
+        assert t.out_specs[0].tensors[0].dtype == DType.FLOAT32
+
+    def test_media_into_transform_fails_actionably(self):
+        p = parse_launch(
+            "videotestsrc ! tensor_transform mode=typecast option=float32 "
+            "! tensor_sink name=s"
+        )
+        with pytest.raises(NegotiationError, match="tensor_converter"):
+            p.negotiate()
+
+    def test_unlinked_src_pad(self):
+        p = Pipeline()
+        p.add(VideoTestSrc(name="src"))
+        with pytest.raises(PipelineError, match="must be linked"):
+            p.negotiate()
+
+    def test_cycle_detection(self):
+        p = Pipeline()
+        a = p.add(TensorTransform(name="a", mode="typecast", option="float32"))
+        b = p.add(TensorTransform(name="b", mode="typecast", option="float32"))
+        p.add(AppSrc(name="src", dims="2:2"))
+        p.link(p.get("src"), a)
+        # craft a cycle a->b->a via manual link list surgery
+        p.link(a, b)
+        from nnstreamer_tpu.graph.pipeline import Link
+
+        p.links.append(Link(b, 0, a, 1))
+        with pytest.raises(PipelineError):
+            p.negotiate()
+
+    def test_double_link_rejected(self):
+        p = Pipeline()
+        src = p.add(AppSrc(name="src", dims="2:2"))
+        sink = p.add(TensorSink(name="s"))
+        p.link(src, sink)
+        with pytest.raises(PipelineError, match="already linked"):
+            p.link(src, sink, src_pad=0, dst_pad=0)
+
+
+class TestTransformPrograms:
+    def test_arith_chain(self):
+        from nnstreamer_tpu.elements.transform import TransformProgram
+
+        prog = TransformProgram("arithmetic", "typecast:float32,add:-127.5,div:127.5")
+        x = np.array([0, 127.5, 255], np.uint8)
+        out = prog.apply(np, np.array([0, 128, 255], np.uint8))
+        np.testing.assert_allclose(out, (np.array([0, 128, 255]) - 127.5) / 127.5)
+
+    def test_transpose_reference_order(self):
+        from nnstreamer_tpu.elements.transform import TransformProgram
+
+        # reference option 1:0:2:3 swaps the two innermost dims (ch<->w)
+        prog = TransformProgram("transpose", "1:0:2:3")
+        x = np.zeros((1, 4, 6, 3))
+        y = prog.apply(np, x)
+        assert y.shape == (1, 4, 3, 6)
+        info = prog.out_info(
+            __import__("nnstreamer_tpu").TensorInfo((1, 4, 6, 3))
+        )
+        assert info.shape == (1, 4, 3, 6)
+
+    def test_clamp(self):
+        from nnstreamer_tpu.elements.transform import TransformProgram
+
+        prog = TransformProgram("clamp", "0:1")
+        out = prog.apply(np, np.array([-5.0, 0.5, 9.0]))
+        np.testing.assert_array_equal(out, [0, 0.5, 1])
+
+    def test_stand_default(self):
+        from nnstreamer_tpu.elements.transform import TransformProgram
+
+        prog = TransformProgram("stand", "default")
+        out = prog.apply(np, np.arange(10, dtype=np.float32))
+        assert abs(out.mean()) < 1e-6 and abs(out.std() - 1) < 1e-3
+
+    def test_bad_mode(self):
+        with pytest.raises(PipelineError, match="unknown tensor_transform mode"):
+            TensorTransform(mode="wavelet")
+
+    def test_bad_arith_op(self):
+        from nnstreamer_tpu.elements.transform import TransformProgram
+
+        with pytest.raises(PipelineError, match="unknown arithmetic op"):
+            TransformProgram("arithmetic", "pow:2")
+
+    def test_dimchg(self):
+        from nnstreamer_tpu.elements.transform import TransformProgram
+
+        # reference dimchg 0:2: move innermost (channel) to position 2
+        prog = TransformProgram("dimchg", "0:2")
+        x = np.zeros((1, 4, 6, 3))
+        assert prog.apply(np, x).shape == (1, 3, 4, 6)
