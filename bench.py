@@ -1,0 +1,99 @@
+"""Benchmark — BASELINE.md config 1 on the real chip.
+
+Runs the flagship streaming pipeline (source → converter-equivalent
+normalize → MobileNetV2 → label decode, all fused into one XLA
+computation by the graph optimizer) and reports steady-state
+frames/sec/chip. Baseline: the driver target of 30 FPS/chip
+(BASELINE.json — the reference publishes no numbers of its own;
+SURVEY.md §6).
+
+Prints ONE JSON line:
+  {"metric": "mobilenet_v2_224_fps_per_chip", "value": N,
+   "unit": "frames/s", "vs_baseline": N/30}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def bench_pipeline(n_frames: int = 256, warmup: int = 16) -> float:
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements import (
+        AppSrc, FakeSink, TensorFilter, TensorTransform)
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    spec = TensorsSpec.of(TensorInfo((1, 224, 224, 3), DType.UINT8))
+    src = AppSrc(spec=spec, name="src")
+    # the reference's stock pipeline shape: typecast+normalize, then model
+    # (transform fuses into the filter's XLA computation at negotiation)
+    trans = TensorTransform(
+        name="t", mode="arithmetic",
+        option="typecast:float32,add:-127.5,div:127.5")
+    filt = TensorFilter(name="f", framework="xla", model="zoo://mobilenet_v2")
+    sink = FakeSink(name="sink", sync_device=True)
+
+    pipe = nns.Pipeline("bench")
+    for e in (src, trans, filt, sink):
+        pipe.add(e)
+    pipe.link(src, trans)
+    pipe.link(trans, filt)
+    pipe.link(filt, sink)
+
+    runner = nns.PipelineRunner(pipe, queue_capacity=4).start()
+    frame = np.random.default_rng(0).integers(
+        0, 256, (1, 224, 224, 3), np.uint8)
+
+    def wait_count(target: int, poll: float) -> None:
+        while sink.count < target:
+            err = runner._error
+            if err is not None:  # fail fast, don't spin forever
+                runner.stop()
+                raise RuntimeError(f"pipeline failed: {err}") from err
+            time.sleep(poll)
+
+    # warmup (compile)
+    for i in range(warmup):
+        src.push(TensorBuffer.of(frame, pts=i))
+    wait_count(warmup, 0.005)
+
+    t0 = time.perf_counter()
+    for i in range(n_frames):
+        src.push(TensorBuffer.of(frame, pts=warmup + i))
+    wait_count(warmup + n_frames, 0.002)
+    dt = time.perf_counter() - t0
+    src.end()
+    runner.wait(30)
+    return n_frames / dt
+
+
+def main() -> int:
+    try:
+        fps = bench_pipeline()
+        baseline = 30.0  # BASELINE.json driver target, FPS/chip
+        print(json.dumps({
+            "metric": "mobilenet_v2_224_fps_per_chip",
+            "value": round(fps, 2),
+            "unit": "frames/s",
+            "vs_baseline": round(fps / baseline, 3),
+        }))
+        return 0
+    except Exception as e:  # one JSON line even on failure
+        print(json.dumps({
+            "metric": "mobilenet_v2_224_fps_per_chip",
+            "value": 0.0,
+            "unit": "frames/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

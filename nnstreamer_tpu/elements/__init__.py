@@ -5,32 +5,65 @@ registry — the plugin_init analog (registerer/nnstreamer.c:91-119).
 """
 
 from nnstreamer_tpu.elements import (  # noqa: F401
+    aggregator,
+    control,
     converter,
+    debug,
     decoder,
     filter as filter_element,
+    repo,
+    routing,
     sinks,
     sources,
+    sparse_elements,
     transform,
 )
 
+from nnstreamer_tpu.elements.aggregator import TensorAggregator
+from nnstreamer_tpu.elements.control import (
+    TensorCrop, TensorIf, TensorRate, register_if_condition)
 from nnstreamer_tpu.elements.converter import TensorConverter, register_converter
+from nnstreamer_tpu.elements.debug import TensorDebug
 from nnstreamer_tpu.elements.decoder import TensorDecoder, register_decoder
 from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.repo import REPO, TensorRepoSink, TensorRepoSrc
+from nnstreamer_tpu.elements.routing import (
+    Join, Queue, Tee, TensorDemux, TensorMerge, TensorMux, TensorSplit)
 from nnstreamer_tpu.elements.sinks import FakeSink, TensorSink
 from nnstreamer_tpu.elements.sources import AppSrc, TensorSrc, VideoTestSrc
+from nnstreamer_tpu.elements.sparse_elements import (
+    TensorSparseDec, TensorSparseEnc)
 from nnstreamer_tpu.elements.transform import TensorTransform, TransformProgram
 
 __all__ = [
-    "TensorConverter",
-    "TensorDecoder",
-    "TensorFilter",
-    "TensorSink",
-    "FakeSink",
     "AppSrc",
+    "FakeSink",
+    "Join",
+    "Queue",
+    "REPO",
+    "Tee",
+    "TensorAggregator",
+    "TensorConverter",
+    "TensorCrop",
+    "TensorDebug",
+    "TensorDecoder",
+    "TensorDemux",
+    "TensorFilter",
+    "TensorIf",
+    "TensorMerge",
+    "TensorMux",
+    "TensorRate",
+    "TensorRepoSink",
+    "TensorRepoSrc",
+    "TensorSink",
+    "TensorSparseDec",
+    "TensorSparseEnc",
+    "TensorSplit",
     "TensorSrc",
-    "VideoTestSrc",
     "TensorTransform",
     "TransformProgram",
+    "VideoTestSrc",
     "register_converter",
     "register_decoder",
+    "register_if_condition",
 ]

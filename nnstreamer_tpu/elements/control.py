@@ -1,0 +1,323 @@
+"""Data-driven control-flow elements: tensor_if, tensor_rate, tensor_crop.
+
+Reference parity (SURVEY.md §2.2):
+- tensor_if  (gsttensor_if.c) — stream branching on tensor values:
+  compared-value modes (gsttensor_if.h:42-55), 10 operators (:60-71),
+  then/else actions (:79-91) incl. passthrough/skip/fill-zero/tensorpick,
+  plus registered custom python predicates (TIFCV_CUSTOM analog).
+- tensor_rate (gsttensor_rate.c) — framerate conform by drop/dup with
+  `throttle` QoS.
+- tensor_crop (gsttensor_crop.c) — data-driven crop: geometry arrives as
+  a second stream; output is FLEXIBLE (per-buffer shapes).
+
+TPU-first note (§7 hard part c): tensor_if's condition evaluates on tiny
+scalars. When the compared tensors live on device, only the reduced
+scalar comes back to host (one cheap D2H of 4 bytes), never the payload;
+the payload arrays keep flowing by reference.
+"""
+
+from __future__ import annotations
+
+import operator
+from fractions import Fraction
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import PipelineError
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.pipeline import (
+    DYNAMIC, Element, Emission, PropDef, StreamSpec, prop_bool)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorInfo, TensorsSpec
+
+# -- tensor_if ---------------------------------------------------------------
+
+#: registered custom predicates (include/tensor_if.h analog)
+_custom_conds: Dict[str, Callable[[TensorBuffer], bool]] = {}
+
+
+def register_if_condition(name: str, fn: Callable[[TensorBuffer], bool]) -> None:
+    """Custom-condition registration (TIFCV_CUSTOM analog): fn(buffer)->bool."""
+    _custom_conds[name] = fn
+
+
+_OPS = {
+    "eq": operator.eq, "ne": operator.ne,
+    "gt": operator.gt, "ge": operator.ge,
+    "lt": operator.lt, "le": operator.le,
+}
+
+CV_MODES = ("a_value", "average", "custom")
+ACTIONS = ("passthrough", "skip", "fill_zero", "tensorpick")
+
+
+@register_element("tensor_if")
+class TensorIf(Element):
+    """2 src pads: 0 = then-branch, 1 = else-branch (optional).
+
+    compared_value: a_value (option "<tensor>:<flat_index>"), average
+    (option "<tensor>"), or custom (option = registered predicate name).
+    operator: eq|ne|gt|ge|lt|le  against supplied_value (float).
+    then/else: passthrough | skip | fill_zero | tensorpick (option =
+    comma indices).
+    """
+
+    ELEMENT_NAME = "tensor_if"
+    NUM_SRC_PADS = DYNAMIC
+    PROPS = {
+        "compared_value": PropDef(str, "a_value", "|".join(CV_MODES)),
+        "compared_value_option": PropDef(str, "0:0"),
+        "operator": PropDef(str, "gt", "|".join(_OPS)),
+        "supplied_value": PropDef(float, 0.0),
+        "then": PropDef(str, "passthrough", "|".join(ACTIONS)),
+        "then_option": PropDef(str, ""),
+        "else_": PropDef(str, "skip", "|".join(ACTIONS)),
+        "else_option": PropDef(str, ""),
+    }
+
+    def __init__(self, name=None, **props):
+        props = {("else_" if k in ("else", "else-") else k): v
+                 for k, v in props.items()}
+        super().__init__(name, **props)
+        if self.props["operator"] not in _OPS:
+            raise PipelineError(
+                f"tensor_if {self.name}: unknown operator "
+                f"{self.props['operator']!r}; valid: {sorted(_OPS)}"
+            )
+        if self.props["compared_value"] not in CV_MODES:
+            raise PipelineError(
+                f"tensor_if {self.name}: unknown compared_value "
+                f"{self.props['compared_value']!r}; valid: {CV_MODES}"
+            )
+
+    def _out_spec_for(self, action: str, option: str,
+                      spec: TensorsSpec) -> TensorsSpec:
+        if action == "tensorpick":
+            idxs = [int(x) for x in option.split(",") if x.strip()]
+            for i in idxs:
+                if i >= spec.num_tensors:
+                    self.fail_negotiation(
+                        f"tensorpick index {i} out of range "
+                        f"({spec.num_tensors} tensors)"
+                    )
+            return TensorsSpec(
+                tensors=tuple(spec.tensors[i] for i in idxs),
+                rate=spec.rate)
+        return spec
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        spec = self.expect_tensors(in_specs[0])
+        n_out = len(self._pipeline.links_from(self)) if self._pipeline else 1
+        if n_out not in (1, 2):
+            self.fail_negotiation(
+                f"tensor_if has then/else src pads; {n_out} links found"
+            )
+        outs = [self._out_spec_for(self.props["then"],
+                                   self.props["then_option"], spec)]
+        if n_out == 2:
+            outs.append(self._out_spec_for(self.props["else_"],
+                                           self.props["else_option"], spec))
+        return outs
+
+    # -- condition evaluation (tensor_data.c scalar math analog) -----------
+    def _decide(self, buf: TensorBuffer) -> bool:
+        mode = self.props["compared_value"]
+        opt = self.props["compared_value_option"]
+        if mode == "custom":
+            fn = _custom_conds.get(opt)
+            if fn is None:
+                raise PipelineError(
+                    f"tensor_if {self.name}: no custom condition {opt!r} "
+                    f"registered; call register_if_condition() first"
+                )
+            return bool(fn(buf))
+        if mode == "a_value":
+            ti, _, idx = opt.partition(":")
+            t = buf.tensors[int(ti or 0)]
+            flat_idx = int(idx or 0)
+            # index on whatever device t lives — float() then moves only
+            # the one scalar to host (the §7(c) no-stall property)
+            val = float(t.reshape(-1)[flat_idx])
+        else:  # average
+            t = buf.tensors[int(opt or 0)]
+            # device-side reduce → single scalar D2H
+            val = float(np.asarray(t.mean() if hasattr(t, "mean")
+                                   else np.mean(t)))
+        return _OPS[self.props["operator"]](val, self.props["supplied_value"])
+
+    def _apply(self, action: str, option: str, pad: int,
+               buf: TensorBuffer) -> List[Emission]:
+        if action == "passthrough":
+            return [(pad, buf)]
+        if action == "skip":
+            return []
+        if action == "fill_zero":
+            # build zeros from shape/dtype — never pull the payload to host
+            zeros = tuple(np.zeros(t.shape, t.dtype) for t in buf.tensors)
+            return [(pad, buf.with_tensors(zeros))]
+        if action == "tensorpick":
+            idxs = [int(x) for x in option.split(",") if x.strip()]
+            return [(pad, buf.subset(idxs))]
+        raise PipelineError(
+            f"tensor_if {self.name}: unknown action {action!r}; valid: "
+            f"{ACTIONS}"
+        )
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        cond = self._decide(buf)
+        has_else = len(self.out_specs) == 2
+        if cond:
+            return self._apply(self.props["then"],
+                               self.props["then_option"], 0, buf)
+        if has_else:
+            return self._apply(self.props["else_"],
+                               self.props["else_option"], 1, buf)
+        return []
+
+
+# -- tensor_rate -------------------------------------------------------------
+
+@register_element("tensor_rate")
+class TensorRate(Element):
+    """Conform stream to `framerate` by dropping/duplicating frames.
+
+    PTS-based like the reference (gsttensor_rate.c): each output slot i
+    has target time i/rate; incoming frames fill slots up to their PTS
+    (dup when source is slower, drop when faster). `silent=false` logs
+    drop/dup counts. `throttle=true` merely tags buffers with QoS meta —
+    backpressure is inherent to the bounded queues.
+    """
+
+    ELEMENT_NAME = "tensor_rate"
+    PROPS = {
+        "framerate": PropDef(str, None, "target rate 'N/D'"),
+        "throttle": PropDef(prop_bool, True),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.props["framerate"]:
+            raise PipelineError(
+                f"tensor_rate {self.name}: framerate=N/D is required"
+            )
+        self._rate = Fraction(self.props["framerate"].replace(":", "/"))
+        if self._rate <= 0:
+            raise PipelineError(
+                f"tensor_rate {self.name}: framerate must be positive"
+            )
+        self._next_slot = 0
+        self._prev: Optional[TensorBuffer] = None
+        self.dropped = 0
+        self.duplicated = 0
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        spec = self.expect_tensors(in_specs[0])
+        return [spec.with_rate(self._rate)]
+
+    def _slot_pts(self, slot: int) -> int:
+        return int(slot * 1_000_000_000 / self._rate)
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        if buf.pts is None:
+            return [(0, buf)]  # untimed stream: pass through
+        out: List[Emission] = []
+        # emit pending slots whose target time has passed, using the
+        # previous frame (duplication when upstream is slow)
+        while self._prev is not None and \
+                self._slot_pts(self._next_slot) < buf.pts:
+            out.append((0, self._prev.with_tensors(
+                self._prev.tensors, pts=self._slot_pts(self._next_slot))))
+            if len(out) > 1:
+                self.duplicated += 1
+            self._next_slot += 1
+        if self._slot_pts(self._next_slot) >= buf.pts or self._prev is None:
+            # frame lands in (or before) the next slot — it becomes the
+            # candidate; a faster-than-rate source overwrites (drop)
+            if self._prev is not None and buf.pts < self._slot_pts(self._next_slot):
+                self.dropped += 1
+            self._prev = buf
+        return out
+
+    def flush(self) -> List[Emission]:
+        if self._prev is not None:
+            return [(0, self._prev.with_tensors(
+                self._prev.tensors, pts=self._slot_pts(self._next_slot)))]
+        return []
+
+
+# -- tensor_crop -------------------------------------------------------------
+
+@register_element("tensor_crop")
+class TensorCrop(Element):
+    """Data-driven crop: sink 0 = raw tensors, sink 1 = crop info stream.
+
+    Crop info per frame: tensor of shape (num_regions, 4) [x, y, w, h]
+    (gsttensor_crop.c:18-33). Output is a FLEXIBLE stream — region sizes
+    vary per frame. `lateness` (ns) bounds the PTS distance accepted
+    between raw and info frames (:87).
+    """
+
+    ELEMENT_NAME = "tensor_crop"
+    NUM_SINK_PADS = 2
+    PROPS = {
+        "lateness": PropDef(int, 33_000_000, "max |pts_raw - pts_info| ns"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._raw: List[TensorBuffer] = []
+        self._info: List[TensorBuffer] = []
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        raw = self.expect_tensors(in_specs[0], 0)
+        self.expect_tensors(in_specs[1], 1)
+        if raw.num_tensors != 1:
+            self.fail_negotiation(
+                f"tensor_crop takes a single-tensor raw stream, got "
+                f"{raw.num_tensors} tensors (demux first)"
+            )
+        t = raw.tensors[0]
+        if len(t.shape) < 2:
+            self.fail_negotiation(
+                f"crop input must be at least rank-2 (spatial); got {t}"
+            )
+        return [TensorsSpec(tensors=raw.tensors, format=TensorFormat.FLEXIBLE,
+                            rate=raw.rate)]
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        (self._raw if pad == 0 else self._info).append(buf)
+        out: List[Emission] = []
+        lateness = self.props["lateness"]
+        while self._raw and self._info:
+            raw, info = self._raw[0], self._info[0]
+            d = abs((raw.pts or 0) - (info.pts or 0))
+            if d > lateness:
+                # discard the older of the two and retry
+                if (raw.pts or 0) < (info.pts or 0):
+                    self._raw.pop(0)
+                else:
+                    self._info.pop(0)
+                continue
+            self._raw.pop(0)
+            self._info.pop(0)
+            out.append((0, self._crop(raw, info)))
+        return out
+
+    def _crop(self, raw: TensorBuffer, info: TensorBuffer) -> TensorBuffer:
+        t = raw.tensors[0]
+        regions = np.asarray(info.tensors[0]).reshape(-1, 4).astype(np.int64)
+        crops = []
+        # spatial dims: assume (..., H, W, C) if rank>=3 else (H, W)
+        h_ax = t.ndim - 3 if t.ndim >= 3 else 0
+        w_ax = h_ax + 1
+        H, W = t.shape[h_ax], t.shape[w_ax]
+        for x, y, w, h in regions:
+            x0, y0 = max(0, int(x)), max(0, int(y))
+            x1, y1 = min(W, x0 + int(w)), min(H, y0 + int(h))
+            sl = [slice(None)] * t.ndim
+            sl[h_ax] = slice(y0, y1)
+            sl[w_ax] = slice(x0, x1)
+            crops.append(t[tuple(sl)])
+        return TensorBuffer(tensors=tuple(crops), pts=raw.pts,
+                            format=TensorFormat.FLEXIBLE)

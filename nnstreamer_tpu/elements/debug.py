@@ -1,0 +1,61 @@
+"""tensor_debug — passthrough stream introspection.
+
+Reference parity: gsttensor_debug.c (:29) — prints caps/meta of passing
+buffers. Here it logs spec + per-buffer summary (shape/dtype/pts/device
+residency) through the framework logger, with `output=console|log` and a
+`capture` list for tests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.pipeline import Element, Emission, PropDef, StreamSpec, prop_bool
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+log = get_logger("elements.debug")
+
+
+@register_element("tensor_debug")
+class TensorDebug(Element):
+    ELEMENT_NAME = "tensor_debug"
+    PROPS = {
+        "output": PropDef(str, "log", "log|console"),
+        "verbose": PropDef(prop_bool, False, "include value stats"),
+        "capture": PropDef(prop_bool, False, "record lines in .lines"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.lines: List[str] = []
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        self._say(f"{self.name}: negotiated {in_specs[0]}")
+        return [in_specs[0]]
+
+    def _say(self, line: str) -> None:
+        if self.props["capture"]:
+            self.lines.append(line)
+        if self.props["output"] == "console":
+            print(line)
+        else:
+            log.info("%s", line)
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        desc = repr(buf)
+        if self.props["verbose"]:
+            stats = []
+            for t in buf.tensors:
+                a = np.asarray(t)
+                if a.dtype.kind in "fiu" and a.size:
+                    stats.append(f"min={a.min():.4g} max={a.max():.4g} "
+                                 f"mean={a.mean():.4g}")
+                else:
+                    stats.append("-")
+            desc += " [" + "; ".join(stats) + "]"
+        self._say(f"{self.name}: {desc}")
+        return [(0, buf)]

@@ -1,0 +1,144 @@
+"""tensor_repo — out-of-band buffer repository for feedback loops.
+
+Reference parity: gsttensor_reposink.c / gsttensor_reposrc.c /
+gsttensor_repo.c — a global slot-indexed repository passing buffers
+outside the link graph, the sanctioned way to build cycles (RNN/LSTM
+state, tests/nnstreamer_repo_{rnn,lstm}). The pipeline DAG stays acyclic;
+the repo closes the loop.
+
+Semantics: reposink writes its input buffer into slot N; reposrc reads
+slot N, emitting one buffer per read. reposrc must produce the *first*
+buffer itself (the loop has no data yet): zeros shaped by dims/types —
+the recurrent-state initializer.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from fractions import Fraction
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import PipelineError
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.pipeline import (
+    Element, Emission, PropDef, SinkElement, SourceElement, StreamSpec)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+
+class _Repo:
+    """Global slot table (gsttensor_repo.c analog)."""
+
+    def __init__(self):
+        self._slots: Dict[int, _queue.Queue] = {}
+        self._lock = threading.Lock()
+
+    def slot(self, idx: int) -> _queue.Queue:
+        with self._lock:
+            if idx not in self._slots:
+                self._slots[idx] = _queue.Queue(maxsize=16)
+            return self._slots[idx]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._slots.clear()
+
+
+REPO = _Repo()
+
+
+@register_element("tensor_repo_sink")
+class TensorRepoSink(SinkElement):
+    ELEMENT_NAME = "tensor_repo_sink"
+    PROPS = {
+        "slot": PropDef(int, 0, "repository slot index"),
+    }
+
+    def render(self, buf: TensorBuffer) -> None:
+        q = REPO.slot(self.props["slot"])
+        try:
+            q.put(buf, timeout=10)
+        except _queue.Full:
+            raise PipelineError(
+                f"tensor_repo_sink {self.name}: slot "
+                f"{self.props['slot']} full — is the matching "
+                f"tensor_repo_src consuming?"
+            ) from None
+
+    def stop(self) -> None:
+        # wake a blocked reposrc at teardown
+        try:
+            REPO.slot(self.props["slot"]).put_nowait(None)
+        except _queue.Full:
+            pass
+
+
+@register_element("tensor_repo_src")
+class TensorRepoSrc(SourceElement):
+    """Reads slot N. Emits `initial` zero-buffers first to prime the loop,
+    then one buffer per reposink write, until `count` total buffers."""
+
+    ELEMENT_NAME = "tensor_repo_src"
+    PROPS = {
+        "slot": PropDef(int, 0),
+        "dims": PropDef(str, None, "state tensor dims (zeros initializer)"),
+        "types": PropDef(str, "float32"),
+        "initial": PropDef(int, 1, "number of priming zero-buffers"),
+        "count": PropDef(int, 0, "total buffers to emit; 0 = until stopped"),
+        "rate": PropDef(str, "0/1"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        # purge stale buffers / teardown sentinels a previous run left in
+        # this slot, so every pipeline run starts from a clean loop state
+        q = REPO.slot(self.props["slot"])
+        while True:
+            try:
+                q.get_nowait()
+            except _queue.Empty:
+                break
+
+    def output_spec(self) -> StreamSpec:
+        if not self.props["dims"]:
+            raise PipelineError(
+                f"tensor_repo_src {self.name}: dims= is required (shapes "
+                f"the priming zero-state)"
+            )
+        return TensorsSpec.from_strings(
+            self.props["dims"], self.props["types"],
+            rate=Fraction(self.props["rate"]))
+
+    def interrupt(self) -> None:
+        self._stop.set()
+        try:
+            REPO.slot(self.props["slot"]).put_nowait(None)
+        except _queue.Full:
+            pass
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        spec: TensorsSpec = self.out_specs[0]
+        emitted = 0
+        count = self.props["count"]
+        for _ in range(self.props["initial"]):
+            zeros = tuple(np.zeros(t.shape, t.dtype.np_dtype)
+                          for t in spec.tensors)
+            yield TensorBuffer(tensors=zeros, pts=0)
+            emitted += 1
+            if count and emitted >= count:
+                return
+        q = REPO.slot(self.props["slot"])
+        while not self._stop.is_set():
+            item = q.get()
+            if item is None:
+                return
+            yield item
+            emitted += 1
+            if count and emitted >= count:
+                return

@@ -1,0 +1,148 @@
+"""Shared plain-JAX NN layers for the model zoo.
+
+Design: models are *pure functions* over explicit param pytrees (nested
+dicts of jnp arrays) — no framework classes. This keeps every model
+directly jit/pjit/shard_map-able and makes param sharding rules trivial
+to express as pytree paths (parallel/mesh.py).
+
+TPU-first conventions:
+- NHWC layouts and channel-last convs: XLA tiles these onto the MXU.
+- Channel counts padded to multiples of 8 where architectures allow.
+- `dtype` threading: params live in float32 (optimizer precision), the
+  forward cast to bfloat16 happens at the compute boundary so matmuls/
+  convs run in bf16 on the MXU with float32 accumulation (the default
+  `preferred_element_type` behavior).
+
+Replaces: the reference has no model code at all — its models are opaque
+vendor files run by filter subplugins (SURVEY.md §2.3). A TPU-native
+framework ships models as traced code so transforms fuse around them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initializers (deterministic given the key)
+# ---------------------------------------------------------------------------
+
+def _fan_in_out(shape: Sequence[int]) -> Tuple[int, int]:
+    if len(shape) == 2:  # dense (in, out)
+        return shape[0], shape[1]
+    # conv HWIO: receptive * in, receptive * out
+    receptive = math.prod(shape[:-2])
+    return receptive * shape[-2], receptive * shape[-1]
+
+
+def kaiming_init(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fan_in_out(shape)
+    std = math.sqrt(2.0 / max(1, fan_in))
+    return std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+
+def xavier_init(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fan_in_out(shape)
+    limit = math.sqrt(6.0 / max(1, fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+# ---------------------------------------------------------------------------
+# Conv / BN / dense primitives. Params are dicts; init_* builds them.
+# ---------------------------------------------------------------------------
+
+def init_conv(key, kh, kw, cin, cout, *, groups: int = 1) -> Params:
+    """HWIO conv kernel. groups=cin & cout=cin → depthwise."""
+    w = kaiming_init(key, (kh, kw, cin // groups, cout))
+    return {"w": w}
+
+
+def conv2d(p: Params, x, *, stride: int = 1, padding="SAME",
+           groups: int = 1, dtype=None):
+    w = p["w"]
+    if dtype is not None:
+        w = w.astype(dtype)
+        x = x.astype(dtype)
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+
+
+def init_bn(cout: int) -> Params:
+    return {
+        "scale": jnp.ones((cout,), jnp.float32),
+        "bias": jnp.zeros((cout,), jnp.float32),
+        "mean": jnp.zeros((cout,), jnp.float32),
+        "var": jnp.ones((cout,), jnp.float32),
+    }
+
+
+def batch_norm(p: Params, x, *, train: bool = False, eps: float = 1e-3):
+    """Inference BN uses stored stats; train uses batch stats.
+
+    Returns (y, batch_stats) where batch_stats is (mean, var) under
+    train=True (for the caller to fold into running stats) else None.
+    """
+    if train:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+        var = jnp.var(x.astype(jnp.float32), axis=axes)
+        stats = (mean, var)
+    else:
+        mean, var = p["mean"], p["var"]
+        stats = None
+    inv = lax.rsqrt(var + eps) * p["scale"]
+    y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + p["bias"].astype(x.dtype)
+    return y, stats
+
+
+def init_dense(key, cin: int, cout: int) -> Params:
+    kw, _ = jax.random.split(key)
+    return {"w": xavier_init(kw, (cin, cout)), "b": jnp.zeros((cout,), jnp.float32)}
+
+
+def dense(p: Params, x, *, dtype=None):
+    w, b = p["w"], p["b"]
+    if dtype is not None:
+        w, b, x = w.astype(dtype), b.astype(dtype), x.astype(dtype)
+    return x @ w + b
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+# ---------------------------------------------------------------------------
+# Conv + BN (+relu6) block — the MobileNet building unit
+# ---------------------------------------------------------------------------
+
+def init_conv_bn(key, kh, kw, cin, cout, *, groups: int = 1) -> Params:
+    return {"conv": init_conv(key, kh, kw, cin, cout, groups=groups),
+            "bn": init_bn(cout)}
+
+
+def conv_bn(p: Params, x, *, stride=1, groups=1, act=relu6,
+            train: bool = False, dtype=None):
+    y = conv2d(p["conv"], x, stride=stride, groups=groups, dtype=dtype)
+    y, _ = batch_norm(p["bn"], y, train=train)
+    return act(y) if act is not None else y
+
+
+def global_avg_pool(x):
+    """NHWC → NC mean over spatial dims."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def count_params(params) -> int:
+    return sum(int(a.size) for a in jax.tree_util.tree_leaves(params))

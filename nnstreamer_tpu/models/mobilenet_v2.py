@@ -1,0 +1,152 @@
+"""MobileNetV2 — the flagship classification model (zoo://mobilenet_v2).
+
+Covers the reference's headline pipeline: tensor_filter running
+mobilenet_v2_1.0_224_quant.tflite for image labeling
+(tests/nnstreamer_filter_tensorflow_lite/runTest.sh, BASELINE.md config 1)
+— rebuilt as traced JAX code so the surrounding tensor_transform chain
+fuses into the same XLA computation.
+
+Architecture: Sandler et al. 2018 inverted residuals, width-multiplier
+aware, NHWC, bf16 compute / f32 params. Output is 1001 classes
+(background + ImageNet), matching the reference model's label layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import layers as L
+from nnstreamer_tpu.models.zoo import register_model
+
+# (expansion t, out channels c, repeats n, first stride s) — the paper's
+# table 2 / standard 1.0 config.
+_INVERTED_RESIDUAL_CFG = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    """Round channel counts the MobileNet way (multiples of 8 — also the
+    TPU-friendly lane multiple)."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def init_block(key, cin: int, cout: int, t: int, stride: int) -> Dict[str, Any]:
+    hidden = cin * t
+    keys = jax.random.split(key, 3)
+    p: Dict[str, Any] = {}
+    if t != 1:
+        p["expand"] = L.init_conv_bn(keys[0], 1, 1, cin, hidden)
+    p["depthwise"] = L.init_conv_bn(keys[1], 3, 3, hidden, hidden, groups=hidden)
+    p["project"] = L.init_conv_bn(keys[2], 1, 1, hidden, cout)
+    return p
+
+
+def block_apply(p, x, *, cin, cout, t, stride, train=False, dtype=None):
+    h = x
+    if t != 1:
+        h = L.conv_bn(p["expand"], h, train=train, dtype=dtype)
+    h = L.conv_bn(p["depthwise"], h, stride=stride,
+                  groups=h.shape[-1], train=train, dtype=dtype)
+    h = L.conv_bn(p["project"], h, act=None, train=train, dtype=dtype)
+    if stride == 1 and cin == cout:
+        h = h + x
+    return h
+
+
+def init_params(key=None, *, width: float = 1.0, num_classes: int = 1001,
+                seed: int = 0) -> Dict[str, Any]:
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    n_blocks = sum(n for _, _, n, _ in _INVERTED_RESIDUAL_CFG)
+    keys = jax.random.split(key, n_blocks + 3)
+    ki = iter(range(n_blocks + 3))
+
+    stem_out = _make_divisible(32 * width)
+    params: Dict[str, Any] = {
+        "stem": L.init_conv_bn(keys[next(ki)], 3, 3, 3, stem_out),
+        "blocks": [],
+    }
+    cin = stem_out
+    for t, c, n, s in _INVERTED_RESIDUAL_CFG:
+        cout = _make_divisible(c * width)
+        for i in range(n):
+            stride = s if i == 0 else 1
+            params["blocks"].append(init_block(keys[next(ki)], cin, cout, t, stride))
+            cin = cout
+    head_out = _make_divisible(1280 * max(1.0, width))
+    params["head"] = L.init_conv_bn(keys[next(ki)], 1, 1, cin, head_out)
+    params["classifier"] = L.init_dense(keys[next(ki)], head_out, num_classes)
+    return params
+
+
+def apply(params, x, *, width: float = 1.0, train: bool = False,
+          dtype=jnp.bfloat16, features_only: bool = False):
+    """Forward. x: NHWC float (any float dtype), already normalized to
+    roughly [-1, 1]. Returns logits (N, num_classes) in float32, or the
+    list of stride-{8,16,32} feature maps when features_only (SSD use).
+    """
+    x = x.astype(dtype)
+    h = L.conv_bn(params["stem"], x, stride=2, train=train, dtype=dtype)
+    feats = []
+    bi = 0
+    cin = h.shape[-1]
+    for t, c, n, s in _INVERTED_RESIDUAL_CFG:
+        cout = _make_divisible(c * width)
+        for i in range(n):
+            stride = s if i == 0 else 1
+            if stride == 2:
+                feats.append(h)
+            h = block_apply(params["blocks"][bi], h, cin=cin, cout=cout,
+                            t=t, stride=stride, train=train, dtype=dtype)
+            cin = cout
+            bi += 1
+    h = L.conv_bn(params["head"], h, train=train, dtype=dtype)
+    if features_only:
+        feats.append(h)
+        return feats
+    h = L.global_avg_pool(h)
+    logits = L.dense(params["classifier"], h, dtype=dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, x, labels, *, width: float = 1.0, dtype=jnp.bfloat16):
+    """Softmax cross-entropy training loss (used by trainer/ and the
+    multichip dry-run train step)."""
+    logits = apply(params, x, width=width, train=True, dtype=dtype)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+@register_model("mobilenet_v2")
+def build(width: float = 1.0, num_classes: int = 1001, input_size: int = 224,
+          batch: int = 1, dtype: str = "bfloat16", seed: int = 0):
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    cdtype = jnp.dtype(dtype)
+    params = init_params(width=width, num_classes=num_classes, seed=seed)
+
+    def fn(params, x):
+        return apply(params, x, width=width, dtype=cdtype)
+
+    in_spec = TensorsSpec.of(
+        TensorInfo((batch, input_size, input_size, 3), DType.FLOAT32)
+    )
+    out_spec = TensorsSpec.of(TensorInfo((batch, num_classes), DType.FLOAT32))
+    return ModelBundle(fn=fn, params=params, in_spec=in_spec,
+                       out_spec=out_spec, name=f"mobilenet_v2_{width}")

@@ -1,0 +1,78 @@
+"""PoseNet — single-person pose estimation (zoo://posenet).
+
+Covers the reference's pose pipeline: posenet model + `tensor_decoder
+mode=pose_estimation` (ext/nnstreamer/tensor_decoder/tensordec-pose.c,
+tests/nnstreamer_decoder_pose/). Outputs the decoder's expected pair:
+keypoint heatmaps (N, H/16, W/16, K) and short-range offsets
+(N, H/16, W/16, 2K) for K=17 COCO keypoints.
+
+Backbone is MobileNetV2 truncated at stride 16 (output_stride=16 via
+skipping the last stride-2 — standard PoseNet practice), heads are 1x1
+convs — all one fused XLA computation on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import layers as L
+from nnstreamer_tpu.models import mobilenet_v2 as mnv2
+from nnstreamer_tpu.models.zoo import register_model
+
+NUM_KEYPOINTS = 17
+
+
+def init_params(key=None, *, width: float = 1.0, seed: int = 0) -> Dict[str, Any]:
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    kb, kp, kh, ko = jax.random.split(key, 4)
+    backbone = mnv2.init_params(kb, width=width)
+    c16 = mnv2._make_divisible(96 * width)  # stride-16 feature channels
+    chead = 256
+    return {
+        "backbone": backbone,
+        "proj": L.init_conv_bn(kp, 1, 1, c16, chead),
+        "heatmap": L.init_conv(kh, 1, 1, chead, NUM_KEYPOINTS),
+        "offset": L.init_conv(ko, 1, 1, chead, 2 * NUM_KEYPOINTS),
+    }
+
+
+def apply(params, x, *, width: float = 1.0, train: bool = False,
+          dtype=jnp.bfloat16):
+    """x: (N, H, W, 3) float → (heatmaps (N,h,w,17) sigmoid f32,
+    offsets (N,h,w,34) f32) at output stride 16."""
+    feats = mnv2.apply(params["backbone"], x, width=width, train=train,
+                       dtype=dtype, features_only=True)
+    # run the head on the stride-16 map upsampled path: PoseNet keeps
+    # output_stride 16 by using the pre-stride-32 features; the 1280-ch
+    # head conv of the backbone ran at stride 32, so re-project from the
+    # stride-16 map instead.
+    h16 = feats[-2]
+    h = L.conv_bn(params["proj"], h16, train=train, dtype=dtype)
+    heat = L.conv2d(params["heatmap"], h, dtype=dtype)
+    off = L.conv2d(params["offset"], h, dtype=dtype)
+    return (jax.nn.sigmoid(heat).astype(jnp.float32),
+            off.astype(jnp.float32))
+
+
+@register_model("posenet")
+def build(width: float = 1.0, input_size: int = 257, batch: int = 1,
+          dtype: str = "bfloat16", seed: int = 0):
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    cdtype = jnp.dtype(dtype)
+    params = init_params(width=width, seed=seed)
+
+    def fn(params, x):
+        return apply(params, x, width=width, dtype=cdtype)
+
+    in_spec = TensorsSpec.of(
+        TensorInfo((batch, input_size, input_size, 3), DType.FLOAT32))
+    return ModelBundle(fn=fn, params=params, in_spec=in_spec,
+                       out_spec=None,  # negotiated via eval_shape
+                       name="posenet_mnv2")

@@ -1,0 +1,168 @@
+"""SSD-MobileNetV2 object detector (zoo://ssd_mobilenet).
+
+Covers the reference's detection pipeline: SSD model + `tensor_decoder
+mode=bounding_boxes option1=mobilenet-ssd` with a box-priors file
+(gst/nnstreamer/tensor_query/README.md:46-53 pipeline;
+ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c). TPU-first: the
+priors are generated in-code (`generate_anchors`) and shared with the
+decoder — no sidecar file — and the whole detector is one fused XLA
+computation.
+
+Outputs (per frame): loc deltas (N, A, 4) [ty, tx, th, tw] and class
+logits (N, A, num_classes) for A=1917 anchors at input 300², the standard
+TF-SSD anchor grid the reference's decoder expects.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.models import layers as L
+from nnstreamer_tpu.models import mobilenet_v2 as mnv2
+from nnstreamer_tpu.models.zoo import register_model
+
+# feature-map grid sizes for 300x300 input and anchors per cell — yields
+# the canonical 1917-anchor layout (19²·3 + (10²+5²+3²+2²+1)·6).
+_GRIDS_300 = ((19, 3), (10, 6), (5, 6), (3, 6), (2, 6), (1, 6))
+_SCALES = (0.2, 0.35, 0.5, 0.65, 0.8, 0.95, 1.0)
+_ASPECTS = (1.0, 2.0, 0.5, 3.0, 1.0 / 3.0)
+_BOX_CODER = (10.0, 10.0, 5.0, 5.0)  # ty, tx, th, tw scale factors
+
+
+def generate_anchors(grids=_GRIDS_300) -> np.ndarray:
+    """→ (A, 4) float32 [cy, cx, h, w] in [0,1] — the box-priors analog."""
+    out: List[np.ndarray] = []
+    for level, (g, n_anchor) in enumerate(grids):
+        s = _SCALES[level]
+        s_next = _SCALES[level + 1]
+        if n_anchor == 3:
+            # first layer: reduced set {1.0 scaled-down, 2.0, 0.5}
+            hw = [(0.1, 0.1),
+                  (s / math.sqrt(2.0), s * math.sqrt(2.0)),
+                  (s * math.sqrt(2.0), s / math.sqrt(2.0))]
+        else:
+            hw = [(s / math.sqrt(a), s * math.sqrt(a)) for a in _ASPECTS]
+            hw.append((math.sqrt(s * s_next), math.sqrt(s * s_next)))
+        ys, xs = np.meshgrid(np.arange(g), np.arange(g), indexing="ij")
+        cy = ((ys + 0.5) / g).reshape(-1)
+        cx = ((xs + 0.5) / g).reshape(-1)
+        per_anchor = [
+            np.stack([cy, cx, np.full_like(cy, h), np.full_like(cx, w)], axis=-1)
+            for h, w in hw[:n_anchor]
+        ]
+        # per-cell interleave (anchors of one cell contiguous) — matches the
+        # head's reshape(n, -1, 4) ordering
+        lvl = np.stack(per_anchor, axis=1)  # (cells, n_anchor, 4)
+        out.append(lvl.reshape(g * g * n_anchor, 4))
+    return np.concatenate(out, axis=0).astype(np.float32)
+
+
+def decode_boxes(loc, anchors):
+    """SSD box-coder decode: deltas+priors → (ymin, xmin, ymax, xmax).
+
+    jnp-traceable (used on-device by the fused decoder path) and
+    numpy-compatible (host decoder).
+    """
+    ty, tx, th, tw = (loc[..., 0] / _BOX_CODER[0], loc[..., 1] / _BOX_CODER[1],
+                      loc[..., 2] / _BOX_CODER[2], loc[..., 3] / _BOX_CODER[3])
+    acy, acx, ah, aw = (anchors[..., 0], anchors[..., 1],
+                        anchors[..., 2], anchors[..., 3])
+    xp = jnp if not isinstance(loc, np.ndarray) else np
+    cy = ty * ah + acy
+    cx = tx * aw + acx
+    h = ah * xp.exp(th)
+    w = aw * xp.exp(tw)
+    return xp.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2], axis=-1)
+
+
+def init_params(key=None, *, num_classes: int = 91, width: float = 1.0,
+                seed: int = 0) -> Dict[str, Any]:
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    kb, kx, kh = jax.random.split(key, 3)
+    params: Dict[str, Any] = {"backbone": mnv2.init_params(kb, width=width)}
+    # extra feature layers past the backbone: 1280→512→256→256→128, each a
+    # 1x1 squeeze + 3x3 stride-2 conv (SSD extra-layer pattern)
+    head_in = [
+        mnv2._make_divisible(96 * width),    # stride-16 feature map (19²)
+        mnv2._make_divisible(1280 * max(1.0, width)),
+        512, 256, 256, 128,
+    ]
+    extras = []
+    cin = head_in[1]
+    xkeys = jax.random.split(kx, 4)
+    for i, cout in enumerate(head_in[2:]):
+        k1, k2 = jax.random.split(xkeys[i])
+        extras.append({
+            "squeeze": L.init_conv_bn(k1, 1, 1, cin, cout // 2),
+            "conv": L.init_conv_bn(k2, 3, 3, cout // 2, cout),
+        })
+        cin = cout
+    params["extras"] = extras
+    # prediction heads: per level a loc conv (n_anchor*4) and cls conv
+    locs, clss = [], []
+    hkeys = jax.random.split(kh, len(_GRIDS_300) * 2)
+    for i, ((g, n_anchor), cin) in enumerate(zip(_GRIDS_300, head_in)):
+        locs.append(L.init_conv(hkeys[2 * i], 3, 3, cin, n_anchor * 4))
+        clss.append(L.init_conv(hkeys[2 * i + 1], 3, 3, cin, n_anchor * num_classes))
+    params["loc_heads"] = locs
+    params["cls_heads"] = clss
+    return params
+
+
+def apply(params, x, *, num_classes: int = 91, width: float = 1.0,
+          train: bool = False, dtype=jnp.bfloat16):
+    """x: (N, 300, 300, 3) float → (loc (N,A,4) f32, logits (N,A,C) f32)."""
+    n = x.shape[0]
+    feats = mnv2.apply(params["backbone"], x, width=width, train=train,
+                       dtype=dtype, features_only=True)
+    # stride-16 map (19², pre-stride-32 input) and the 1280-ch head (10²)
+    levels = [feats[-2], feats[-1]]
+    h = feats[-1]
+    for extra in params["extras"]:
+        h = L.conv_bn(extra["squeeze"], h, train=train, dtype=dtype)
+        h = L.conv_bn(extra["conv"], h, stride=2, train=train, dtype=dtype)
+        levels.append(h)
+    locs, clss = [], []
+    for lvl, lp, cp in zip(levels, params["loc_heads"], params["cls_heads"]):
+        loc = L.conv2d(lp, lvl, dtype=dtype)
+        cls = L.conv2d(cp, lvl, dtype=dtype)
+        locs.append(loc.reshape(n, -1, 4))
+        clss.append(cls.reshape(n, -1, num_classes))
+    return (jnp.concatenate(locs, axis=1).astype(jnp.float32),
+            jnp.concatenate(clss, axis=1).astype(jnp.float32))
+
+
+@register_model("ssd_mobilenet")
+def build(num_classes: int = 91, width: float = 1.0, input_size: int = 300,
+          batch: int = 1, dtype: str = "bfloat16", seed: int = 0):
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    if input_size != 300:
+        raise ValueError(
+            "zoo://ssd_mobilenet currently ships the canonical 300x300 "
+            "anchor grid (1917 anchors); input_size must be 300"
+        )
+    cdtype = jnp.dtype(dtype)
+    params = init_params(num_classes=num_classes, width=width, seed=seed)
+    n_anchors = int(generate_anchors().shape[0])
+
+    def fn(params, x):
+        return apply(params, x, num_classes=num_classes, width=width,
+                     dtype=cdtype)
+
+    in_spec = TensorsSpec.of(
+        TensorInfo((batch, input_size, input_size, 3), DType.FLOAT32))
+    out_spec = TensorsSpec.of(
+        TensorInfo((batch, n_anchors, 4), DType.FLOAT32, name="loc"),
+        TensorInfo((batch, n_anchors, num_classes), DType.FLOAT32, name="scores"),
+    )
+    return ModelBundle(fn=fn, params=params, in_spec=in_spec,
+                       out_spec=out_spec, name="ssd_mobilenet_v2")

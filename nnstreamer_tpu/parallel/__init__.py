@@ -1,0 +1,33 @@
+"""Multi-chip scale-out: meshes, sharding rules, distributed train/infer.
+
+The reference has NO collectives — its "distributed" story is
+point-to-point TCP/MQTT/gRPC offload (SURVEY.md §5.8). The TPU-native
+equivalent is first-class: device meshes (`jax.sharding.Mesh`) with
+dp/tp/sp axes, XLA collectives over ICI inserted by pjit from sharding
+annotations, ring attention for sequence parallelism, and a pod batch
+dispatcher that replaces per-frame TCP request/reply (edge/ still
+provides the off-pod parity transport).
+
+Modules:
+- mesh.py           — mesh construction + pytree sharding rules
+- train.py          — sharded train step (optax) + TrainState
+- ring_attention.py — sequence-parallel attention via shard_map/ppermute
+- dispatch.py       — pod batch dispatcher (mesh-sharded inference)
+"""
+
+from nnstreamer_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    shard_params,
+    sharding_for,
+)
+from nnstreamer_tpu.parallel.train import TrainState, make_train_step
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "shard_params",
+    "sharding_for",
+    "TrainState",
+    "make_train_step",
+]

@@ -1,0 +1,138 @@
+"""Pod batch dispatcher — mesh-sharded streaming inference.
+
+North-star replacement for the reference's per-frame TCP request/reply
+offload (`tensor_query_client` → server, SURVEY.md §3.4): instead of one
+frame per round-trip, frames from any number of streams are coalesced
+into batches, sharded over the mesh's dp axis, and executed as one pjit
+computation whose collectives ride ICI. Off-pod clients still reach this
+through edge/ (parity transport); on-pod, elements call it directly.
+
+Flow: submit(frame) → future; a collector thread packs up to
+`max_batch` frames (or flushes after `max_delay_ms`), pads the batch to
+the bucket size (static shapes — no recompiles), runs the sharded fn,
+and resolves futures with per-frame outputs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.core.log import get_logger
+
+log = get_logger("parallel.dispatch")
+
+
+class MeshDispatcher:
+    """Batches single-frame requests onto a dp-sharded jit computation.
+
+    fn(params, x) must accept a leading batch dim; `bucket` is the
+    compiled batch size (requests are padded up to it, so there is
+    exactly one compilation).
+    """
+
+    def __init__(self, fn: Callable, params, mesh: Mesh, *,
+                 bucket: int = 8, max_delay_ms: float = 2.0,
+                 batch_axis: str = "dp"):
+        if bucket % mesh.shape[batch_axis] != 0:
+            raise StreamError(
+                f"bucket {bucket} must be divisible by mesh axis "
+                f"{batch_axis!r} size {mesh.shape[batch_axis]}"
+            )
+        self.mesh = mesh
+        self.bucket = bucket
+        self.max_delay = max_delay_ms / 1e3
+        x_sharding = NamedSharding(mesh, P(batch_axis))
+
+        def batched(params, x):
+            x = jax.lax.with_sharding_constraint(x, x_sharding)
+            return fn(params, x)
+
+        self._params = params
+        self._fn = jax.jit(batched)
+        self._pending: List[Tuple[Any, Future]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mesh-dispatch", daemon=True)
+        self._thread.start()
+        # perf counters (BASELINE.md: p50 latency / batches)
+        self.frames = 0
+        self.batches = 0
+
+    # -- client API --------------------------------------------------------
+    def submit(self, frame) -> Future:
+        """frame: single-sample array (no batch dim or batch=1)."""
+        fut: Future = Future()
+        with self._lock:
+            if self._stop:
+                raise StreamError("dispatcher is shut down")
+            self._pending.append((frame, fut))
+        self._wake.set()
+        return fut
+
+    def infer(self, frame, timeout: Optional[float] = 30.0):
+        return self.submit(frame).result(timeout)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    # -- batcher loop ------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait(timeout=0.1)
+            with self._lock:
+                if self._stop and not self._pending:
+                    return
+                have = len(self._pending)
+            if have == 0:
+                self._wake.clear()
+                continue
+            if have < self.bucket:
+                # linger briefly for more frames, then flush what we have
+                time.sleep(self.max_delay)
+            with self._lock:
+                take = self._pending[: self.bucket]
+                del self._pending[: len(take)]
+                if not self._pending:
+                    self._wake.clear()
+            if take:
+                self._run_batch(take)
+
+    def _squeeze(self, f):
+        """Accept samples with or without a leading batch=1 dim."""
+        f = np.asarray(f)
+        return f[0] if f.ndim > 1 and f.shape[0] == 1 else f
+
+    def _run_batch(self, take) -> None:
+        frames = [self._squeeze(f) for f, _ in take]
+        n = len(frames)
+        try:
+            batch = np.stack(frames, axis=0)
+            if n < self.bucket:  # pad to the compiled bucket size
+                pad = np.zeros((self.bucket - n,) + batch.shape[1:], batch.dtype)
+                batch = np.concatenate([batch, pad], axis=0)
+            out = self._fn(self._params, jnp.asarray(batch))
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            host = [np.asarray(o) for o in outs]
+            for i, (_, fut) in enumerate(take):
+                fut.set_result(tuple(h[i] for h in host))
+            self.frames += n
+            self.batches += 1
+        except Exception as e:  # resolve futures, never hang clients
+            for _, fut in take:
+                if not fut.done():
+                    fut.set_exception(
+                        StreamError(f"mesh dispatch failed: {e}"))

@@ -1,0 +1,118 @@
+"""Sharded training step.
+
+The reference is inference-only (SURVEY.md §0) with a `tensor_trainer`
+subplugin *type* reserved in its registry (nnstreamer_subplugin.h). Here
+training is first-class and TPU-native: one jitted step, params/opt-state
+sharded per mesh rules, batch sharded over (dp, sp), gradients reduced by
+XLA collectives over ICI — no NCCL/MPI analog, no hand-written reduce.
+
+Donation: params and opt_state are donated into the step so the update is
+in-place in HBM (no 2× weight memory).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nnstreamer_tpu.parallel.mesh import default_param_rules, param_specs
+
+LossFn = Callable[..., jnp.ndarray]  # loss_fn(params, *batch) -> scalar
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+def init_state(params, optimizer: optax.GradientTransformation) -> TrainState:
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+    )
+
+
+def shard_state(state: TrainState, mesh: Mesh, rules=None) -> TrainState:
+    """Place a TrainState on the mesh: params by rules, opt_state mirrors
+    params leaf-by-leaf shape (moments share param sharding), scalars
+    replicated."""
+    rules = rules if rules is not None else default_param_rules()
+    pspecs = param_specs(state.params, mesh, rules)
+    params_treedef = jax.tree_util.tree_structure(state.params)
+
+    def place(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    def place_opt(node):
+        # optax states are (named)tuples whose param-shaped members mirror
+        # the params pytree exactly (e.g. Adam's mu/nu); match by tree
+        # STRUCTURE, not leaf shape, so same-shaped params with different
+        # partition rules keep distinct moment shardings
+        if jax.tree_util.tree_structure(node) == params_treedef:
+            return jax.tree_util.tree_map(place, node, pspecs)
+        if isinstance(node, tuple):
+            children = [place_opt(c) for c in node]
+            if hasattr(node, "_fields"):  # NamedTuple optax states
+                return type(node)(*children)
+            return tuple(children)
+        if isinstance(node, (list,)):
+            return [place_opt(c) for c in node]
+        if isinstance(node, dict):
+            return {k: place_opt(v) for k, v in node.items()}
+        return place(node, P())  # counts/scalars: replicate
+
+    return TrainState(
+        step=place(state.step, P()),
+        params=jax.tree_util.tree_map(place, state.params, pspecs),
+        opt_state=place_opt(state.opt_state),
+    )
+
+
+def make_train_step(loss_fn: LossFn, optimizer: optax.GradientTransformation,
+                    mesh: Optional[Mesh] = None,
+                    batch_spec: Optional[Sequence[P]] = None,
+                    donate: bool = True):
+    """Build a jitted `step(state, *batch) -> (state, loss)`.
+
+    With a mesh, batch args get in_shardings (default: shard leading dim
+    over dp) and XLA inserts the gradient all-reduce implied by sharded
+    batch + replicated-or-tp-sharded params. Without a mesh, plain jit.
+    """
+
+    def step(state: TrainState, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, *batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(step=state.step + 1, params=params,
+                          opt_state=opt_state), loss
+
+    donate_argnums = (0,) if donate else ()
+    if mesh is None:
+        return jax.jit(step, donate_argnums=donate_argnums)
+
+    if batch_spec is None:
+        batch_spec = (P("dp"),) * 8  # enough for any arity; trimmed below
+
+    def wrapped(state, *batch):
+        return step(state, *batch)
+
+    # Rely on sharding propagation from the placed TrainState (shard_state)
+    # + constrained batch inputs.
+    def constrained(state, *batch):
+        batch = tuple(
+            jax.lax.with_sharding_constraint(b, NamedSharding(mesh, s))
+            for b, s in zip(batch, batch_spec)
+        )
+        return wrapped(state, *batch)
+
+    return jax.jit(constrained, donate_argnums=donate_argnums)

@@ -7,12 +7,20 @@ driver's dryrun uses). Bench (bench.py) runs on the real chip instead.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session points JAX_PLATFORMS at a real TPU
+# (e.g. "axon"): unit tests must be hermetic and fast; bench.py is the
+# real-chip path. The TPU tunnel's sitecustomize sets the jax_platforms
+# *config* programmatically, which outranks the env var — so set both.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

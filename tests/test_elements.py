@@ -1,0 +1,370 @@
+"""Element-set tests: routing, sync policies, aggregator, control flow,
+repo loops, sparse codec elements, debug.
+
+Technique mirrors the reference (SURVEY.md §4): deterministic synthetic
+buffers through in-process pipelines; fake 'models' are plain callables
+(custom-easy analog) so no XLA is needed for element logic.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.elements import (
+    AppSrc, FakeSink, Join, Tee, TensorAggregator, TensorCrop, TensorDebug,
+    TensorDemux, TensorIf, TensorMerge, TensorMux, TensorRate,
+    TensorRepoSink, TensorRepoSrc, TensorSink, TensorSparseDec,
+    TensorSparseEnc, TensorSplit, register_if_condition)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorInfo, TensorsSpec
+
+
+def spec_of(*shapes, dtype=DType.FLOAT32):
+    return TensorsSpec.of(*(TensorInfo(s, dtype) for s in shapes))
+
+
+def run_graph(elements, links, pushes, timeout=30):
+    """Build/run a pipeline; pushes = {src_name: [buffers]}. Returns the
+    pipeline (sinks hold .results)."""
+    pipe = nns.Pipeline()
+    for e in elements:
+        pipe.add(e)
+    for a, b, *pads in links:
+        pipe.link(a, b, *(pads or []))
+    runner = nns.PipelineRunner(pipe)
+    runner.start()
+    for name, bufs in pushes.items():
+        src = pipe.get(name)
+        for b in bufs:
+            src.push(b)
+        src.end()
+    runner.wait(timeout)
+    return pipe
+
+
+def buf(val, shape=(2, 2), pts=0, dtype=np.float32):
+    return TensorBuffer.of(np.full(shape, val, dtype), pts=pts)
+
+
+# -- mux / sync policies -----------------------------------------------------
+
+def test_mux_nosync_pairs_fifo():
+    a = AppSrc(spec=spec_of((2, 2)), name="a")
+    b = AppSrc(spec=spec_of((3,)), name="b")
+    mux = TensorMux(name="m", sync_mode="nosync")
+    sink = TensorSink(name="s")
+    pipe = run_graph(
+        [a, b, mux, sink],
+        [(a, mux, 0, 0), (b, mux, 0, 1), (mux, sink)],
+        {"a": [buf(1, pts=0), buf(2, pts=50)],
+         "b": [buf(10, (3,), pts=0), buf(20, (3,), pts=60)]},
+    )
+    res = sink.results
+    assert len(res) == 2
+    assert res[0].num_tensors == 2
+    np.testing.assert_array_equal(res[0].tensors[0], np.full((2, 2), 1))
+    np.testing.assert_array_equal(res[1].tensors[1], np.full((3,), 20))
+
+
+def test_mux_slowest_drops_stale_frames():
+    a = AppSrc(spec=spec_of((1,)), name="a")
+    b = AppSrc(spec=spec_of((1,)), name="b")
+    mux = TensorMux(name="m", sync_mode="slowest")
+    sink = TensorSink(name="s")
+    # pad a at 10Hz (0,100,200ms), pad b slow (0, 200ms): frame 100 on a
+    # must be dropped when pairing for base 200. Push a's frames first and
+    # let them drain into the mux before b's arrive, so the stale-frame
+    # decision sees the catch-up queue (deterministic ordering).
+    ns = 1_000_000
+    pipe = nns.Pipeline()
+    for e in (a, b, mux, sink):
+        pipe.add(e)
+    pipe.link(a, mux, 0, 0)
+    pipe.link(b, mux, 0, 1)
+    pipe.link(mux, sink)
+    runner = nns.PipelineRunner(pipe).start()
+    for bb in (buf(0, (1,), pts=0), buf(1, (1,), pts=100 * ns),
+               buf(2, (1,), pts=200 * ns)):
+        a.push(bb)
+    time.sleep(0.2)  # a's frames reach the mux queue first
+    b.push(buf(10, (1,), pts=0))
+    b.push(buf(11, (1,), pts=200 * ns))
+    a.end()
+    b.end()
+    runner.wait(30)
+    res = sink.results
+    assert len(res) == 2
+    np.testing.assert_array_equal(res[0].tensors[0], [0])
+    np.testing.assert_array_equal(res[1].tensors[0], [2])  # 1 dropped
+    np.testing.assert_array_equal(res[1].tensors[1], [11])
+
+
+def test_merge_concat_axis():
+    a = AppSrc(spec=spec_of((2, 3)), name="a")
+    b = AppSrc(spec=spec_of((2, 5)), name="b")
+    merge = TensorMerge(name="m", option="1", sync_mode="nosync")
+    sink = TensorSink(name="s")
+    pipe = run_graph(
+        [a, b, merge, sink],
+        [(a, merge, 0, 0), (b, merge, 0, 1), (merge, sink)],
+        {"a": [buf(1, (2, 3))], "b": [buf(2, (2, 5))]},
+    )
+    assert merge.out_specs[0].tensors[0].shape == (2, 8)
+    out = sink.results[0].tensors[0]
+    assert out.shape == (2, 8)
+    np.testing.assert_array_equal(out[:, :3], np.full((2, 3), 1))
+
+
+def test_demux_tensorpick_reorder():
+    src = AppSrc(spec=spec_of((1,), (2,), (3,)), name="src")
+    demux = TensorDemux(name="d", tensorpick="2,0")
+    s1 = TensorSink(name="s1")
+    s2 = TensorSink(name="s2")
+    three = TensorBuffer.of(np.zeros((1,), np.float32),
+                            np.ones((2,), np.float32),
+                            np.full((3,), 2, np.float32), pts=0)
+    pipe = run_graph(
+        [src, demux, s1, s2],
+        [(src, demux), (demux, s1, 0, 0), (demux, s2, 1, 0)],
+        {"src": [three]},
+    )
+    assert s1.results[0].tensors[0].shape == (3,)
+    assert s2.results[0].tensors[0].shape == (1,)
+
+
+def test_split_segments():
+    src = AppSrc(spec=spec_of((2, 8)), name="src")
+    split = TensorSplit(name="sp", tensorseg="3:5", axis=1)
+    s1 = TensorSink(name="s1")
+    s2 = TensorSink(name="s2")
+    arr = np.arange(16, dtype=np.float32).reshape(2, 8)
+    pipe = run_graph(
+        [src, split, s1, s2],
+        [(src, split), (split, s1, 0, 0), (split, s2, 1, 0)],
+        {"src": [TensorBuffer.of(arr, pts=0)]},
+    )
+    np.testing.assert_array_equal(s1.results[0].tensors[0], arr[:, :3])
+    np.testing.assert_array_equal(s2.results[0].tensors[0], arr[:, 3:])
+
+
+def test_split_then_merge_roundtrip():
+    src = AppSrc(spec=spec_of((4, 6)), name="src")
+    split = TensorSplit(name="sp", tensorseg="2:4", axis=1)
+    merge = TensorMerge(name="mg", option="1", sync_mode="nosync")
+    sink = TensorSink(name="s")
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    pipe = run_graph(
+        [src, split, merge, sink],
+        [(src, split), (split, merge, 0, 0), (split, merge, 1, 1),
+         (merge, sink)],
+        {"src": [TensorBuffer.of(arr, pts=0)]},
+    )
+    np.testing.assert_array_equal(sink.results[0].tensors[0], arr)
+
+
+def test_tee_duplicates_and_join_rejoins():
+    src = AppSrc(spec=spec_of((2,)), name="src")
+    tee = Tee(name="t")
+    j = Join(name="j")
+    sink = TensorSink(name="s")
+    pipe = run_graph(
+        [src, tee, j, sink],
+        [(src, tee), (tee, j, 0, 0), (tee, j, 1, 1), (j, sink)],
+        {"src": [buf(5, (2,))]},
+    )
+    assert len(sink.results) == 2  # both branches delivered
+
+
+# -- aggregator --------------------------------------------------------------
+
+def test_aggregator_tumbling_window():
+    src = AppSrc(spec=spec_of((1, 4)), name="src")
+    agg = TensorAggregator(name="agg", frames_out=3, frames_dim=0)
+    sink = TensorSink(name="s")
+    bufs = [TensorBuffer.of(np.full((1, 4), i, np.float32), pts=i)
+            for i in range(7)]
+    pipe = run_graph([src, agg, sink], [(src, agg), (agg, sink)],
+                     {"src": bufs})
+    assert agg.out_specs[0].tensors[0].shape == (3, 4)
+    res = sink.results
+    assert len(res) == 2  # 7 frames → 2 windows of 3, 1 leftover dropped
+    np.testing.assert_array_equal(res[0].tensors[0][:, 0], [0, 1, 2])
+    np.testing.assert_array_equal(res[1].tensors[0][:, 0], [3, 4, 5])
+
+
+def test_aggregator_sliding_window():
+    src = AppSrc(spec=spec_of((1, 2)), name="src")
+    agg = TensorAggregator(name="agg", frames_out=2, frames_flush=1,
+                           frames_dim=0)
+    sink = TensorSink(name="s")
+    bufs = [TensorBuffer.of(np.full((1, 2), i, np.float32), pts=i)
+            for i in range(4)]
+    pipe = run_graph([src, agg, sink], [(src, agg), (agg, sink)],
+                     {"src": bufs})
+    res = sink.results
+    # windows: [0,1] [1,2] [2,3]
+    assert len(res) == 3
+    np.testing.assert_array_equal(res[1].tensors[0][:, 0], [1, 2])
+
+
+# -- tensor_if ---------------------------------------------------------------
+
+def test_tensor_if_then_else_branching():
+    src = AppSrc(spec=spec_of((2,)), name="src")
+    tif = TensorIf(name="if", compared_value="a_value",
+                   compared_value_option="0:0", operator="gt",
+                   supplied_value=5.0, then="passthrough", else_="passthrough")
+    st = TensorSink(name="st")
+    se = TensorSink(name="se")
+    pipe = run_graph(
+        [src, tif, st, se],
+        [(src, tif), (tif, st, 0, 0), (tif, se, 1, 0)],
+        {"src": [buf(9, (2,), pts=0), buf(1, (2,), pts=1)]},
+    )
+    assert len(st.results) == 1 and len(se.results) == 1
+    np.testing.assert_array_equal(st.results[0].tensors[0], [9, 9])
+    np.testing.assert_array_equal(se.results[0].tensors[0], [1, 1])
+
+
+def test_tensor_if_average_fill_zero():
+    src = AppSrc(spec=spec_of((4,)), name="src")
+    tif = TensorIf(name="if", compared_value="average",
+                   compared_value_option="0", operator="ge",
+                   supplied_value=2.0, then="fill_zero", else_="skip")
+    sink = TensorSink(name="s")
+    pipe = run_graph(
+        [src, tif, sink],
+        [(src, tif), (tif, sink)],
+        {"src": [buf(3, (4,), pts=0), buf(1, (4,), pts=1)]},
+    )
+    assert len(sink.results) == 1  # second skipped
+    np.testing.assert_array_equal(sink.results[0].tensors[0], np.zeros(4))
+
+
+def test_tensor_if_custom_condition():
+    register_if_condition("evens", lambda b: int(b.pts or 0) % 2 == 0)
+    src = AppSrc(spec=spec_of((1,)), name="src")
+    tif = TensorIf(name="if", compared_value="custom",
+                   compared_value_option="evens")
+    sink = TensorSink(name="s")
+    pipe = run_graph(
+        [src, tif, sink], [(src, tif), (tif, sink)],
+        {"src": [buf(i, (1,), pts=i) for i in range(5)]},
+    )
+    assert len(sink.results) == 3  # pts 0,2,4
+
+
+# -- tensor_rate -------------------------------------------------------------
+
+def test_tensor_rate_downsample():
+    ns = 1_000_000_000
+    src = AppSrc(spec=spec_of((1,)), name="src")
+    rate = TensorRate(name="r", framerate="1/1")  # 1 fps
+    sink = TensorSink(name="s")
+    # 4 fps input over 2s
+    bufs = [TensorBuffer.of(np.full((1,), i, np.float32), pts=i * ns // 4)
+            for i in range(8)]
+    pipe = run_graph([src, rate, sink], [(src, rate), (rate, sink)],
+                     {"src": bufs})
+    res = sink.results
+    assert 2 <= len(res) <= 3
+    assert rate.dropped > 0
+    # slot PTS are exact multiples of 1s
+    assert all((b.pts % ns) == 0 for b in res)
+
+
+def test_tensor_rate_upsample_duplicates():
+    ns = 1_000_000_000
+    src = AppSrc(spec=spec_of((1,)), name="src")
+    rate = TensorRate(name="r", framerate="4/1")
+    sink = TensorSink(name="s")
+    bufs = [TensorBuffer.of(np.full((1,), i, np.float32), pts=i * ns)
+            for i in range(2)]  # 1 fps input
+    pipe = run_graph([src, rate, sink], [(src, rate), (rate, sink)],
+                     {"src": bufs})
+    assert len(sink.results) >= 4  # 0..1s at 4fps
+
+# -- tensor_crop -------------------------------------------------------------
+
+def test_tensor_crop_regions():
+    raw_spec = spec_of((8, 8, 3), dtype=DType.UINT8)
+    src = AppSrc(spec=raw_spec, name="raw")
+    info = AppSrc(spec=spec_of((1, 4), dtype=DType.UINT32), name="info")
+    crop = TensorCrop(name="c")
+    sink = TensorSink(name="s")
+    img = np.arange(8 * 8 * 3, dtype=np.uint8).reshape(8, 8, 3)
+    region = np.array([[2, 1, 4, 3]], np.uint32)  # x,y,w,h
+    pipe = run_graph(
+        [src, info, crop, sink],
+        [(src, crop, 0, 0), (info, crop, 0, 1), (crop, sink)],
+        {"raw": [TensorBuffer.of(img, pts=0)],
+         "info": [TensorBuffer.of(region, pts=0)]},
+    )
+    out = sink.results[0]
+    assert out.format == TensorFormat.FLEXIBLE
+    assert out.tensors[0].shape == (3, 4, 3)  # h=3, w=4
+    np.testing.assert_array_equal(out.tensors[0], img[1:4, 2:6])
+
+
+# -- repo loop ---------------------------------------------------------------
+
+def test_repo_feedback_loop_accumulates():
+    """reposrc primes zeros; filter adds input; reposink feeds back.
+    Chain: reposrc → (state) mux with appsrc → custom add → tee →
+    [reposink, sink]. After 3 inputs the state is the running sum."""
+    from nnstreamer_tpu.backends.custom import register_custom_easy
+    from nnstreamer_tpu.elements import REPO, TensorFilter
+
+    REPO.reset()
+    register_custom_easy("add_pair", lambda ts: (ts[0] + ts[1],))
+    state = TensorRepoSrc(name="state", slot=7, dims="4", count=4)
+    xs = AppSrc(spec=spec_of((4,)), name="xs")
+    mux = TensorMux(name="m", sync_mode="nosync")
+    f = TensorFilter(name="f", framework="custom", model="add_pair")
+    tee = Tee(name="t")
+    back = TensorRepoSink(name="back", slot=7)
+    sink = TensorSink(name="s")
+    pipe = run_graph(
+        [state, xs, mux, f, tee, back, sink],
+        [(state, mux, 0, 0), (xs, mux, 0, 1), (mux, f), (f, tee),
+         (tee, back, 0, 0), (tee, sink, 1, 0)],
+        {"xs": [buf(1, (4,), pts=i) for i in range(4)]},
+    )
+    sums = [r.tensors[0][0] for r in sink.results]
+    assert sums == [1, 2, 3, 4]
+
+
+# -- sparse ------------------------------------------------------------------
+
+def test_sparse_enc_dec_roundtrip():
+    src = AppSrc(spec=spec_of((4, 4)), name="src")
+    enc = TensorSparseEnc(name="e")
+    dec = TensorSparseDec(name="d")
+    sink = TensorSink(name="s")
+    arr = np.zeros((4, 4), np.float32)
+    arr[1, 2] = 5.0
+    arr[3, 3] = -1.5
+    pipe = run_graph(
+        [src, enc, dec, sink],
+        [(src, enc), (enc, dec), (dec, sink)],
+        {"src": [TensorBuffer.of(arr, pts=0)]},
+    )
+    np.testing.assert_array_equal(sink.results[0].tensors[0], arr)
+    assert enc.out_specs[0].format == TensorFormat.SPARSE
+
+
+# -- debug -------------------------------------------------------------------
+
+def test_debug_passthrough_captures():
+    src = AppSrc(spec=spec_of((2,)), name="src")
+    dbg = TensorDebug(name="dbg", capture=True, verbose=True)
+    sink = TensorSink(name="s")
+    pipe = run_graph([src, dbg, sink], [(src, dbg), (dbg, sink)],
+                     {"src": [buf(7, (2,))]})
+    assert len(sink.results) == 1
+    assert any("float32[2]" in l for l in dbg.lines)
+    assert any("max=7" in l for l in dbg.lines)

@@ -1,0 +1,142 @@
+"""Model zoo tests (CPU, small shapes — conftest forces JAX_PLATFORMS=cpu).
+
+Mirrors the reference's approach of tiny deterministic models as test
+fixtures (SURVEY.md §4): shapes and determinism are validated here; the
+real-chip perf path is bench.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models import layers as L
+from nnstreamer_tpu.models.zoo import build_model, list_models
+
+
+def test_zoo_lists_flagships():
+    models = list_models()
+    for name in ("mobilenet_v2", "ssd_mobilenet", "posenet"):
+        assert name in models
+
+
+def test_mobilenet_v2_forward_shape_and_determinism():
+    from nnstreamer_tpu.models import mobilenet_v2 as m
+
+    params = m.init_params(seed=0)
+    x = jnp.ones((2, 96, 96, 3), jnp.float32)
+    logits = m.apply(params, x, dtype=jnp.float32)
+    assert logits.shape == (2, 1001)
+    assert logits.dtype == jnp.float32
+    # deterministic init
+    params2 = m.init_params(seed=0)
+    logits2 = m.apply(params2, x, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2))
+    # param count ~3.5M at width 1.0
+    n = L.count_params(params)
+    assert 3_000_000 < n < 4_500_000, n
+
+
+def test_mobilenet_v2_width_multiplier():
+    from nnstreamer_tpu.models import mobilenet_v2 as m
+
+    params = m.init_params(width=0.35)
+    x = jnp.ones((1, 96, 96, 3))
+    logits = m.apply(params, x, width=0.35, dtype=jnp.float32)
+    assert logits.shape == (1, 1001)
+    assert L.count_params(params) < 2_000_000
+
+
+def test_mobilenet_v2_bundle_eval_shape():
+    bundle = build_model("mobilenet_v2?input_size=96&dtype=float32")
+    out = jax.eval_shape(
+        lambda p, x: bundle.fn(p, x),
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), bundle.params),
+        jax.ShapeDtypeStruct((1, 96, 96, 3), jnp.float32),
+    )
+    assert out.shape == (1, 1001)
+
+
+def test_mobilenet_loss_grad():
+    from nnstreamer_tpu.models import mobilenet_v2 as m
+
+    params = m.init_params(width=0.35)
+    x = jnp.ones((2, 64, 64, 3))
+    y = jnp.array([1, 2])
+    loss, grads = jax.value_and_grad(m.loss_fn)(
+        params, x, y, width=0.35, dtype=jnp.float32)
+    assert jnp.isfinite(loss)
+    g = grads["classifier"]["w"]
+    assert float(jnp.abs(g).sum()) > 0.0
+
+
+def test_ssd_anchors_canonical_count():
+    from nnstreamer_tpu.models.ssd_mobilenet import generate_anchors
+
+    anchors = generate_anchors()
+    assert anchors.shape == (1917, 4)
+    assert np.all(anchors[:, 2:] > 0)  # h, w positive
+    assert np.all(anchors[:, :2] >= 0) and np.all(anchors[:, :2] <= 1)
+
+
+def test_ssd_box_decode_roundtrip_identity():
+    from nnstreamer_tpu.models.ssd_mobilenet import decode_boxes, generate_anchors
+
+    anchors = generate_anchors()[:8]
+    # zero deltas decode to the anchors themselves
+    boxes = decode_boxes(np.zeros((8, 4), np.float32), anchors)
+    np.testing.assert_allclose(boxes[:, 2] - boxes[:, 0], anchors[:, 2], atol=1e-6)
+    np.testing.assert_allclose(
+        (boxes[:, 1] + boxes[:, 3]) / 2, anchors[:, 1], atol=1e-6)
+
+
+@pytest.mark.slow
+def test_ssd_mobilenet_forward():
+    from nnstreamer_tpu.models import ssd_mobilenet as s
+
+    params = s.init_params(num_classes=11, width=0.35)
+    x = jnp.ones((1, 300, 300, 3))
+    loc, cls = s.apply(params, x, num_classes=11, width=0.35, dtype=jnp.float32)
+    assert loc.shape == (1, 1917, 4)
+    assert cls.shape == (1, 1917, 11)
+
+
+def test_posenet_forward():
+    from nnstreamer_tpu.models import posenet as p
+
+    params = p.init_params(width=0.35)
+    x = jnp.ones((1, 97, 97, 3))
+    heat, off = p.apply(params, x, width=0.35, dtype=jnp.float32)
+    assert heat.shape[-1] == 17
+    assert off.shape[-1] == 34
+    assert heat.shape[1:3] == off.shape[1:3]
+    assert float(heat.min()) >= 0.0 and float(heat.max()) <= 1.0
+
+
+def test_model_in_pipeline_via_zoo_uri():
+    """End-to-end: appsrc → filter(zoo model) → sink, tiny mobilenet."""
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements import AppSrc, TensorFilter, TensorSink
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+    from nnstreamer_tpu.tensor.dtypes import DType
+
+    spec = TensorsSpec.of(TensorInfo((1, 64, 64, 3), DType.FLOAT32))
+    src = AppSrc(spec=spec, name="src")
+    filt = TensorFilter(
+        name="f", framework="xla",
+        model="zoo://mobilenet_v2?width=0.35&input_size=64&dtype=float32")
+    out = []
+    sink = TensorSink(name="sink", new_data=lambda b: out.append(b))
+    pipe = nns.Pipeline()
+    for e in (src, filt, sink):
+        pipe.add(e)
+    pipe.link(src, filt)
+    pipe.link(filt, sink)
+    runner = nns.PipelineRunner(pipe).start()
+    src.push(TensorBuffer.of(np.zeros((1, 64, 64, 3), np.float32), pts=0))
+    src.end()
+    runner.wait(60)
+    assert len(out) == 1
+    assert out[0].tensors[0].shape == (1, 1001)
