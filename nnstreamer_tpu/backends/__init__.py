@@ -15,11 +15,15 @@ Importing this package registers all built-in backends.
 
 from nnstreamer_tpu.backends.base import FilterBackend
 from nnstreamer_tpu.backends.custom import CustomBackend, register_custom_easy
+from nnstreamer_tpu.backends.pallas_backend import (
+    PallasBackend, register_pallas_filter)
 from nnstreamer_tpu.backends.xla import XLABackend
 
 __all__ = [
     "FilterBackend",
     "CustomBackend",
+    "PallasBackend",
     "XLABackend",
     "register_custom_easy",
+    "register_pallas_filter",
 ]

@@ -1,0 +1,138 @@
+"""Pallas filter backend — hand-written TPU kernels as tensor_filter
+models (``framework=pallas model=<registered kernel>``).
+
+The reference's closest analog is the custom-easy subplugin (in-process
+function registration, include/tensor_filter_custom_easy.h) — here the
+registered function is a jax-traceable kernel (usually a `pl.pallas_call`
+wrapper from pallas_ops.py), jit-compiled with any fused pre/post chains
+into one device program.
+
+Registration:
+
+    @register_pallas_filter("my_norm", out_like=lambda spec: spec)
+    def my_norm(tensors):
+        return (pallas_ops.normalize_u8(tensors[0]),)
+
+`out_like` maps the input TensorsSpec to the output spec; omit it to have
+the backend infer shapes with jax.eval_shape.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from nnstreamer_tpu.backends.base import (
+    ArrayTuple, ElementwiseFn, FilterBackend, register_backend)
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+
+@dataclass
+class _PallasEntry:
+    fn: Callable[[ArrayTuple], ArrayTuple]
+    out_like: Optional[Callable[[TensorsSpec], TensorsSpec]] = None
+
+
+_kernels: Dict[str, _PallasEntry] = {}
+_lock = threading.Lock()
+
+
+def register_pallas_filter(name: str, out_like=None):
+    """Decorator registering kernel `fn(tensors)->tensors` as a filter."""
+    def deco(fn):
+        with _lock:
+            _kernels[name] = _PallasEntry(fn=fn, out_like=out_like)
+        return fn
+    return deco
+
+
+def _builtins() -> None:
+    """Register the stock pallas_ops kernels lazily."""
+    from nnstreamer_tpu.backends import pallas_ops
+
+    with _lock:
+        if "normalize_u8" in _kernels:
+            return
+
+    def norm_spec(spec: TensorsSpec) -> TensorsSpec:
+        return TensorsSpec(tensors=tuple(
+            TensorInfo(t.shape, DType.FLOAT32) for t in spec.tensors),
+            rate=spec.rate)
+
+    register_pallas_filter("normalize_u8", out_like=norm_spec)(
+        lambda ts: tuple(pallas_ops.normalize_u8(t) for t in ts))
+
+
+@register_backend("pallas")
+class PallasBackend(FilterBackend):
+    def __init__(self):
+        self._entry: Optional[_PallasEntry] = None
+        self._name = ""
+        self._pre: Optional[ElementwiseFn] = None
+        self._post: Optional[ElementwiseFn] = None
+        self._jitted = None
+        self._in_spec: Optional[TensorsSpec] = None
+
+    def open(self, props: Dict[str, Any]) -> None:
+        _builtins()
+        model = props.get("model")
+        if callable(model):
+            self._entry = _PallasEntry(fn=model)
+            self._name = getattr(model, "__name__", "callable")
+            return
+        with _lock:
+            entry = _kernels.get(model)
+        if entry is None:
+            raise BackendError(
+                f"no pallas filter named {model!r} registered; available: "
+                f"{sorted(_kernels)} (register with "
+                f"@register_pallas_filter)")
+        self._entry = entry
+        self._name = str(model)
+
+    def get_model_info(self):
+        return None, None  # adapts to the negotiated input
+
+    def set_input_info(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self._in_spec = in_spec
+        if self._entry.out_like is not None:
+            return self._entry.out_like(in_spec)
+        args = tuple(
+            jax.ShapeDtypeStruct(t.shape, t.dtype.np_dtype)
+            for t in in_spec.tensors)
+        try:
+            out = jax.eval_shape(lambda ts: self._entry.fn(ts), args)
+        except Exception as e:
+            raise BackendError(
+                f"pallas filter {self._name!r} rejected input {in_spec}: {e}"
+            ) from e
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return TensorsSpec(tensors=tuple(
+            TensorInfo(tuple(o.shape), DType.from_np(o.dtype)) for o in outs),
+            rate=in_spec.rate)
+
+    def fuse(self, pre, post) -> bool:
+        self._pre, self._post = pre, post
+        self._jitted = None
+        return True
+
+    def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
+        if self._jitted is None:
+            entry, pre, post = self._entry, self._pre, self._post
+
+            def full(ts):
+                if pre is not None:
+                    ts = pre(ts)
+                out = entry.fn(tuple(ts))
+                out = out if isinstance(out, (tuple, list)) else (out,)
+                if post is not None:
+                    out = post(tuple(out))
+                return tuple(out)
+
+            self._jitted = jax.jit(full)
+        return tuple(self._jitted(tuple(tensors)))

@@ -3,6 +3,13 @@
 Importing this package registers all built-in decoders.
 """
 
-from nnstreamer_tpu.decoders import label  # noqa: F401
+from nnstreamer_tpu.decoders import (  # noqa: F401
+    boundingbox,
+    direct_video,
+    label,
+    octet,
+    pose,
+    segment,
+)
 
-__all__ = ["label"]
+__all__ = ["boundingbox", "direct_video", "label", "octet", "pose", "segment"]

@@ -8,7 +8,6 @@ Output buffer: meta["label"], meta["label_index"], meta["score"], payload
 
 from __future__ import annotations
 
-from pathlib import Path
 from typing import List, Optional
 
 import numpy as np
@@ -26,17 +25,9 @@ class ImageLabeling(DecoderSubplugin):
         self.labels: List[str] = []
 
     def init(self, props: dict) -> None:
-        path = props.get("option1", "")
-        if path:
-            p = Path(path)
-            if not p.is_file():
-                raise PipelineError(
-                    f"image_labeling: labels file {path!r} not found "
-                    f"(option1 must point at a one-label-per-line text file)"
-                )
-            self.labels = [
-                line.strip() for line in p.read_text().splitlines() if line.strip()
-            ]
+        from nnstreamer_tpu.decoders.util import load_labels
+
+        self.labels = load_labels(props.get("option1", ""), "image_labeling")
 
     def negotiate(self, in_spec: TensorsSpec) -> TextSpec:
         if in_spec.num_tensors != 1:

@@ -1,0 +1,32 @@
+"""Among-device AI: remote offload, pub/sub, wire transport.
+
+Reference parity (SURVEY.md §2.5, §5.8): the `tensor_query_*` elements
+(sync RPC offload with per-client routing meta), `edgesink`/`edgesrc`
+(pub/sub), and the nnstreamer-edge TCP transport with its caps handshake.
+The MQTT/gRPC/AITT transport zoo collapses into one TCP protocol
+(edge/protocol.py) + the in-process mesh dispatcher (parallel/dispatch.py)
+for on-pod scale-out — parity transport off-pod, ICI collectives on-pod.
+
+Modules:
+- wire.py     — TensorBuffer ↔ wire frame codec (MetaHeader per tensor)
+- protocol.py — length-prefixed TCP message transport (client/server)
+- query.py    — tensor_query_client / serversrc / serversink elements
+- pubsub.py   — edgesink (publisher) / edgesrc (subscriber) elements
+"""
+
+from nnstreamer_tpu.edge.query import (
+    QueryServer, TensorQueryClient, TensorQueryServerSink,
+    TensorQueryServerSrc)
+from nnstreamer_tpu.edge.pubsub import EdgeSink, EdgeSrc
+from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
+
+__all__ = [
+    "EdgeSink",
+    "EdgeSrc",
+    "QueryServer",
+    "TensorQueryClient",
+    "TensorQueryServerSink",
+    "TensorQueryServerSrc",
+    "decode_buffer",
+    "encode_buffer",
+]

@@ -1,0 +1,266 @@
+"""Length-prefixed TCP message transport.
+
+Reference parity: the nnstreamer-edge connection layer (SURVEY.md §5.8) —
+connection handle + event callback, caps-compat handshake at connect,
+clean reconnect/close semantics. One transport (TCP) replaces the
+reference's TCP/HYBRID/AITT/MQTT zoo; the message framing is:
+
+  u32 type | u32 length | length bytes payload
+
+Types: HELLO (caps string), HELLO_ACK (caps string or error), DATA
+(wire frame, edge/wire.py), RESULT (wire frame), BYE, PING/PONG.
+
+Threading model: a `MsgServer` runs an accept loop + one reader thread
+per connection, dispatching to a callback; `MsgClient` owns one socket
+with a reader thread. All sends are serialized per connection (lock) so
+frames never interleave.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.core.log import get_logger
+
+log = get_logger("edge.protocol")
+
+_FRAME = struct.Struct("<II")
+
+T_HELLO = 1
+T_HELLO_ACK = 2
+T_HELLO_NAK = 3
+T_DATA = 4
+T_RESULT = 5
+T_BYE = 6
+T_PING = 7
+T_PONG = 8
+
+#: hard cap on a single message (matches wire.MAX_FRAME_BYTES intent)
+MAX_MSG = 1 << 31
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        try:
+            b = sock.recv(min(n, 1 << 20))
+        except OSError:
+            return None
+        if not b:
+            return None
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def read_msg(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
+    head = _recv_exact(sock, _FRAME.size)
+    if head is None:
+        return None
+    mtype, length = _FRAME.unpack(head)
+    if length > MAX_MSG:
+        raise StreamError(f"edge message of {length} bytes exceeds limit")
+    payload = _recv_exact(sock, length) if length else b""
+    if payload is None:
+        return None
+    return mtype, payload
+
+
+def write_msg(sock: socket.socket, mtype: int, payload: bytes = b"",
+              lock: Optional[threading.Lock] = None) -> None:
+    data = _FRAME.pack(mtype, len(payload)) + payload
+    if lock:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+class Connection:
+    """One accepted server-side connection."""
+
+    _next_id = 1
+    _id_lock = threading.Lock()
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = sock
+        self.addr = addr
+        self.send_lock = threading.Lock()
+        with Connection._id_lock:
+            self.client_id = Connection._next_id
+            Connection._next_id += 1
+
+    def send(self, mtype: int, payload: bytes = b"") -> None:
+        write_msg(self.sock, mtype, payload, self.send_lock)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class MsgServer:
+    """Accept loop + per-connection reader threads.
+
+    on_message(conn, mtype, payload); on_connect(conn) -> bool (False
+    rejects); on_disconnect(conn).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 on_message: Callable,
+                 on_connect: Optional[Callable] = None,
+                 on_disconnect: Optional[Callable] = None):
+        self._on_message = on_message
+        self._on_connect = on_connect
+        self._on_disconnect = on_disconnect
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self.host = host
+        self._conns: Dict[int, Connection] = {}
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"edge-accept:{self.port}",
+            daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                sock, addr = self._sock.accept()
+            except OSError:
+                return
+            conn = Connection(sock, addr)
+            if self._on_connect is not None and not self._on_connect(conn):
+                conn.close()
+                continue
+            with self._lock:
+                self._conns[conn.client_id] = conn
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             name=f"edge-read:{conn.client_id}",
+                             daemon=True).start()
+
+    def _read_loop(self, conn: Connection) -> None:
+        try:
+            while not self._stopping.is_set():
+                msg = read_msg(conn.sock)
+                if msg is None or msg[0] == T_BYE:
+                    break
+                if msg[0] == T_PING:
+                    conn.send(T_PONG)
+                    continue
+                self._on_message(conn, msg[0], msg[1])
+        except StreamError as e:
+            log.error("connection %d protocol error: %s", conn.client_id, e)
+        finally:
+            with self._lock:
+                self._conns.pop(conn.client_id, None)
+            if self._on_disconnect is not None:
+                self._on_disconnect(conn)
+            conn.close()
+
+    def connection(self, client_id: int) -> Optional[Connection]:
+        with self._lock:
+            return self._conns.get(client_id)
+
+    def connections(self):
+        with self._lock:
+            return list(self._conns.values())
+
+    def close(self) -> None:
+        self._stopping.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in self.connections():
+            c.close()
+
+
+class MsgClient:
+    """Client connection with a reader thread + reconnect.
+
+    on_message(mtype, payload) runs on the reader thread.
+    """
+
+    def __init__(self, host: str, port: int, *, on_message: Callable,
+                 on_close: Optional[Callable] = None,
+                 connect_timeout: float = 10.0, retries: int = 3):
+        self.host, self.port = host, port
+        self._on_message = on_message
+        self._on_close = on_close
+        self.send_lock = threading.Lock()
+        self._stopping = threading.Event()
+        self.sock: Optional[socket.socket] = None
+        last = None
+        for attempt in range(retries):
+            try:
+                self.sock = socket.create_connection(
+                    (host, port), timeout=connect_timeout)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.2 * (attempt + 1))
+        if self.sock is None:
+            raise StreamError(
+                f"cannot connect to edge peer {host}:{port} after "
+                f"{retries} attempts: {last}")
+        self.sock.settimeout(None)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name=f"edge-client:{port}",
+                                        daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._stopping.is_set():
+                msg = read_msg(self.sock)
+                if msg is None or msg[0] == T_BYE:
+                    break
+                if msg[0] == T_PING:
+                    self.send(T_PONG)
+                    continue
+                self._on_message(msg[0], msg[1])
+        except StreamError as e:
+            log.error("client protocol error: %s", e)
+        finally:
+            self._stopping.set()
+            if self._on_close is not None:
+                self._on_close()
+
+    @property
+    def alive(self) -> bool:
+        return not self._stopping.is_set()
+
+    def send(self, mtype: int, payload: bytes = b"") -> None:
+        if self._stopping.is_set():
+            raise StreamError(
+                f"edge connection to {self.host}:{self.port} is closed")
+        try:
+            write_msg(self.sock, mtype, payload, self.send_lock)
+        except OSError as e:
+            self._stopping.set()
+            raise StreamError(
+                f"edge send to {self.host}:{self.port} failed: {e}") from e
+
+    def close(self) -> None:
+        if not self._stopping.is_set():
+            try:
+                self.send(T_BYE)
+            except StreamError:
+                pass
+        self._stopping.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass

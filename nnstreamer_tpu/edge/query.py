@@ -1,0 +1,293 @@
+"""tensor_query elements — remote inference offload (request/reply).
+
+Reference parity (SURVEY.md §3.4): `tensor_query_client` wraps each frame,
+sends it to a server pipeline, and blocks on an async queue for the
+result; `tensor_query_serversrc`/`serversink` bracket the server pipeline
+and share per-id state, routing answers back by the client_id that rides
+the buffer meta (GstMetaQuery analog). Caps compatibility is verified at
+connect (HELLO/ACK handshake carrying spec strings).
+
+TPU-first: the server pipeline typically ends in one XLA-fused filter, so
+offload cost is wire + one H2D/D2H per frame; for on-pod scale-out use
+parallel/dispatch.py instead (no wire at all). This module is the
+off-pod parity transport.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import threading
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from nnstreamer_tpu.core.errors import PipelineError, StreamError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.edge import protocol as P
+from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
+from nnstreamer_tpu.graph.pipeline import (
+    Element, Emission, PropDef, SinkElement, SourceElement, StreamSpec)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+log = get_logger("edge.query")
+
+
+class QueryServer:
+    """Shared state of one query server id: the transport + the in/out
+    specs + the frame queue serversrc drains (GstTensorQueryServer
+    analog, tensor_query_server.c)."""
+
+    _by_id: Dict[int, "QueryServer"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, sid: int):
+        self.sid = sid
+        self.server: Optional[P.MsgServer] = None
+        self.in_spec: Optional[TensorsSpec] = None
+        self.out_spec: Optional[TensorsSpec] = None
+        self.frames: _queue.Queue = _queue.Queue(maxsize=64)
+        self.started = threading.Event()
+
+    @classmethod
+    def get(cls, sid: int) -> "QueryServer":
+        with cls._lock:
+            if sid not in cls._by_id:
+                cls._by_id[sid] = cls(sid)
+            return cls._by_id[sid]
+
+    @classmethod
+    def reset_all(cls) -> None:
+        with cls._lock:
+            for s in cls._by_id.values():
+                if s.server is not None:
+                    s.server.close()
+            cls._by_id.clear()
+
+    # -- transport lifecycle (owned by serversrc) --------------------------
+    def start(self, host: str, port: int) -> None:
+        if self.server is not None:
+            return
+        self.server = P.MsgServer(
+            host, port,
+            on_message=self._on_message,
+            on_connect=self._on_connect,
+        )
+        self.started.set()
+
+    def _on_connect(self, conn: P.Connection) -> bool:
+        return True  # handshake happens via HELLO message
+
+    def _on_message(self, conn: P.Connection, mtype: int, payload: bytes):
+        if mtype == P.T_HELLO:
+            try:
+                want = json.loads(payload.decode())
+                client_in = TensorsSpec.from_strings(
+                    want["dims"], want["types"])
+            except (ValueError, KeyError) as e:
+                conn.send(P.T_HELLO_NAK, f"bad hello: {e}".encode())
+                return
+            if self.in_spec is not None and \
+                    not self.in_spec.is_compatible(client_in):
+                conn.send(P.T_HELLO_NAK, (
+                    f"incompatible caps: server expects "
+                    f"{self.in_spec.to_strings()[:2]}, client sends "
+                    f"{want['dims']},{want['types']}").encode())
+                return
+            dims, types, _ = (self.out_spec.to_strings()
+                              if self.out_spec else ("", "", ""))
+            conn.send(P.T_HELLO_ACK,
+                      json.dumps({"dims": dims, "types": types}).encode())
+        elif mtype == P.T_DATA:
+            try:
+                buf, _ = decode_buffer(payload)
+            except ValueError as e:
+                log.error("server %d: dropping corrupt frame: %s",
+                          self.sid, e)
+                return
+            buf = buf.with_meta(client_id=conn.client_id)
+            try:
+                self.frames.put(buf, timeout=5)
+            except _queue.Full:
+                log.warning("server %d: frame queue full, dropping "
+                            "(client %d)", self.sid, conn.client_id)
+
+    def reply(self, client_id: int, buf: TensorBuffer) -> None:
+        conn = self.server.connection(client_id) if self.server else None
+        if conn is None:
+            log.warning("server %d: client %d gone, dropping result",
+                        self.sid, client_id)
+            return
+        try:
+            conn.send(P.T_RESULT, encode_buffer(buf, client_id))
+        except OSError as e:
+            log.warning("server %d: reply to %d failed: %s",
+                        self.sid, client_id, e)
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+        with QueryServer._lock:
+            QueryServer._by_id.pop(self.sid, None)
+
+
+@register_element("tensor_query_serversrc")
+class TensorQueryServerSrc(SourceElement):
+    """Server entry pad: emits frames received from clients.
+
+    dims/types declare the accepted input (HELLO compat check). port=0
+    picks a free port (read it from `.port` — loopback tests do this).
+    """
+
+    ELEMENT_NAME = "tensor_query_serversrc"
+    PROPS = {
+        "host": PropDef(str, "127.0.0.1"),
+        "port": PropDef(int, 0),
+        "id": PropDef(int, 0, "server pair id"),
+        "dims": PropDef(str, None, "accepted input dims"),
+        "types": PropDef(str, "float32"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._srv: Optional[QueryServer] = None
+        self._stop = threading.Event()
+
+    def output_spec(self) -> StreamSpec:
+        if not self.props["dims"]:
+            raise PipelineError(
+                f"tensor_query_serversrc {self.name}: dims= is required "
+                f"(declares the accepted client input)")
+        return TensorsSpec.from_strings(self.props["dims"],
+                                        self.props["types"])
+
+    def start(self) -> None:
+        self._srv = QueryServer.get(self.props["id"])
+        self._srv.in_spec = self.out_specs[0]
+        self._srv.start(self.props["host"], self.props["port"])
+
+    @property
+    def port(self) -> int:
+        assert self._srv is not None and self._srv.server is not None
+        return self._srv.server.port
+
+    def interrupt(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.frames.put_nowait(None)
+            except _queue.Full:
+                pass
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.stop()
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        while not self._stop.is_set():
+            item = self._srv.frames.get()
+            if item is None:
+                return
+            yield item
+
+
+@register_element("tensor_query_serversink")
+class TensorQueryServerSink(SinkElement):
+    """Server exit pad: routes each result back to its client by the
+    client_id riding buffer meta."""
+
+    ELEMENT_NAME = "tensor_query_serversink"
+    PROPS = {
+        "id": PropDef(int, 0, "server pair id"),
+    }
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        srv = QueryServer.get(self.props["id"])
+        spec = in_specs[0]
+        if isinstance(spec, TensorsSpec):
+            srv.out_spec = spec
+        return []
+
+    def render(self, buf: TensorBuffer) -> None:
+        client_id = buf.meta.get("client_id")
+        if client_id is None:
+            raise StreamError(
+                f"tensor_query_serversink {self.name}: buffer has no "
+                f"client_id meta — it must originate from "
+                f"tensor_query_serversrc (same id) for reply routing")
+        QueryServer.get(self.props["id"]).reply(int(client_id), buf)
+
+
+@register_element("tensor_query_client")
+class TensorQueryClient(Element):
+    """Sync RPC offload: push frame to server, block (with timeout) for
+    the result, emit it downstream (tensor_query_client.c:657-699)."""
+
+    ELEMENT_NAME = "tensor_query_client"
+    PROPS = {
+        "host": PropDef(str, "127.0.0.1"),
+        "port": PropDef(int, None, "server port (required)"),
+        "timeout": PropDef(float, 10.0, "per-frame reply timeout, s"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._client: Optional[P.MsgClient] = None
+        self._replies: _queue.Queue = _queue.Queue()
+        self._hello: _queue.Queue = _queue.Queue()
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        spec = self.expect_tensors(in_specs[0])
+        if not self.props["port"]:
+            self.fail_negotiation("port= of the query server is required")
+        try:
+            self._client = P.MsgClient(
+                self.props["host"], int(self.props["port"]),
+                on_message=self._on_message)
+        except StreamError as e:
+            self.fail_negotiation(str(e))
+        dims, types, _ = spec.to_strings()
+        self._client.send(P.T_HELLO,
+                          json.dumps({"dims": dims, "types": types}).encode())
+        try:
+            kind, payload = self._hello.get(timeout=self.props["timeout"])
+        except _queue.Empty:
+            self.fail_negotiation(
+                f"query server {self.props['host']}:{self.props['port']} "
+                f"did not answer the caps handshake within "
+                f"{self.props['timeout']}s")
+        if kind == P.T_HELLO_NAK:
+            self.fail_negotiation(
+                f"query server rejected our caps: {payload.decode()}")
+        reply = json.loads(payload.decode())
+        if not reply.get("dims"):
+            self.fail_negotiation(
+                "query server did not declare an output spec; start the "
+                "server pipeline (serversrc+serversink) first")
+        return [TensorsSpec.from_strings(reply["dims"], reply["types"],
+                                         rate=spec.rate)]
+
+    def _on_message(self, mtype: int, payload: bytes) -> None:
+        if mtype in (P.T_HELLO_ACK, P.T_HELLO_NAK):
+            self._hello.put((mtype, payload))
+        elif mtype == P.T_RESULT:
+            self._replies.put(payload)
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        self._client.send(P.T_DATA, encode_buffer(buf))
+        try:
+            payload = self._replies.get(timeout=self.props["timeout"])
+        except _queue.Empty:
+            raise StreamError(
+                f"tensor_query_client {self.name}: no reply for frame "
+                f"pts={buf.pts} within {self.props['timeout']}s "
+                f"(server overloaded or connection lost)") from None
+        out, _ = decode_buffer(payload)
+        out.meta.pop("client_id", None)
+        return [(0, out.with_tensors(out.tensors, pts=buf.pts))]
+
+    def stop(self) -> None:
+        if self._client is not None:
+            self._client.close()

@@ -1,0 +1,95 @@
+"""TensorBuffer ↔ wire-frame codec.
+
+Frame layout (little-endian):
+
+  u32 magic 'TPUF'   u32 num_tensors   s64 pts (ns, -1 = none)
+  u64 client_id      u32 meta_len      meta_len bytes of JSON meta
+  per tensor: MetaHeader (tensor/meta.py) + raw payload bytes
+
+The per-tensor MetaHeader is the same self-describing header flexible
+streams use in-process (GstTensorMetaInfo analog), so any stream —
+static, flexible, or sparse-encoded — serializes without negotiation
+context; the receiver reconstructs shapes/dtypes from the wire alone
+(the property the reference's query/edge elements get from caps strings
+in their connect handshake plus per-memory headers).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import MAX_TENSORS_PER_FRAME, TensorFormat
+from nnstreamer_tpu.tensor.meta import MetaHeader
+
+FRAME_MAGIC = 0x54505546  # 'TPUF'
+_HEAD = struct.Struct("<IIqQI")  # magic, num, pts, client_id, meta_len
+
+#: refuse to allocate absurd frames from hostile/corrupt headers
+MAX_FRAME_BYTES = 1 << 31
+
+
+def encode_buffer(buf: TensorBuffer, client_id: int = 0) -> bytes:
+    """Serialize a (host) TensorBuffer. Device buffers are synced here —
+    the transport boundary is by definition a D2H point."""
+    host = buf.to_host()
+    metable = {k: v for k, v in host.meta.items()
+               if isinstance(v, (str, int, float, bool))}
+    meta_bytes = json.dumps(metable).encode() if metable else b""
+    parts = [
+        _HEAD.pack(FRAME_MAGIC, host.num_tensors,
+                   -1 if host.pts is None else host.pts,
+                   client_id, len(meta_bytes)),
+        meta_bytes,
+    ]
+    for t in host.tensors:
+        a = np.ascontiguousarray(t)
+        hdr = MetaHeader(shape=tuple(a.shape) or (1,),
+                         dtype=DType.from_np(a.dtype),
+                         format=host.format)
+        parts.append(hdr.pack())
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def decode_buffer(data: bytes) -> Tuple[TensorBuffer, int]:
+    """→ (buffer, client_id). Raises ValueError on corrupt frames."""
+    if len(data) < _HEAD.size:
+        raise ValueError(f"wire frame too small: {len(data)} bytes")
+    magic, num, pts, client_id, meta_len = _HEAD.unpack_from(data, 0)
+    if magic != FRAME_MAGIC:
+        raise ValueError(
+            f"bad wire frame magic 0x{magic:08x}; peer speaks a different "
+            f"protocol (expected 0x{FRAME_MAGIC:08x})")
+    if num > MAX_TENSORS_PER_FRAME:
+        raise ValueError(f"corrupt frame: {num} tensors > limit")
+    off = _HEAD.size
+    meta = {}
+    if meta_len:
+        if meta_len > len(data) - off:
+            raise ValueError("corrupt frame: meta overruns payload")
+        meta = json.loads(data[off:off + meta_len])
+        off += meta_len
+    tensors = []
+    fmt = TensorFormat.STATIC
+    for _ in range(num):
+        hdr, used = MetaHeader.unpack(data[off:])
+        off += used
+        n_bytes = int(np.prod(hdr.shape)) * hdr.dtype.itemsize
+        if n_bytes > MAX_FRAME_BYTES or n_bytes > len(data) - off:
+            raise ValueError(
+                f"corrupt frame: tensor payload {n_bytes}B overruns frame")
+        a = np.frombuffer(data[off:off + n_bytes],
+                          hdr.dtype.np_dtype).reshape(hdr.shape).copy()
+        off += n_bytes
+        tensors.append(a)
+        fmt = hdr.format
+    return (TensorBuffer(tensors=tuple(tensors),
+                         pts=None if pts < 0 else pts,
+                         format=fmt, meta=meta),
+            client_id)

@@ -1,0 +1,226 @@
+"""Decoder-family tests with synthetic tensors (SURVEY.md §4: goldens are
+synthetic rasters, no real models needed)."""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.elements import AppSrc, TensorDecoder, TensorSink
+from nnstreamer_tpu.graph.media import OctetSpec, VideoSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+
+def decode_one(dec_props, spec, buffers):
+    src = AppSrc(spec=spec, name="src")
+    dec = TensorDecoder(name="dec", **dec_props)
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (src, dec, sink):
+        pipe.add(e)
+    pipe.link(src, dec)
+    pipe.link(dec, sink)
+    runner = nns.PipelineRunner(pipe).start()
+    for b in buffers:
+        src.push(b)
+    src.end()
+    runner.wait(30)
+    return dec, sink.results
+
+
+# -- direct_video ------------------------------------------------------------
+
+def test_direct_video_rgb():
+    spec = TensorsSpec.of(TensorInfo((1, 6, 8, 3), DType.UINT8))
+    img = np.arange(6 * 8 * 3, dtype=np.uint8).reshape(1, 6, 8, 3)
+    dec, res = decode_one({"mode": "direct_video"}, spec,
+                          [TensorBuffer.of(img, pts=0)])
+    out_spec = dec.out_specs[0]
+    assert isinstance(out_spec, VideoSpec)
+    assert (out_spec.width, out_spec.height, out_spec.format) == (8, 6, "RGB")
+    np.testing.assert_array_equal(res[0].tensors[0], img[0])
+
+
+def test_direct_video_rejects_float():
+    spec = TensorsSpec.of(TensorInfo((4, 4, 3), DType.FLOAT32))
+    with pytest.raises(Exception, match="uint8"):
+        decode_one({"mode": "direct_video"}, spec,
+                   [TensorBuffer.of(np.zeros((4, 4, 3), np.float32))])
+
+
+# -- image_labeling (existing decoder, regression) ---------------------------
+
+def test_image_labeling_argmax(tmp_path):
+    labels = tmp_path / "labels.txt"
+    labels.write_text("cat\ndog\nbird\n")
+    spec = TensorsSpec.of(TensorInfo((3,), DType.FLOAT32))
+    scores = np.array([0.1, 0.9, 0.2], np.float32)
+    dec, res = decode_one({"mode": "image_labeling", "option1": str(labels)},
+                          spec, [TensorBuffer.of(scores, pts=0)])
+    assert res[0].meta["label"] == "dog"
+    assert bytes(res[0].tensors[0]).decode() == "dog"
+
+
+# -- bounding boxes ----------------------------------------------------------
+
+def test_bbox_postprocess_scheme_draws_and_reports():
+    # 2 boxes normalized [ymin,xmin,ymax,xmax] + per-class scores
+    boxes = np.array([[0.1, 0.1, 0.5, 0.5],
+                      [0.6, 0.6, 0.9, 0.9]], np.float32)
+    scores = np.array([[0.1, 0.95], [0.8, 0.1]], np.float32)
+    spec = TensorsSpec.of(TensorInfo((2, 4), DType.FLOAT32),
+                          TensorInfo((2, 2), DType.FLOAT32))
+    dec, res = decode_one(
+        {"mode": "bounding_boxes", "option1": "mobilenet-ssd-postprocess",
+         "option3": "0.5:0.5", "option4": "100:100"},
+        spec,
+        [TensorBuffer.of(boxes, scores, pts=0)])
+    out = res[0]
+    img = out.tensors[0]
+    assert img.shape == (100, 100, 4)
+    det = out.meta["boxes"]
+    assert det.shape[0] == 2
+    # box edges drawn: border pixel non-transparent
+    y0, x0 = int(det[0][0]), int(det[0][1])
+    assert img[y0, x0, 3] == 255
+    # pixels well outside any box remain transparent
+    assert img[99, 0, 3] == 0
+
+
+def test_bbox_nms_suppresses_overlaps():
+    from nnstreamer_tpu.decoders.boundingbox import nms
+
+    boxes = np.array([[0, 0, 1, 1], [0.05, 0.05, 1.0, 1.0],
+                      [0.5, 0.5, 0.6, 0.6]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = nms(boxes, scores, iou_thresh=0.5)
+    assert list(keep) == [0, 2]
+
+
+def test_bbox_mobilenet_ssd_with_anchors():
+    from nnstreamer_tpu.models.ssd_mobilenet import generate_anchors
+
+    anchors = generate_anchors()
+    n = anchors.shape[0]
+    loc = np.zeros((1, n, 4), np.float32)       # boxes = anchors
+    logits = np.full((1, n, 3), -10.0, np.float32)
+    logits[0, 100, 1] = 10.0                    # one confident class-1 hit
+    spec = TensorsSpec.of(TensorInfo((1, n, 4), DType.FLOAT32),
+                          TensorInfo((1, n, 3), DType.FLOAT32))
+    dec, res = decode_one(
+        {"mode": "bounding_boxes", "option1": "mobilenet-ssd",
+         "option3": "0.5:0.5", "option4": "300:300"},
+        spec, [TensorBuffer.of(loc, logits, pts=0)])
+    det = res[0].meta["boxes"]
+    assert det.shape[0] == 1
+    assert int(det[0][5]) == 1  # class id
+
+
+def test_bbox_yolov5_scheme():
+    # one prediction row: cx,cy,w,h (normalized), obj, 2 class probs
+    pred = np.zeros((1, 2, 7), np.float32)
+    pred[0, 0] = [0.5, 0.5, 0.2, 0.2, 0.9, 0.1, 0.8]
+    pred[0, 1] = [0.2, 0.2, 0.1, 0.1, 0.05, 0.9, 0.1]  # low obj → dropped
+    spec = TensorsSpec.of(TensorInfo((1, 2, 7), DType.FLOAT32))
+    dec, res = decode_one(
+        {"mode": "bounding_boxes", "option1": "yolov5",
+         "option3": "0.5:0.5", "option4": "100:100"},
+        spec, [TensorBuffer.of(pred, pts=0)])
+    det = res[0].meta["boxes"]
+    assert det.shape[0] == 1
+    assert int(det[0][5]) == 1
+
+
+# -- pose --------------------------------------------------------------------
+
+def test_pose_decoder_keypoints():
+    k = 17
+    hm = np.zeros((1, 10, 10, k), np.float32)
+    for i in range(k):
+        hm[0, i % 10, (i * 2) % 10, i] = 1.0
+    spec = TensorsSpec.of(TensorInfo((1, 10, 10, k), DType.FLOAT32))
+    dec, res = decode_one(
+        {"mode": "pose_estimation", "option1": "100:100", "option4": "0.5"},
+        spec, [TensorBuffer.of(hm, pts=0)])
+    kps = res[0].meta["keypoints"]
+    assert kps.shape == (k, 3)
+    # keypoint 3 is at grid (3, 6) → center pixel ((6+.5)/10*100, (3+.5)/10*100)
+    np.testing.assert_allclose(kps[3, :2], [65.0, 35.0], atol=1e-4)
+    img = res[0].tensors[0]
+    assert img.shape == (100, 100, 4)
+    assert (img[:, :, 3] > 0).sum() > 0  # something drawn
+
+
+def test_pose_decoder_with_offsets():
+    k = 2
+    hm = np.zeros((1, 4, 4, k), np.float32)
+    hm[0, 1, 1, 0] = 1.0
+    hm[0, 2, 3, 1] = 1.0
+    off = np.zeros((1, 4, 4, 2 * k), np.float32)
+    off[0, 1, 1, 0] = 0.5   # y-offset half a cell
+    spec = TensorsSpec.of(TensorInfo((1, 4, 4, k), DType.FLOAT32),
+                          TensorInfo((1, 4, 4, 2 * k), DType.FLOAT32))
+    dec, res = decode_one(
+        {"mode": "pose_estimation", "option1": "80:80"},
+        spec, [TensorBuffer.of(hm, off, pts=0)])
+    kps = res[0].meta["keypoints"]
+    # base y = (1+0.5)/4*80 = 30, +0.5 cell (=1/4 grid *80 /4... offset*stride)
+    assert kps[0, 1] > 30.0
+
+
+# -- segmentation ------------------------------------------------------------
+
+def test_segment_tflite_deeplab_argmax():
+    scores = np.zeros((1, 4, 4, 3), np.float32)
+    scores[0, :2, :, 1] = 1.0   # top half class 1
+    scores[0, 2:, :, 2] = 1.0   # bottom half class 2
+    spec = TensorsSpec.of(TensorInfo((1, 4, 4, 3), DType.FLOAT32))
+    dec, res = decode_one(
+        {"mode": "image_segment", "option1": "tflite-deeplab"},
+        spec, [TensorBuffer.of(scores, pts=0)])
+    cm = res[0].meta["class_map"]
+    assert cm.shape == (4, 4)
+    assert (cm[:2] == 1).all() and (cm[2:] == 2).all()
+    img = res[0].tensors[0]
+    assert img.shape == (4, 4, 4)
+    # two distinct colors, both opaque
+    assert img[0, 0, 3] == 255 and img[3, 0, 3] == 255
+    assert not np.array_equal(img[0, 0], img[3, 0])
+
+
+def test_segment_index_variant():
+    idx_map = np.array([[0, 1], [2, 3]], np.uint8)
+    spec = TensorsSpec.of(TensorInfo((2, 2), DType.UINT8))
+    dec, res = decode_one(
+        {"mode": "image_segment", "option1": "index", "option2": "4"},
+        spec, [TensorBuffer.of(idx_map, pts=0)])
+    assert res[0].meta["class_map"].tolist() == [[0, 1], [2, 3]]
+    assert res[0].tensors[0][0, 0, 3] == 0  # background transparent
+
+
+# -- octet -------------------------------------------------------------------
+
+def test_octet_stream_concat():
+    spec = TensorsSpec.of(TensorInfo((2,), DType.UINT8),
+                          TensorInfo((2,), DType.UINT8))
+    b = TensorBuffer.of(np.array([1, 2], np.uint8),
+                        np.array([3, 4], np.uint8), pts=0)
+    dec, res = decode_one({"mode": "octet_stream"}, spec, [b])
+    assert isinstance(dec.out_specs[0], OctetSpec)
+    np.testing.assert_array_equal(res[0].tensors[0], [1, 2, 3, 4])
+
+
+# -- font --------------------------------------------------------------------
+
+def test_font_renders_text():
+    from nnstreamer_tpu.decoders.font import blit_text, render_text
+
+    bm = render_text("AB1")
+    assert bm.shape == (8, 24)
+    assert bm.sum() > 0
+    img = np.zeros((10, 30, 4), np.uint8)
+    blit_text(img, "HI", 1, 1)
+    assert (img[:, :, 0] == 255).sum() > 0
+    # clipping never raises
+    blit_text(img, "CLIPPED", 25, 8)

@@ -1,0 +1,182 @@
+"""Edge/distributed tests — loopback on localhost, the reference's own
+technique (SURVEY.md §4: background server pipeline + byte-compare; no
+cluster needed)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.backends.custom import register_custom_easy
+from nnstreamer_tpu.edge import (
+    EdgeSink, EdgeSrc, QueryServer, TensorQueryClient, TensorQueryServerSink,
+    TensorQueryServerSrc, decode_buffer, encode_buffer)
+from nnstreamer_tpu.elements import AppSrc, TensorFilter, TensorSink
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+
+@pytest.fixture(autouse=True)
+def _clean_servers():
+    yield
+    QueryServer.reset_all()
+
+
+def test_wire_roundtrip_preserves_everything():
+    buf = TensorBuffer.of(
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.array([1, 2, 3], np.uint8),
+        pts=123456789)
+    buf = buf.with_meta(label="cat", score=0.75)
+    data = encode_buffer(buf, client_id=42)
+    out, cid = decode_buffer(data)
+    assert cid == 42
+    assert out.pts == 123456789
+    assert out.meta["label"] == "cat"
+    assert out.meta["score"] == 0.75
+    np.testing.assert_array_equal(out.tensors[0], buf.tensors[0])
+    np.testing.assert_array_equal(out.tensors[1], buf.tensors[1])
+
+
+def test_wire_rejects_corrupt_frames():
+    buf = TensorBuffer.of(np.zeros((2, 2), np.float32))
+    data = bytearray(encode_buffer(buf))
+    data[0] ^= 0xFF  # clobber magic
+    with pytest.raises(ValueError, match="magic"):
+        decode_buffer(bytes(data))
+    with pytest.raises(ValueError):
+        decode_buffer(encode_buffer(buf)[:10])
+
+
+def _start_echo_server(transform=None):
+    """Server pipeline: serversrc → filter(custom fn) → serversink."""
+    register_custom_easy("edge_double", lambda ts: (ts[0] * 2.0,))
+    ssrc = TensorQueryServerSrc(name="ssrc", id=5, dims="4", types="float32",
+                                port=0)
+    f = TensorFilter(name="f", framework="custom", model="edge_double")
+    ssink = TensorQueryServerSink(name="ssink", id=5)
+    pipe = nns.Pipeline("server")
+    for e in (ssrc, f, ssink):
+        pipe.add(e)
+    pipe.link(ssrc, f)
+    pipe.link(f, ssink)
+    runner = nns.PipelineRunner(pipe).start()
+    return pipe, runner, ssrc
+
+
+def test_query_offload_roundtrip():
+    server_pipe, server_runner, ssrc = _start_echo_server()
+    try:
+        port = ssrc.port
+        # client pipeline: appsrc → query_client → sink
+        spec = TensorsSpec.of(TensorInfo((4,), DType.FLOAT32))
+        src = AppSrc(spec=spec, name="src")
+        qc = TensorQueryClient(name="qc", port=port, timeout=15)
+        sink = TensorSink(name="s")
+        pipe = nns.Pipeline("client")
+        for e in (src, qc, sink):
+            pipe.add(e)
+        pipe.link(src, qc)
+        pipe.link(qc, sink)
+        runner = nns.PipelineRunner(pipe).start()
+        for i in range(3):
+            src.push(TensorBuffer.of(
+                np.full((4,), i + 1, np.float32), pts=i))
+        src.end()
+        runner.wait(30)
+        assert len(sink.results) == 3
+        for i, r in enumerate(sink.results):
+            np.testing.assert_array_equal(
+                r.tensors[0], np.full((4,), 2.0 * (i + 1), np.float32))
+            assert r.pts == i
+            assert "client_id" not in r.meta
+    finally:
+        server_runner.stop()
+
+
+def test_query_client_caps_rejection():
+    server_pipe, server_runner, ssrc = _start_echo_server()
+    try:
+        spec = TensorsSpec.of(TensorInfo((7,), DType.FLOAT32))  # wrong dims
+        src = AppSrc(spec=spec, name="src")
+        qc = TensorQueryClient(name="qc", port=ssrc.port, timeout=15)
+        sink = TensorSink(name="s")
+        pipe = nns.Pipeline()
+        for e in (src, qc, sink):
+            pipe.add(e)
+        pipe.link(src, qc)
+        pipe.link(qc, sink)
+        with pytest.raises(Exception, match="incompatible|rejected"):
+            pipe.negotiate()
+    finally:
+        server_runner.stop()
+
+
+def test_query_two_clients_routed_separately():
+    server_pipe, server_runner, ssrc = _start_echo_server()
+    try:
+        port = ssrc.port
+        results = {}
+
+        def run_client(tag, value):
+            spec = TensorsSpec.of(TensorInfo((4,), DType.FLOAT32))
+            src = AppSrc(spec=spec, name="src")
+            qc = TensorQueryClient(name="qc", port=port, timeout=15)
+            sink = TensorSink(name="s")
+            pipe = nns.Pipeline(tag)
+            for e in (src, qc, sink):
+                pipe.add(e)
+            pipe.link(src, qc)
+            pipe.link(qc, sink)
+            runner = nns.PipelineRunner(pipe).start()
+            for i in range(4):
+                src.push(TensorBuffer.of(
+                    np.full((4,), value, np.float32), pts=i))
+            src.end()
+            runner.wait(30)
+            results[tag] = [float(r.tensors[0][0]) for r in sink.results]
+
+        t1 = threading.Thread(target=run_client, args=("c1", 10.0))
+        t2 = threading.Thread(target=run_client, args=("c2", 100.0))
+        t1.start(); t2.start()
+        t1.join(30); t2.join(30)
+        assert results["c1"] == [20.0] * 4   # never c2's answers
+        assert results["c2"] == [200.0] * 4
+    finally:
+        server_runner.stop()
+
+
+def test_edge_pubsub_stream_bridging():
+    # publisher pipeline: appsrc → edgesink
+    spec = TensorsSpec.of(TensorInfo((2, 2), DType.FLOAT32))
+    psrc = AppSrc(spec=spec, name="psrc")
+    esink = EdgeSink(name="pub", port=0)
+    ppipe = nns.Pipeline("pub")
+    ppipe.add(psrc)
+    ppipe.add(esink)
+    ppipe.link(psrc, esink)
+    prunner = nns.PipelineRunner(ppipe).start()
+    port = esink.port
+
+    # subscriber pipeline: edgesrc → sink (caps from handshake)
+    esrc = EdgeSrc(name="sub", port=port, timeout=15)
+    sink = TensorSink(name="s")
+    spipe = nns.Pipeline("sub")
+    spipe.add(esrc)
+    spipe.add(sink)
+    spipe.link(esrc, sink)
+    srunner = nns.PipelineRunner(spipe).start()
+    assert esrc.out_specs[0].tensors[0].shape == (2, 2)
+
+    time.sleep(0.3)  # let subscription settle before publishing
+    for i in range(5):
+        psrc.push(TensorBuffer.of(np.full((2, 2), i, np.float32), pts=i))
+    psrc.end()
+    prunner.wait(30)
+    prunner.stop()    # closes the publisher socket…
+    srunner.wait(30)  # …which is the subscriber's EOS
+    vals = [float(r.tensors[0][0, 0]) for r in sink.results]
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0]

@@ -1,0 +1,98 @@
+"""Pallas kernel + backend tests (interpret mode on the CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.backends.pallas_backend import register_pallas_filter
+from nnstreamer_tpu.elements import AppSrc, TensorFilter, TensorSink
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+
+def test_normalize_u8_kernel_matches_numpy():
+    from nnstreamer_tpu.backends.pallas_ops import normalize_u8
+
+    x = np.arange(256, dtype=np.uint8).reshape(2, 128)
+    out = np.asarray(normalize_u8(x))
+    ref = (x.astype(np.float32) - 127.5) / 127.5
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_clamp_scale_kernel():
+    from nnstreamer_tpu.backends.pallas_ops import clamp_scale
+
+    x = np.linspace(-4, 4, 256, dtype=np.float32).reshape(2, 128)
+    out = np.asarray(clamp_scale(x, -1.0, 1.0, scale=2.0, offset=1.0))
+    ref = np.clip(x, -1, 1) * 2 + 1
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_sparse_to_dense_device_scatter():
+    from nnstreamer_tpu.backends.pallas_ops import sparse_to_dense
+    import jax.numpy as jnp
+
+    vals = jnp.array([5.0, -2.0])
+    idx = jnp.array([1, 6])
+    dense = np.asarray(sparse_to_dense(vals, idx, (2, 4)))
+    ref = np.zeros((2, 4), np.float32)
+    ref[0, 1], ref[1, 2] = 5.0, -2.0
+    np.testing.assert_array_equal(dense, ref)
+
+
+def test_pallas_backend_in_pipeline():
+    spec = TensorsSpec.of(TensorInfo((2, 128), DType.UINT8))
+    src = AppSrc(spec=spec, name="src")
+    f = TensorFilter(name="f", framework="pallas", model="normalize_u8")
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (src, f, sink):
+        pipe.add(e)
+    pipe.link(src, f)
+    pipe.link(f, sink)
+    assert f is pipe.get("f")
+    runner = nns.PipelineRunner(pipe).start()
+    x = np.full((2, 128), 255, np.uint8)
+    src.push(TensorBuffer.of(x, pts=0))
+    src.end()
+    runner.wait(60)
+    out = sink.results[0].tensors[0]
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, np.full((2, 128), 1.0), rtol=1e-6)
+
+
+def test_pallas_backend_custom_registration():
+    @register_pallas_filter("double_it")
+    def double_it(ts):
+        return tuple(t * 2 for t in ts)
+
+    spec = TensorsSpec.of(TensorInfo((4,), DType.FLOAT32))
+    src = AppSrc(spec=spec, name="src")
+    f = TensorFilter(name="f", framework="pallas", model="double_it")
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (src, f, sink):
+        pipe.add(e)
+    pipe.link(src, f)
+    pipe.link(f, sink)
+    runner = nns.PipelineRunner(pipe).start()
+    src.push(TensorBuffer.of(np.full((4,), 3.0, np.float32), pts=0))
+    src.end()
+    runner.wait(30)
+    np.testing.assert_array_equal(sink.results[0].tensors[0],
+                                  np.full((4,), 6.0))
+
+
+def test_pallas_backend_unknown_kernel_actionable_error():
+    spec = TensorsSpec.of(TensorInfo((4,), DType.FLOAT32))
+    src = AppSrc(spec=spec, name="src")
+    f = TensorFilter(name="f", framework="pallas", model="nope")
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (src, f, sink):
+        pipe.add(e)
+    pipe.link(src, f)
+    pipe.link(f, sink)
+    with pytest.raises(Exception, match="register_pallas_filter"):
+        pipe.negotiate()
