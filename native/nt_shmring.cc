@@ -1,0 +1,289 @@
+// Shared-memory SPSC frame ring — zero-copy local IPC transport.
+//
+// Native-runtime component (SURVEY.md §7: "native C++ only where latency
+// demands — zero-copy ingest, wire protocol"). The reference gets local
+// zero-copy from GStreamer's GstMemory ref-counting inside ONE process;
+// crossing processes it falls back to TCP/MQTT serialization. This ring
+// gives nnstreamer_tpu a faster primitive: frames move between local
+// pipeline processes through /dev/shm with exactly one memcpy in, one
+// out, and no socket stack.
+//
+// Layout in the shm segment:
+//   [Header | data bytes ... capacity]
+// Frames are length-prefixed (u64) and may wrap. Single producer, single
+// consumer; a process-shared mutex + condvars coordinate blocking.
+//
+// Exported C ABI (ctypes-consumed from nnstreamer_tpu/native/__init__.py):
+//   nt_ring_create / nt_ring_open / nt_ring_close / nt_ring_unlink
+//   nt_ring_write(h, data, len, timeout_ms)      -> 0 ok, <0 error
+//   nt_ring_next_len(h, timeout_ms)              -> frame len, 0 timeout,
+//                                                   -1 closed+empty
+//   nt_ring_read(h, out, cap)                    -> bytes read, <0 error
+//   nt_ring_mark_closed(h)                       -> wake readers, EOS
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x544E524E47303131ULL;  // "TNRNG011"
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;    // data area size in bytes
+  uint64_t head;        // producer write offset (monotonic)
+  uint64_t tail;        // consumer read offset (monotonic)
+  uint32_t closed;      // producer signalled EOS
+  uint32_t _pad;
+  pthread_mutex_t mu;
+  pthread_cond_t can_read;
+  pthread_cond_t can_write;
+};
+
+struct Ring {
+  Header* h;
+  uint8_t* data;
+  uint64_t map_size;
+  int fd;
+  char name[128];
+};
+
+uint64_t used(const Header* h) { return h->head - h->tail; }
+
+void abs_deadline(struct timespec* ts, int timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (long)(timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+// copy in/out with wrap-around
+void ring_put(Header* h, uint8_t* data, const uint8_t* src, uint64_t len) {
+  uint64_t pos = h->head % h->capacity;
+  uint64_t first = len < h->capacity - pos ? len : h->capacity - pos;
+  memcpy(data + pos, src, first);
+  if (len > first) memcpy(data, src + first, len - first);
+  h->head += len;
+}
+
+void ring_get(Header* h, const uint8_t* data, uint8_t* dst, uint64_t len) {
+  uint64_t pos = h->tail % h->capacity;
+  uint64_t first = len < h->capacity - pos ? len : h->capacity - pos;
+  memcpy(dst, data + pos, first);
+  if (len > first) memcpy(dst + first, data, len - first);
+  h->tail += len;
+}
+
+void ring_peek_len(const Header* h, const uint8_t* data, uint64_t* out_len) {
+  uint8_t tmp[8];
+  uint64_t pos = h->tail % h->capacity;
+  uint64_t first = 8 < h->capacity - pos ? 8 : h->capacity - pos;
+  memcpy(tmp, data + pos, first);
+  if (8 > first) memcpy(tmp + first, data, 8 - first);
+  memcpy(out_len, tmp, 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+Ring* nt_ring_create(const char* name, uint64_t capacity) {
+  if (capacity < (1u << 12)) capacity = 1u << 12;
+  uint64_t total = sizeof(Header) + capacity;
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* h = (Header*)mem;
+  memset(h, 0, sizeof(Header));
+  h->capacity = capacity;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->can_read, &ca);
+  pthread_cond_init(&h->can_write, &ca);
+  h->magic = kMagic;  // publish last
+
+  Ring* r = new Ring{h, (uint8_t*)mem + sizeof(Header), total, fd, {0}};
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  return r;
+}
+
+Ring* nt_ring_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = (Header*)mem;
+  if (h->magic != kMagic ||
+      sizeof(Header) + h->capacity > (uint64_t)st.st_size) {
+    munmap(mem, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Ring* r = new Ring{h, (uint8_t*)mem + sizeof(Header), (uint64_t)st.st_size,
+                     fd, {0}};
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  return r;
+}
+
+static int lock_robust(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {  // peer died holding the lock: recover
+    pthread_mutex_consistent(&h->mu);
+    h->closed = 1;
+    return 0;
+  }
+  return rc;
+}
+
+int nt_ring_write(Ring* r, const uint8_t* buf, uint64_t len, int timeout_ms) {
+  Header* h = r->h;
+  uint64_t need = len + 8;
+  if (need > h->capacity) return -2;  // frame larger than the ring
+  if (lock_robust(h) != 0) return -3;
+  struct timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  while (h->capacity - used(h) < need && !h->closed) {
+    int rc = pthread_cond_timedwait(&h->can_write, &h->mu, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -4;  // timeout: consumer too slow
+    }
+    if (rc == EOWNERDEAD) {  // peer died mid-operation: recover + EOS
+      pthread_mutex_consistent(&h->mu);
+      h->closed = 1;
+      break;
+    }
+    if (rc != 0) {  // inconsistent/invalid mutex: don't spin
+      pthread_mutex_unlock(&h->mu);
+      return -3;
+    }
+  }
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint8_t lenbuf[8];
+  memcpy(lenbuf, &len, 8);
+  ring_put(h, r->data, lenbuf, 8);
+  ring_put(h, r->data, buf, len);
+  pthread_cond_signal(&h->can_read);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+int64_t nt_ring_next_len(Ring* r, int timeout_ms) {
+  Header* h = r->h;
+  if (lock_robust(h) != 0) return -3;
+  struct timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  while (used(h) < 8) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;  // EOS and drained
+    }
+    int rc = pthread_cond_timedwait(&h->can_read, &h->mu, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return 0;  // timeout, retry
+    }
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&h->mu);
+      h->closed = 1;
+    } else if (rc != 0) {
+      pthread_mutex_unlock(&h->mu);
+      return -3;
+    }
+  }
+  uint64_t len;
+  ring_peek_len(h, r->data, &len);
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)len;
+}
+
+int64_t nt_ring_read(Ring* r, uint8_t* out, uint64_t cap) {
+  Header* h = r->h;
+  if (lock_robust(h) != 0) return -3;
+  if (used(h) < 8) {
+    pthread_mutex_unlock(&h->mu);
+    return h->closed ? -1 : 0;
+  }
+  uint64_t len;
+  ring_peek_len(h, r->data, &len);
+  if (len > cap) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;  // caller buffer too small (use nt_ring_next_len first)
+  }
+  h->tail += 8;  // consume the length prefix
+  ring_get(h, r->data, out, len);
+  pthread_cond_signal(&h->can_write);
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)len;
+}
+
+void nt_ring_mark_closed(Ring* r) {
+  Header* h = r->h;
+  if (lock_robust(h) != 0) return;
+  h->closed = 1;
+  pthread_cond_broadcast(&h->can_read);
+  pthread_cond_broadcast(&h->can_write);
+  pthread_mutex_unlock(&h->mu);
+}
+
+void nt_ring_close(Ring* r) {
+  if (!r) return;
+  munmap((void*)((uint8_t*)r->data - sizeof(Header)), r->map_size);
+  close(r->fd);
+  delete r;
+}
+
+int nt_ring_unlink(const char* name) { return shm_unlink(name); }
+
+uint64_t nt_ring_capacity(Ring* r) { return r->h->capacity; }
+uint64_t nt_ring_used(Ring* r) {
+  Header* h = r->h;
+  if (lock_robust(h) != 0) return 0;
+  uint64_t u = used(h);
+  pthread_mutex_unlock(&h->mu);
+  return u;
+}
+
+}  // extern "C"

@@ -1,0 +1,86 @@
+// Native wire-frame validation/scan — the hot header path of the edge
+// transport (edge/wire.py documents the layout; this is the C twin used
+// by relays and the IPC elements to validate frames without Python).
+//
+//   frame:  u32 magic('TPUF') u32 num s64 pts u64 client_id u32 meta_len
+//           meta | per tensor: tensor-meta header + payload
+//   tensor: u32 magic('TPUT') u32 ver u32 dtype u32 fmt u32 media u32 rank
+//           u32 dims[rank] u32 extra
+//
+// nt_wire_frame_size(data, len) -> total frame bytes if a complete valid
+// frame starts at data[0]; 0 if more bytes are needed; -1 if corrupt.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kFrameMagic = 0x54505546;   // 'TPUF'
+constexpr uint32_t kTensorMagic = 0x54505554;  // 'TPUT'
+constexpr uint32_t kMaxTensors = 16;
+constexpr uint32_t kMaxRank = 16;
+constexpr uint64_t kMaxFrame = 1ull << 31;
+
+// dtype sizes must match tensor/dtypes.py enum order:
+// INT32 UINT32 INT16 UINT16 INT8 UINT8 FLOAT64 FLOAT32 INT64 UINT64
+// FLOAT16 BFLOAT16
+constexpr uint32_t kDtypeSize[] = {
+    4, 4, 2, 2, 1, 1, 8, 4, 8, 8, 2, 2,
+};
+constexpr uint32_t kNumDtypes = sizeof(kDtypeSize) / sizeof(uint32_t);
+
+uint32_t rd32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// → total tensor block size (header+payload) or 0/-1 as frame_size
+int64_t nt_wire_tensor_size(const uint8_t* p, uint64_t len) {
+  const uint64_t fixed = 6 * 4;
+  if (len < fixed) return 0;
+  if (rd32(p) != kTensorMagic) return -1;
+  uint32_t version = rd32(p + 4);
+  uint32_t dtype = rd32(p + 8);
+  uint32_t rank = rd32(p + 20);
+  if (version != 1 || dtype >= kNumDtypes || rank < 1 || rank > kMaxRank)
+    return -1;
+  uint64_t hdr = fixed + 4ull * rank + 4;
+  if (len < hdr) return 0;
+  uint64_t elems = 1;
+  for (uint32_t i = 0; i < rank; i++) {
+    uint32_t d = rd32(p + fixed + 4ull * i);
+    if (d == 0) return -1;
+    elems *= d;
+    if (elems > kMaxFrame) return -1;
+  }
+  uint64_t payload = elems * kDtypeSize[dtype];
+  if (payload > kMaxFrame) return -1;
+  if (len < hdr + payload) return 0;
+  return (int64_t)(hdr + payload);
+}
+
+int64_t nt_wire_frame_size(const uint8_t* p, uint64_t len) {
+  const uint64_t head = 4 + 4 + 8 + 8 + 4;
+  if (len < head) return 0;
+  if (rd32(p) != kFrameMagic) return -1;
+  uint32_t num = rd32(p + 4);
+  uint32_t meta_len = rd32(p + 24);
+  if (num > kMaxTensors || meta_len > kMaxFrame) return -1;
+  uint64_t off = head + meta_len;
+  if (len < off) return 0;
+  for (uint32_t i = 0; i < num; i++) {
+    int64_t t = nt_wire_tensor_size(p + off, len - off);
+    if (t < 0) return -1;
+    if (t == 0) return 0;
+    off += (uint64_t)t;
+    if (off > kMaxFrame) return -1;
+  }
+  return (int64_t)off;
+}
+
+}  // extern "C"

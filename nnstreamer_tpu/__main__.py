@@ -1,0 +1,94 @@
+"""CLI — the gst-launch-1.0 / gst-inspect-1.0 parity surface.
+
+    python -m nnstreamer_tpu 'videotestsrc num-buffers=16 ! tensor_converter \
+        ! tensor_filter model=zoo://mobilenet_v2 ! tensor_sink'
+    python -m nnstreamer_tpu --inspect                 # list elements
+    python -m nnstreamer_tpu --inspect tensor_filter   # element detail
+    python -m nnstreamer_tpu --models                  # list zoo models
+    python -m nnstreamer_tpu --stats '...pipeline...'  # per-element stats
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _inspect(name: str | None) -> int:
+    import nnstreamer_tpu.elements  # noqa: F401 (register built-ins)
+    from nnstreamer_tpu.core.registry import PluginKind, registry
+
+    if not name:
+        print("elements:")
+        for n in sorted(registry.names(PluginKind.ELEMENT)):
+            cls = registry.get(PluginKind.ELEMENT, n)
+            doc = (cls.__doc__ or "").strip().splitlines()
+            print(f"  {n:24s} {doc[0] if doc else ''}")
+        print("\ndecoder modes:")
+        import nnstreamer_tpu.decoders  # noqa: F401
+
+        for n in sorted(registry.names(PluginKind.DECODER)):
+            print(f"  {n}")
+        return 0
+    cls = registry.get(PluginKind.ELEMENT, name)
+    print(f"element {name} ({cls.__name__})")
+    if cls.__doc__:
+        print(cls.__doc__)
+    print("properties:")
+    for prop, pd in cls.PROPS.items():
+        print(f"  {prop.replace('_', '-'):24s} default={pd.default!r}  {pd.doc}")
+    return 0
+
+
+def _models() -> int:
+    from nnstreamer_tpu.models.zoo import list_models
+
+    for m in list_models():
+        print(f"zoo://{m}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu",
+        description="TPU-native streaming AI pipelines (gst-launch parity)")
+    ap.add_argument("pipeline", nargs="?", help="pipeline description string")
+    ap.add_argument("--inspect", nargs="?", const="", default=None,
+                    metavar="ELEMENT", help="list elements / element detail")
+    ap.add_argument("--models", action="store_true", help="list zoo models")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="max run seconds")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-element stats JSON after EOS")
+    ap.add_argument("--no-optimize", action="store_true",
+                    help="disable transform-into-filter fusion")
+    args = ap.parse_args(argv)
+
+    if args.inspect is not None:
+        return _inspect(args.inspect or None)
+    if args.models:
+        return _models()
+    if not args.pipeline:
+        ap.print_help()
+        return 2
+
+    import nnstreamer_tpu as nns
+
+    pipe = nns.parse_launch(args.pipeline)
+    runner = nns.PipelineRunner(pipe, optimize=not args.no_optimize)
+    try:
+        runner.start()
+        runner.wait(args.timeout)
+    except KeyboardInterrupt:
+        print("interrupted", file=sys.stderr)
+        return 130
+    finally:
+        runner.stop()
+    if args.stats:
+        print(json.dumps(runner.stats(), indent=2, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

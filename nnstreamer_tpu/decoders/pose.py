@@ -42,7 +42,9 @@ _JOINT = np.array((255, 64, 64, 255), np.uint8)
 class PoseEstimation(DecoderSubplugin):
     def init(self, props: dict) -> None:
         self.out_w, self.out_h = parse_wh(props.get("option1", ""), 640, 480)
-        self.in_size = props.get("option2", "")
+        # model input size: the pixel frame offsets are expressed in;
+        # 0 = derive from the heatmap grid at output stride 16
+        self.in_w, self.in_h = parse_wh(props.get("option2", ""), 0, 0)
         self.labels = load_labels(props.get("option3", ""), "pose_estimation")
         self.score_thresh = float(props.get("option4", "") or 0.3)
 
@@ -76,13 +78,15 @@ class PoseEstimation(DecoderSubplugin):
         fy = (ys + 0.5) / h
         fx = (xs + 0.5) / w
         if offsets is not None:
-            # offsets layout: [..., :K] = y-offset px, [..., K:] = x-offset
-            stride_y = 1.0 / h
-            stride_x = 1.0 / w
+            # offsets layout: [..., :K] = y-offset, [..., K:] = x-offset,
+            # in MODEL-INPUT pixels (PoseNet short-range offsets). The
+            # input frame is option2, or grid × stride-16 by default.
+            in_h = self.in_h or h * 16
+            in_w = self.in_w or w * 16
             oy = offsets[ys, xs, np.arange(k)]
             ox = offsets[ys, xs, k + np.arange(k)]
-            fy = fy + oy * stride_y
-            fx = fx + ox * stride_x
+            fy = fy + oy / in_h
+            fx = fx + ox / in_w
         return np.stack([fx * self.out_w, fy * self.out_h, score], axis=1)
 
     def decode(self, buf: TensorBuffer) -> TensorBuffer:

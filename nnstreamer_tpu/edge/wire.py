@@ -34,12 +34,49 @@ _HEAD = struct.Struct("<IIqQI")  # magic, num, pts, client_id, meta_len
 MAX_FRAME_BYTES = 1 << 31
 
 
+def _meta_to_json(meta: dict) -> dict:
+    """JSON-able meta. Arrays (decoder outputs: boxes/keypoints/class_map)
+    ride as base64'd payloads so the documented meta contract survives
+    the wire; unserializable values are dropped with a log line."""
+    import base64
+
+    out = {}
+    for k, v in meta.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        elif isinstance(v, np.ndarray):
+            out[k] = {"__nd__": True, "dtype": str(v.dtype),
+                      "shape": list(v.shape),
+                      "b64": base64.b64encode(
+                          np.ascontiguousarray(v).tobytes()).decode()}
+        else:
+            from nnstreamer_tpu.core.log import get_logger
+
+            get_logger("edge.wire").debug(
+                "meta key %r (%s) is not wire-serializable; dropped",
+                k, type(v).__name__)
+    return out
+
+
+def _meta_from_json(meta: dict) -> dict:
+    import base64
+
+    out = {}
+    for k, v in meta.items():
+        if isinstance(v, dict) and v.get("__nd__"):
+            out[k] = np.frombuffer(
+                base64.b64decode(v["b64"]),
+                np.dtype(v["dtype"])).reshape(v["shape"]).copy()
+        else:
+            out[k] = v
+    return out
+
+
 def encode_buffer(buf: TensorBuffer, client_id: int = 0) -> bytes:
     """Serialize a (host) TensorBuffer. Device buffers are synced here —
     the transport boundary is by definition a D2H point."""
     host = buf.to_host()
-    metable = {k: v for k, v in host.meta.items()
-               if isinstance(v, (str, int, float, bool))}
+    metable = _meta_to_json(host.meta)
     meta_bytes = json.dumps(metable).encode() if metable else b""
     parts = [
         _HEAD.pack(FRAME_MAGIC, host.num_tensors,
@@ -73,7 +110,7 @@ def decode_buffer(data: bytes) -> Tuple[TensorBuffer, int]:
     if meta_len:
         if meta_len > len(data) - off:
             raise ValueError("corrupt frame: meta overruns payload")
-        meta = json.loads(data[off:off + meta_len])
+        meta = _meta_from_json(json.loads(data[off:off + meta_len]))
         off += meta_len
     tensors = []
     fmt = TensorFormat.STATIC

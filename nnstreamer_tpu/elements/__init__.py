@@ -11,6 +11,7 @@ from nnstreamer_tpu.elements import (  # noqa: F401
     debug,
     decoder,
     filter as filter_element,
+    ipc,
     repo,
     routing,
     sinks,
@@ -18,6 +19,7 @@ from nnstreamer_tpu.elements import (  # noqa: F401
     sparse_elements,
     transform,
 )
+from nnstreamer_tpu.trainer import element as _trainer_element  # noqa: F401
 
 from nnstreamer_tpu.elements.aggregator import TensorAggregator
 from nnstreamer_tpu.elements.control import (
@@ -26,6 +28,7 @@ from nnstreamer_tpu.elements.converter import TensorConverter, register_converte
 from nnstreamer_tpu.elements.debug import TensorDebug
 from nnstreamer_tpu.elements.decoder import TensorDecoder, register_decoder
 from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.ipc import IpcSink, IpcSrc
 from nnstreamer_tpu.elements.repo import REPO, TensorRepoSink, TensorRepoSrc
 from nnstreamer_tpu.elements.routing import (
     Join, Queue, Tee, TensorDemux, TensorMerge, TensorMux, TensorSplit)
@@ -38,6 +41,8 @@ from nnstreamer_tpu.elements.transform import TensorTransform, TransformProgram
 __all__ = [
     "AppSrc",
     "FakeSink",
+    "IpcSink",
+    "IpcSrc",
     "Join",
     "Queue",
     "REPO",

@@ -167,3 +167,91 @@ class TensorSrc(SourceElement):
                 yield item
             else:
                 yield TensorBuffer.of(item, pts=i)
+
+
+@register_element("filesrc")
+class FileSrc(SourceElement):
+    """Replay frames from a file (filesrc + decodebin-lite analog).
+
+    Formats by extension:
+    - .npy  — one array; axis 0 indexes frames (shape[1:] per frame),
+              unless frames-per-file=1, then the whole array is one frame
+    - .npz  — arrays sorted by key, one frame each
+    - .raw/.bin — raw bytes reshaped to dims/types per frame, repeated
+              until the file is exhausted (the reference's raw filesrc +
+              tensor_converter octet path)
+    """
+
+    ELEMENT_NAME = "filesrc"
+    PROPS = {
+        "location": PropDef(str, None, "input file path"),
+        "dims": PropDef(str, "", "frame dims for raw files"),
+        "types": PropDef(str, "float32"),
+        "rate": PropDef(str, "0/1", "emission framerate, 0/1 = as fast"),
+        "frames_per_file": PropDef(int, 0, "npy: 0 = axis-0-indexed"),
+        "loop": PropDef(prop_bool, False, "repeat forever"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.props["location"]:
+            raise PipelineError(f"filesrc {self.name}: location= is required")
+        self._frames = self._load()
+        if not self._frames:
+            raise PipelineError(
+                f"filesrc {self.name}: {self.props['location']!r} contains "
+                f"no frames (empty file or zero-length leading axis)")
+
+    def _load(self) -> List[np.ndarray]:
+        import os
+
+        path = self.props["location"]
+        if not os.path.isfile(path):
+            raise PipelineError(
+                f"filesrc {self.name}: file not found: {path!r}")
+        ext = path.rsplit(".", 1)[-1].lower()
+        if ext == "npy":
+            arr = np.load(path)
+            if self.props["frames_per_file"] == 1 or arr.ndim == 0:
+                return [np.atleast_1d(arr)]
+            return [arr[i] for i in range(arr.shape[0])]
+        if ext == "npz":
+            z = np.load(path)
+            return [z[k] for k in sorted(z.files)]
+        # raw bytes
+        if not self.props["dims"]:
+            raise PipelineError(
+                f"filesrc {self.name}: raw files need dims=/types= to "
+                f"frame the byte stream")
+        spec = TensorsSpec.from_strings(self.props["dims"], self.props["types"])
+        info = spec.tensors[0]
+        data = open(path, "rb").read()
+        fsize = info.nbytes
+        if fsize == 0 or len(data) % fsize != 0:
+            raise PipelineError(
+                f"filesrc {self.name}: file size {len(data)} is not a "
+                f"multiple of the {fsize}-byte frame ({info})")
+        frames = [
+            np.frombuffer(data[i:i + fsize], info.dtype.np_dtype)
+            .reshape(info.shape)
+            for i in range(0, len(data), fsize)
+        ]
+        return frames
+
+    def output_spec(self) -> StreamSpec:
+        first = self._frames[0]
+        spec = TensorBuffer.of(first).spec()
+        return spec.with_rate(Fraction(self.props["rate"]))
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        rate = Fraction(self.props["rate"])
+        frame_ns = int(1e9 / rate) if rate > 0 else 0
+        i = 0
+        while True:
+            for f in self._frames:
+                if frame_ns:
+                    time.sleep(frame_ns / 1e9)
+                yield TensorBuffer.of(f, pts=i * (frame_ns or 1))
+                i += 1
+            if not self.props["loop"]:
+                return

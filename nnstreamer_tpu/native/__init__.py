@@ -1,0 +1,151 @@
+"""ctypes bindings for the native runtime library (native/libnnstpu.so).
+
+Build it with `make -C native` (g++, no other deps). Everything here
+degrades gracefully: `available()` is False when the .so is missing and
+callers raise an actionable error telling the user to build it.
+
+Components:
+- ShmRing — shared-memory SPSC frame ring (native/nt_shmring.cc): the
+  zero-copy local IPC transport behind ipc_sink/ipc_src.
+- wire_frame_size — native wire-frame validator (native/nt_wire.cc).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+from typing import Optional
+
+from nnstreamer_tpu.core.errors import StreamError
+
+_LIB_PATHS = (
+    Path(__file__).resolve().parents[2] / "native" / "libnnstpu.so",
+    Path(os.environ.get("NNSTPU_NATIVE_LIB", "")),
+)
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    for p in _LIB_PATHS:
+        if p and p.is_file():
+            try:
+                lib = ctypes.CDLL(str(p))
+            except OSError:
+                continue
+            lib.nt_ring_create.restype = ctypes.c_void_p
+            lib.nt_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            lib.nt_ring_open.restype = ctypes.c_void_p
+            lib.nt_ring_open.argtypes = [ctypes.c_char_p]
+            lib.nt_ring_write.restype = ctypes.c_int
+            lib.nt_ring_write.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_int]
+            lib.nt_ring_next_len.restype = ctypes.c_int64
+            lib.nt_ring_next_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.nt_ring_read.restype = ctypes.c_int64
+            lib.nt_ring_read.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            lib.nt_ring_mark_closed.argtypes = [ctypes.c_void_p]
+            lib.nt_ring_close.argtypes = [ctypes.c_void_p]
+            lib.nt_ring_unlink.argtypes = [ctypes.c_char_p]
+            lib.nt_ring_capacity.restype = ctypes.c_uint64
+            lib.nt_ring_capacity.argtypes = [ctypes.c_void_p]
+            lib.nt_ring_used.restype = ctypes.c_uint64
+            lib.nt_ring_used.argtypes = [ctypes.c_void_p]
+            lib.nt_wire_frame_size.restype = ctypes.c_int64
+            lib.nt_wire_frame_size.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_uint64]
+            _lib = lib
+            break
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def require():
+    lib = _load()
+    if lib is None:
+        raise StreamError(
+            "the native runtime library is not built; run `make -C native` "
+            "in the repository root (needs only g++) or set "
+            "NNSTPU_NATIVE_LIB to a prebuilt libnnstpu.so")
+    return lib
+
+
+def wire_frame_size(data: bytes) -> int:
+    """→ total frame bytes, 0 = incomplete, -1 = corrupt (native path)."""
+    return int(require().nt_wire_frame_size(data, len(data)))
+
+
+class ShmRing:
+    """SPSC frame ring in shared memory (producer OR consumer side)."""
+
+    def __init__(self, name: str, *, create: bool, capacity: int = 1 << 22):
+        self._lib = require()
+        self.name = name
+        self._creator = create
+        if create:
+            self._h = self._lib.nt_ring_create(name.encode(), capacity)
+        else:
+            self._h = self._lib.nt_ring_open(name.encode())
+        if not self._h:
+            verb = "create" if create else "open"
+            raise StreamError(
+                f"cannot {verb} shared-memory ring {name!r}"
+                + ("" if create else " — is the producer pipeline running?"))
+
+    def write(self, frame: bytes, timeout_ms: int = 10_000) -> None:
+        rc = self._lib.nt_ring_write(self._h, frame, len(frame), timeout_ms)
+        if rc == -2:
+            raise StreamError(
+                f"frame of {len(frame)} bytes exceeds ring capacity "
+                f"{self.capacity} (raise ipc_sink capacity=)")
+        if rc == -4:
+            raise StreamError(
+                f"ring {self.name!r} full for {timeout_ms}ms — consumer "
+                f"stalled or gone")
+        if rc != 0:
+            raise StreamError(f"ring {self.name!r} closed or broken ({rc})")
+
+    def read(self, timeout_ms: int = 100) -> Optional[bytes]:
+        """→ one frame, None on timeout; raises StreamError at EOS."""
+        n = self._lib.nt_ring_next_len(self._h, timeout_ms)
+        if n == 0:
+            return None
+        if n < 0:
+            raise EOFError(f"ring {self.name!r} closed")
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.nt_ring_read(self._h, buf, int(n))
+        if got < 0:
+            if got == -1:
+                raise EOFError(f"ring {self.name!r} closed")
+            raise StreamError(f"ring {self.name!r} read error ({got})")
+        return buf.raw[:got]
+
+    @property
+    def capacity(self) -> int:
+        return int(self._lib.nt_ring_capacity(self._h))
+
+    @property
+    def used(self) -> int:
+        return int(self._lib.nt_ring_used(self._h))
+
+    def close_write(self) -> None:
+        """Producer EOS: wake readers, they drain then see EOF."""
+        self._lib.nt_ring_mark_closed(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.nt_ring_close(self._h)
+            self._h = None
+            if self._creator:
+                self._lib.nt_ring_unlink(self.name.encode())

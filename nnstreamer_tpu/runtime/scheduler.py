@@ -44,6 +44,34 @@ class _EOSType:
 EOS = _EOSType()
 
 
+class ElementStats:
+    """Per-element processing-time counters — the GstShark proctime tracer
+    analog (SURVEY.md §5.1: tools/tracing/README.md:34-41), first-class
+    instead of out-sourced. Read via PipelineRunner.stats()."""
+
+    __slots__ = ("buffers", "total_s", "max_s")
+
+    def __init__(self):
+        self.buffers = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def record(self, dt: float) -> None:
+        self.buffers += 1
+        self.total_s += dt
+        if dt > self.max_s:
+            self.max_s = dt
+
+    @property
+    def avg_us(self) -> float:
+        return 1e6 * self.total_s / self.buffers if self.buffers else 0.0
+
+    def as_dict(self) -> dict:
+        return {"buffers": self.buffers, "proctime_avg_us": self.avg_us,
+                "proctime_max_us": 1e6 * self.max_s,
+                "proctime_total_s": self.total_s}
+
+
 class PipelineRunner:
     def __init__(self, pipeline: Pipeline, queue_capacity: Optional[int] = None,
                  optimize: bool = True):
@@ -52,6 +80,9 @@ class PipelineRunner:
         cap = queue_capacity or get_config().get_int("runtime", "queue_capacity", 4)
         self._cap = max(1, cap)
         self._queues: Dict[str, "queue.Queue"] = {}
+        # built in start(), AFTER transform fusion removed elements —
+        # fused-away elements must not appear as zero-count stats rows
+        self._stats: Dict[str, ElementStats] = {}
         self._threads: List[threading.Thread] = []
         self._stop_evt = threading.Event()
         self._error: Optional[BaseException] = None
@@ -70,6 +101,8 @@ class PipelineRunner:
 
                 fuse_transforms(pipe)
             pipe.negotiate()
+        for name in pipe.elements:
+            self._stats.setdefault(name, ElementStats())
         for e in pipe.elements.values():
             e.start()
         for l in pipe.links:
@@ -136,6 +169,21 @@ class PipelineRunner:
         finally:
             self.stop()
 
+    def stats(self) -> Dict[str, dict]:
+        """Per-element proctime/buffer counters (tracing, §5.1).
+
+        tensor_filter elements additionally expose their own
+        latency_us/throughput props (the reference's two counters)."""
+        out = {}
+        for name, s in self._stats.items():
+            d = s.as_dict()
+            e = self.pipeline.elements.get(name)
+            if hasattr(e, "latency_us"):
+                d["invoke_latency_us"] = e.latency_us
+                d["invoke_throughput"] = e.throughput
+            out[name] = d
+        return out
+
     # -- internals ---------------------------------------------------------
     def _fail(self, elem: Element, exc: BaseException) -> None:
         with self._error_lock:
@@ -185,6 +233,7 @@ class PipelineRunner:
         q = self._queues[elem.name]
         n_pads = max(1, len(self.pipeline.links_to(elem)))
         eos_pads = set()
+        stats = self._stats[elem.name]
         try:
             while not self._stop_evt.is_set():
                 try:
@@ -201,7 +250,10 @@ class PipelineRunner:
                         self._broadcast_eos(elem)
                         return
                     continue
-                for sp, b in elem.process(pad, item):
+                t0 = time.perf_counter()
+                emissions = elem.process(pad, item)
+                stats.record(time.perf_counter() - t0)
+                for sp, b in emissions:
                     self._emit(elem, sp, b)
         except Exception as e:
             self._fail(elem, e)

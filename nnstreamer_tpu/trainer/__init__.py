@@ -1,0 +1,13 @@
+"""On-stream training (reference: the reserved `tensor_trainer` subplugin
+type, nnstreamer_subplugin.h TRAINER slot — never fleshed out upstream;
+first-class here because TPUs train).
+
+`tensor_trainer` consumes (x, label) tensor frames and runs one optimizer
+step per frame/batch on a zoo model — optionally sharded over a mesh
+(parallel/train.py) — and periodically emits the scalar loss downstream
+plus checkpoints via orbax when `checkpoint_dir` is set.
+"""
+
+from nnstreamer_tpu.trainer.element import TensorTrainer
+
+__all__ = ["TensorTrainer"]

@@ -41,6 +41,17 @@ def test_wire_roundtrip_preserves_everything():
     np.testing.assert_array_equal(out.tensors[1], buf.tensors[1])
 
 
+def test_wire_preserves_ndarray_meta():
+    """Decoder outputs (boxes/keypoints) ride meta across transports."""
+    boxes = np.array([[1.0, 2.0, 3.0, 4.0, 0.9, 7.0]], np.float32)
+    buf = TensorBuffer.of(np.zeros((2,), np.uint8)).with_meta(
+        boxes=boxes, label="person", n=3)
+    out, _ = decode_buffer(encode_buffer(buf))
+    np.testing.assert_array_equal(out.meta["boxes"], boxes)
+    assert out.meta["boxes"].dtype == np.float32
+    assert out.meta["label"] == "person" and out.meta["n"] == 3
+
+
 def test_wire_rejects_corrupt_frames():
     buf = TensorBuffer.of(np.zeros((2, 2), np.float32))
     data = bytearray(encode_buffer(buf))

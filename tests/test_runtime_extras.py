@@ -1,0 +1,166 @@
+"""Tests: tensor_trainer, SingleShot, CLI, filesrc, per-element stats."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.elements import AppSrc, TensorSink
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+
+def test_trainer_element_loss_decreases(tmp_path):
+    from nnstreamer_tpu.trainer import TensorTrainer
+
+    spec = TensorsSpec.of(TensorInfo((4, 32, 32, 3), DType.FLOAT32),
+                          TensorInfo((4,), DType.INT32))
+    src = AppSrc(spec=spec, name="src")
+    tr = TensorTrainer(
+        name="tr", model="zoo://mobilenet_v2?width=0.35&num_classes=8",
+        optimizer="adam:0.01",
+        checkpoint_dir=str(tmp_path), checkpoint_every=6)
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (src, tr, sink):
+        pipe.add(e)
+    pipe.link(src, tr)
+    pipe.link(tr, sink)
+    runner = nns.PipelineRunner(pipe).start()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+    y = np.array([0, 1, 2, 3], np.int32)
+    for i in range(6):
+        src.push(TensorBuffer.of(x, y, pts=i))  # same batch → must overfit
+    src.end()
+    runner.wait(120)
+    losses = [float(r.tensors[0][0]) for r in sink.results]
+    assert len(losses) == 6
+    assert losses[-1] < losses[0], losses  # learning happened
+    assert tr.steps == 6
+    # checkpoint written at step 6
+    assert (tmp_path / "step_6").exists()
+
+
+def test_trainer_sharded_on_mesh(eight_cpu_devices):
+    from nnstreamer_tpu.trainer import TensorTrainer
+
+    spec = TensorsSpec.of(TensorInfo((8, 16, 16, 3), DType.FLOAT32),
+                          TensorInfo((8,), DType.INT32))
+    src = AppSrc(spec=spec, name="src")
+    tr = TensorTrainer(
+        name="tr", model="zoo://mobilenet_v2?width=0.35&num_classes=8",
+        optimizer="sgd:0.01", mesh="dp=4,tp=2")
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (src, tr, sink):
+        pipe.add(e)
+    pipe.link(src, tr)
+    pipe.link(tr, sink)
+    runner = nns.PipelineRunner(pipe).start()
+    x = np.ones((8, 16, 16, 3), np.float32)
+    y = np.arange(8, dtype=np.int32) % 8
+    src.push(TensorBuffer.of(x, y, pts=0))
+    src.end()
+    runner.wait(120)
+    assert len(sink.results) == 1
+    assert np.isfinite(sink.results[0].tensors[0][0])
+
+
+def test_single_shot_runner():
+    from nnstreamer_tpu.single import SingleShot
+
+    with SingleShot(
+            model="zoo://mobilenet_v2?width=0.35&input_size=64&dtype=float32"
+    ) as runner:
+        assert runner.input_info is not None
+        out, = runner.invoke(np.zeros((1, 64, 64, 3), np.float32))
+        assert out.shape == (1, 1001)
+        assert runner.output_info.tensors[0].shape == (1, 1001)
+
+
+def test_single_shot_custom_backend_and_fusion():
+    from nnstreamer_tpu.backends.custom import register_custom_easy
+    from nnstreamer_tpu.single import SingleShot
+    from nnstreamer_tpu.tensor.info import TensorsSpec, TensorInfo
+
+    register_custom_easy("ss_add1", lambda ts: (ts[0] + 1,))
+    r = SingleShot(model="ss_add1", framework="custom",
+                   input_spec=TensorsSpec.of(TensorInfo((3,), DType.FLOAT32)))
+    out, = r.invoke(np.zeros((3,), np.float32))
+    np.testing.assert_array_equal(np.asarray(out), [1, 1, 1])
+    r.close()
+
+
+def test_filesrc_npy_and_raw(tmp_path):
+    arr = np.arange(24, dtype=np.float32).reshape(4, 3, 2)
+    npy = tmp_path / "frames.npy"
+    np.save(npy, arr)
+    pipe = nns.parse_launch(
+        f"filesrc location={npy} ! tensor_sink name=s")
+    nns.run_pipeline(pipe, timeout=30)
+    res = pipe.get("s").results
+    assert len(res) == 4
+    np.testing.assert_array_equal(res[2].tensors[0], arr[2])
+
+    raw = tmp_path / "frames.raw"
+    raw.write_bytes(np.arange(12, dtype=np.uint8).tobytes())
+    pipe2 = nns.parse_launch(
+        f"filesrc location={raw} dims=4 types=uint8 ! tensor_sink name=s")
+    nns.run_pipeline(pipe2, timeout=30)
+    res2 = pipe2.get("s").results
+    assert len(res2) == 3
+    np.testing.assert_array_equal(res2[0].tensors[0], [0, 1, 2, 3])
+
+
+def test_runner_stats_counts_buffers():
+    spec = TensorsSpec.of(TensorInfo((2,), DType.FLOAT32))
+    src = AppSrc(spec=spec, name="src")
+    from nnstreamer_tpu.elements import TensorTransform
+
+    t = TensorTransform(name="t", mode="arithmetic", option="add:1.0")
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (src, t, sink):
+        pipe.add(e)
+    pipe.link(src, t)
+    pipe.link(t, sink)
+    runner = nns.PipelineRunner(pipe, optimize=False).start()
+    for i in range(5):
+        src.push(TensorBuffer.of(np.zeros(2, np.float32), pts=i))
+    src.end()
+    runner.wait(30)
+    stats = runner.stats()
+    assert stats["t"]["buffers"] == 5
+    assert stats["s"]["buffers"] == 5
+    assert stats["t"]["proctime_avg_us"] > 0
+
+
+def test_cli_inspect_and_pipeline(tmp_path):
+    env = {"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+           "PYTHONPATH": "/root/repo"}
+    out = subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu", "--inspect"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0
+    assert "tensor_filter" in out.stdout
+    assert "bounding_boxes" in out.stdout
+
+    out2 = subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu", "--models"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert "zoo://mobilenet_v2" in out2.stdout
+
+    arr = np.ones((2, 2, 2), np.float32)
+    np.save(tmp_path / "x.npy", arr)
+    out3 = subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu", "--stats",
+         f"filesrc location={tmp_path}/x.npy ! tensor_debug ! fakesink"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out3.returncode == 0, out3.stderr
+    stats = json.loads(out3.stdout)
+    assert any(v["buffers"] == 2 for v in stats.values())
