@@ -63,6 +63,9 @@ def main(argv=None) -> int:
                     help="print per-element stats JSON after EOS")
     ap.add_argument("--no-optimize", action="store_true",
                     help="disable transform-into-filter fusion")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture an xprof/TensorBoard device trace of the "
+                         "run into DIR (jax.profiler)")
     args = ap.parse_args(argv)
 
     if args.inspect is not None:
@@ -73,18 +76,30 @@ def main(argv=None) -> int:
         ap.print_help()
         return 2
 
+    import contextlib
+
     import nnstreamer_tpu as nns
+
+    profile_cm = contextlib.nullcontext()
+    if args.profile:
+        import jax
+
+        profile_cm = jax.profiler.trace(args.profile)
 
     pipe = nns.parse_launch(args.pipeline)
     runner = nns.PipelineRunner(pipe, optimize=not args.no_optimize)
     try:
-        runner.start()
-        runner.wait(args.timeout)
+        with profile_cm:
+            runner.start()
+            runner.wait(args.timeout)
     except KeyboardInterrupt:
         print("interrupted", file=sys.stderr)
         return 130
     finally:
         runner.stop()
+    if args.profile:
+        print(f"device trace written to {args.profile} "
+              f"(view with TensorBoard / xprof)", file=sys.stderr)
     if args.stats:
         print(json.dumps(runner.stats(), indent=2, default=float))
     return 0

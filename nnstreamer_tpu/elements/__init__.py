@@ -18,6 +18,7 @@ from nnstreamer_tpu.elements import (  # noqa: F401
     sources,
     sparse_elements,
     transform,
+    wire_codec,
 )
 from nnstreamer_tpu.trainer import element as _trainer_element  # noqa: F401
 

@@ -255,3 +255,49 @@ class FileSrc(SourceElement):
                 i += 1
             if not self.props["loop"]:
                 return
+
+
+@register_element("audiotestsrc")
+class AudioTestSrc(SourceElement):
+    """Deterministic audio generator (audiotestsrc analog): sine or
+    seeded noise chunks of `samples_per_buffer` frames."""
+
+    ELEMENT_NAME = "audiotestsrc"
+    PROPS = {
+        "sample_rate": PropDef(int, 16000),
+        "channels": PropDef(int, 1),
+        "format": PropDef(str, "S16LE"),
+        "wave": PropDef(str, "sine", "sine|noise"),
+        "freq": PropDef(float, 440.0),
+        "num_buffers": PropDef(int, 10),
+        "samples_per_buffer": PropDef(int, 1024),
+        "seed": PropDef(int, 0),
+    }
+
+    def output_spec(self) -> StreamSpec:
+        from nnstreamer_tpu.graph.media import AudioSpec
+
+        return AudioSpec(sample_rate=self.props["sample_rate"],
+                         channels=self.props["channels"],
+                         sample_format=self.props["format"])
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        spec = self.out_specs[0]
+        n = self.props["samples_per_buffer"]
+        ch = self.props["channels"]
+        sr = self.props["sample_rate"]
+        rng = np.random.default_rng(self.props["seed"])
+        dtype = np.dtype(spec.dtype_name)
+        for i in range(self.props["num_buffers"]):
+            t = (np.arange(n) + i * n) / sr
+            if self.props["wave"] == "noise":
+                wave = rng.uniform(-1.0, 1.0, size=(n, ch))
+            else:
+                wave = np.sin(2 * np.pi * self.props["freq"] * t)[:, None]
+                wave = np.repeat(wave, ch, axis=1)
+            if dtype.kind == "i":
+                scale = np.iinfo(dtype).max
+                chunk = (wave * 0.8 * scale).astype(dtype)
+            else:
+                chunk = wave.astype(dtype)
+            yield TensorBuffer.of(chunk, pts=int(i * n * 1e9 / sr))

@@ -311,3 +311,119 @@ def _array_module(buf: TensorBuffer):
 
         return jnp
     return np
+
+
+@register_element("tensor_resize")
+class TensorResize(Element):
+    """Spatial resize — the flexible→static bridge (SURVEY.md §7 hard
+    part d).
+
+    A FLEXIBLE stream (e.g. tensor_crop regions, per-buffer shapes) maps
+    onto XLA's static-shape world by resizing every region to one fixed
+    (H, W): `tensor_crop ! tensor_resize size=224:224 channels=3 !
+    tensor_filter ...` runs data-driven ROI inference with exactly one
+    compiled program — the reference can only do this by bouncing back
+    to media and using GStreamer videoscale.
+
+    STATIC input: per-tensor resize, same tensor count. FLEXIBLE input
+    (requires channels=): each region becomes its own STATIC (H, W, C)
+    buffer downstream (meta["region_index"]/["num_regions"] record the
+    grouping).
+    """
+
+    ELEMENT_NAME = "tensor_resize"
+    PROPS = {
+        "size": PropDef(str, None, "output H:W"),
+        "method": PropDef(str, "nearest", "nearest|bilinear"),
+        "channels": PropDef(int, 0, "required for FLEXIBLE input"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.props["size"]:
+            raise PipelineError(
+                f"tensor_resize ({self.name}) requires size=H:W")
+        try:
+            self._h, self._w = (int(v) for v in self.props["size"].split(":"))
+        except ValueError:
+            raise PipelineError(
+                f"tensor_resize size must be H:W, got "
+                f"{self.props['size']!r}") from None
+        if self.props["method"] not in ("nearest", "bilinear"):
+            raise PipelineError(
+                f"tensor_resize method must be nearest|bilinear, got "
+                f"{self.props['method']!r}")
+        self._flexible_in = False
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        from nnstreamer_tpu.tensor.info import TensorFormat
+
+        spec = self.expect_tensors(in_specs[0])
+        if spec.format == TensorFormat.FLEXIBLE:
+            c = self.props["channels"]
+            if not c:
+                self.fail_negotiation(
+                    "FLEXIBLE input needs channels=<C> to declare the "
+                    "static output type (each region becomes one "
+                    "(H, W, C) buffer)")
+            self._flexible_in = True
+            return [TensorsSpec.of(
+                TensorInfo((self._h, self._w, c), DType.UINT8
+                           if not spec.tensors else spec.tensors[0].dtype),
+                rate=spec.rate)]
+        infos = []
+        for t in spec.tensors:
+            if len(t.shape) < 2:
+                self.fail_negotiation(
+                    f"cannot resize rank-{len(t.shape)} tensor {t}; need "
+                    f"spatial (…, H, W, C) or (H, W) layout")
+            shape = list(t.shape)
+            h_ax = len(shape) - 3 if len(shape) >= 3 else 0
+            shape[h_ax], shape[h_ax + 1] = self._h, self._w
+            infos.append(replace(t, shape=tuple(shape)))
+        return [replace(spec, tensors=tuple(infos))]
+
+    def _resize(self, arr):
+        h_ax = arr.ndim - 3 if arr.ndim >= 3 else 0
+        in_h, in_w = arr.shape[h_ax], arr.shape[h_ax + 1]
+        if (in_h, in_w) == (self._h, self._w):
+            return np.asarray(arr)
+        if self.props["method"] == "bilinear":
+            import jax.image
+            import jax.numpy as jnp
+
+            shape = list(arr.shape)
+            shape[h_ax], shape[h_ax + 1] = self._h, self._w
+            out = jax.image.resize(jnp.asarray(arr).astype(jnp.float32),
+                                   shape, method="bilinear")
+            return np.asarray(out).astype(np.asarray(arr).dtype)
+        a = np.asarray(arr)
+        ys = np.clip(((np.arange(self._h) + 0.5) * in_h / self._h)
+                     .astype(int), 0, in_h - 1)
+        xs = np.clip(((np.arange(self._w) + 0.5) * in_w / self._w)
+                     .astype(int), 0, in_w - 1)
+        return np.take(np.take(a, ys, axis=h_ax), xs, axis=h_ax + 1)
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        from nnstreamer_tpu.tensor.info import TensorFormat
+
+        if not self._flexible_in:
+            return [(0, buf.with_tensors(
+                tuple(self._resize(t) for t in buf.tensors)))]
+        out: List[Emission] = []
+        c = self.props["channels"]
+        n = buf.num_tensors
+        for i, t in enumerate(buf.tensors):
+            a = np.asarray(t)
+            if a.ndim == 2:
+                a = a[..., None]
+            if a.ndim != 3 or a.shape[-1] != c:
+                raise PipelineError(
+                    f"tensor_resize {self.name}: region {i} has shape "
+                    f"{np.asarray(t).shape}, expected (h, w, {c})")
+            region = self._resize(a)
+            out.append((0, TensorBuffer(
+                tensors=(region,), pts=buf.pts,
+                format=TensorFormat.STATIC,
+                meta={**buf.meta, "region_index": i, "num_regions": n})))
+        return out

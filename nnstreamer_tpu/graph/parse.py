@@ -9,8 +9,11 @@ Supported grammar subset:
   node       := element | ref
   element    := NAME (prop)*
   prop       := KEY '=' VALUE        (VALUE may be "quoted with spaces")
-  ref        := NAME '.'             (links to/from a named element's next
-                                      free pad — mux/demux/tee branches)
+  ref        := NAME '.' [PAD]       (links to/from a named element; PAD
+                                      selects an explicit pad — 'sink_0',
+                                      'src_1', or a bare index — else the
+                                      next free pad is used; mux/demux/tee
+                                      branches)
 
 Examples:
 
@@ -63,16 +66,41 @@ def parse_launch(description: str, name: str = "pipeline") -> Pipeline:
     # pass 2: create links chain by chain
     for chain in chains:
         prev: Optional[Element] = None
+        prev_pad: Optional[int] = None
         for node in chain:
             cur = (
                 node["instance"]
                 if node["kind"] == "element"
                 else pipe.get(node["name"])
             )
+            cur_pad = _ref_pad(node, "sink")
             if prev is not None:
-                pipe.link(prev, cur)
+                pipe.link(prev, cur, src_pad=prev_pad, dst_pad=cur_pad)
             prev = cur
+            prev_pad = _ref_pad(node, "src")
     return pipe
+
+
+def _ref_pad(node: Dict, direction: str) -> Optional[int]:
+    """Explicit pad index of a ref node for the given direction, if any.
+
+    'sink_0'/'src_1' are direction-qualified (gst pad-template names); a
+    bare integer applies to whichever side the ref is used on.
+    """
+    if node["kind"] != "ref" or not node.get("pad"):
+        return None
+    pad = node["pad"]
+    if pad.isdigit():
+        return int(pad)
+    prefix, _, idx = pad.rpartition("_")
+    if prefix == direction and idx.isdigit():
+        return int(idx)
+    if prefix and prefix != direction:
+        return None  # qualified for the other direction
+    raise PipelineError(
+        f"bad pad reference {node['name']}.{pad!r}: expected sink_<n>, "
+        f"src_<n>, or a bare pad index"
+    )
 
 
 def _tokenize(description: str) -> List[str]:
@@ -131,9 +159,12 @@ def _split_chains(tokens: List[str]) -> List[List[Dict]]:
         # it also starts a new chain (whitespace-separated chains)
         if not expect_node:
             finish_chain()
-        if tok.endswith(".") and _NAME_RE.match(tok[:-1] or ""):
+        if "." in tok and _NAME_RE.match(tok.split(".", 1)[0] or "") and (
+                tok.endswith(".") or _NAME_RE.match(tok.split(".", 1)[1])
+                or tok.split(".", 1)[1].isdigit()):
             finish_node()
-            node = {"kind": "ref", "name": tok[:-1]}
+            elem_name, _, pad = tok.partition(".")
+            node = {"kind": "ref", "name": elem_name, "pad": pad or None}
         elif _NAME_RE.match(tok):
             finish_node()
             node = {"kind": "element", "type": tok, "name": None, "props": {}}

@@ -1,0 +1,79 @@
+"""Streaming LSTM (zoo://lstm) — the recurrent-state use case.
+
+Reference parity: the RNN/LSTM custom-filter examples driven through
+tensor_repo feedback loops (tests/nnstreamer_repo_{rnn,lstm},
+tests/nnstreamer_example/custom_example_{RNN,LSTM}). Here the cell is a
+real traced LSTM whose (h, c) state flows through the pipeline as
+tensors — pair it with tensor_repo_src/sink to close the loop:
+
+    tensor_repo_src (state) ─┐
+    appsrc (x)              ─┴→ tensor_mux → tensor_filter(zoo://lstm)
+                                 → tensor_demux ┬→ outputs
+                                                └→ tensor_repo_sink
+
+Model signature: fn(params, x, h, c) → (y, h', c') with x (B, D_in),
+h/c (B, D_hidden).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import layers as L
+from nnstreamer_tpu.models.zoo import register_model
+
+
+def init_params(key=None, *, d_in: int = 32, d_hidden: int = 64,
+                seed: int = 0) -> Dict[str, Any]:
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    kx, kh = jax.random.split(key)
+    # one fused kernel for the 4 gates (i, f, g, o) — a single MXU matmul
+    return {
+        "wx": L.xavier_init(kx, (d_in, 4 * d_hidden)),
+        "wh": L.xavier_init(kh, (d_hidden, 4 * d_hidden)),
+        "b": jnp.zeros((4 * d_hidden,), jnp.float32),
+    }
+
+
+def apply(params, x, h, c, *, dtype=jnp.float32):
+    x = x.astype(dtype)
+    h = h.astype(dtype)
+    c = c.astype(dtype)
+    z = x @ params["wx"].astype(dtype) + h @ params["wh"].astype(dtype) \
+        + params["b"].astype(dtype)
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return (h_new.astype(jnp.float32), h_new.astype(jnp.float32),
+            c_new.astype(jnp.float32))
+
+
+@register_model("lstm")
+def build(d_in: int = 32, d_hidden: int = 64, batch: int = 1,
+          dtype: str = "float32", seed: int = 0):
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    cdtype = jnp.dtype(dtype)
+    params = init_params(d_in=d_in, d_hidden=d_hidden, seed=seed)
+
+    def fn(params, x, h, c):
+        return apply(params, x, h, c, dtype=cdtype)
+
+    in_spec = TensorsSpec.of(
+        TensorInfo((batch, d_in), DType.FLOAT32, name="x"),
+        TensorInfo((batch, d_hidden), DType.FLOAT32, name="h"),
+        TensorInfo((batch, d_hidden), DType.FLOAT32, name="c"),
+    )
+    out_spec = TensorsSpec.of(
+        TensorInfo((batch, d_hidden), DType.FLOAT32, name="y"),
+        TensorInfo((batch, d_hidden), DType.FLOAT32, name="h"),
+        TensorInfo((batch, d_hidden), DType.FLOAT32, name="c"),
+    )
+    return ModelBundle(fn=fn, params=params, in_spec=in_spec,
+                       out_spec=out_spec, name="lstm")

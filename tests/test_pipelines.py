@@ -1,0 +1,242 @@
+"""SSAT-style golden pipeline tests: full DSL strings in, byte-compared
+output out — the reference's second test tier (SURVEY.md §4: 44
+runTest.sh scripts driving gst-launch pipelines), in-process.
+
+Includes negative cases ("passes if launch fails") exactly like SSAT's
+gstTest failure-expected mode.
+"""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import NegotiationError, PipelineError
+
+
+def launch_and_run(desc, pushes=None, timeout=60):
+    pipe = nns.parse_launch(desc)
+    runner = nns.PipelineRunner(pipe)
+    runner.start()
+    if pushes:
+        src = pipe.get(pushes[0])
+        for b in pushes[1]:
+            src.push(b)
+        src.end()
+    runner.wait(timeout)
+    runner.stop()
+    return pipe
+
+
+# -- golden pipelines --------------------------------------------------------
+
+def test_videotestsrc_convert_transform_golden():
+    pipe = launch_and_run(
+        "videotestsrc num-buffers=3 pattern=gradient width=8 height=6 ! "
+        "tensor_converter ! "
+        "tensor_transform mode=typecast option=float32 ! "
+        "tensor_sink name=s")
+    res = pipe.get("s").results
+    assert len(res) == 3
+    out = res[0].tensors[0]
+    assert out.shape == (1, 6, 8, 3) and out.dtype == np.float32
+    # golden: re-derive the expected gradient frame deterministically
+    pipe2 = nns.parse_launch(
+        "videotestsrc num-buffers=1 pattern=gradient width=8 height=6 ! "
+        "tensor_sink name=s")
+    nns.run_pipeline(pipe2, timeout=30)
+    raw = pipe2.get("s").results[0].tensors[0]
+    np.testing.assert_array_equal(out[0], raw.astype(np.float32))
+
+
+def test_transform_chain_matches_numpy_golden():
+    rng = np.random.default_rng(3)
+    frames = rng.integers(0, 255, size=(4, 5), dtype=np.uint8)
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "f.npy")
+        np.save(path, frames[None])
+        pipe = launch_and_run(
+            f"filesrc location={path} ! "
+            "tensor_transform mode=arithmetic "
+            "option=typecast:float32,add:-127.5,div:127.5 ! "
+            "tensor_transform mode=clamp option=-0.5:0.5 ! "
+            "tensor_sink name=s")
+        out = pipe.get("s").results[0].tensors[0]
+        golden = np.clip((frames.astype(np.float32) - 127.5) / 127.5,
+                         -0.5, 0.5)
+        np.testing.assert_allclose(out, golden, rtol=1e-6)
+
+
+def test_mux_demux_roundtrip_dsl():
+    pipe = launch_and_run(
+        "videotestsrc num-buffers=2 width=4 height=4 pattern=random ! "
+        "tensor_converter ! tee name=t "
+        "t. ! queue ! mux.sink_0 "
+        "t. ! queue ! tensor_transform mode=typecast option=uint8 ! mux.sink_1 "
+        "tensor_mux name=mux sync-mode=nosync ! "
+        "tensor_demux name=d tensorpick=1 ! tensor_sink name=s")
+    res = pipe.get("s").results
+    assert len(res) == 2
+    assert res[0].num_tensors == 1
+
+
+def test_wire_codec_roundtrip_dsl():
+    """decoder mode=wire → converter custom:wire restores the stream
+    (the flatbuf/protobuf IPC serialization path)."""
+    pipe = launch_and_run(
+        "videotestsrc num-buffers=2 width=4 height=4 pattern=random ! "
+        "tensor_converter ! tee name=t "
+        "t. ! queue ! tensor_sink name=orig "
+        "t. ! queue ! tensor_decoder mode=wire ! "
+        "tensor_converter name=back mode=custom:wire ! tensor_sink name=s")
+    orig = pipe.get("orig").results
+    back = pipe.get("s").results
+    assert len(back) == len(orig) == 2
+    for a, b in zip(orig, back):
+        np.testing.assert_array_equal(a.tensors[0], b.tensors[0])
+
+
+def test_ssd_detection_pipeline_dsl():
+    """BASELINE.md config 2 shape, tiny width: model → bbox decoder."""
+    pipe = launch_and_run(
+        "videotestsrc num-buffers=1 width=300 height=300 pattern=solid ! "
+        "tensor_converter ! "
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5 ! "
+        "tensor_filter model=zoo://ssd_mobilenet?width=0.35&num_classes=4&dtype=float32 ! "
+        "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
+        "option3=0.0:0.5 option4=300:300 ! tensor_sink name=s",
+        timeout=300)
+    out = pipe.get("s").results[0]
+    assert out.tensors[0].shape == (300, 300, 4)  # RGBA overlay
+    assert "boxes" in out.meta
+
+
+def test_posenet_pipeline_dsl():
+    """BASELINE.md config 3 shape, tiny width: posenet → pose decoder."""
+    pipe = launch_and_run(
+        "videotestsrc num-buffers=1 width=129 height=129 pattern=gradient ! "
+        "tensor_converter ! "
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5 ! "
+        "tensor_filter model=zoo://posenet?width=0.35&input_size=129&dtype=float32 ! "
+        "tensor_decoder mode=pose_estimation option1=129:129 option4=0.0 ! "
+        "tensor_sink name=s",
+        timeout=300)
+    out = pipe.get("s").results[0]
+    assert out.meta["keypoints"].shape == (17, 3)
+
+
+def test_composite_mux_two_filters_demux():
+    """BASELINE.md config 4 shape: one source, two models, joined."""
+    from nnstreamer_tpu.backends.custom import register_custom_easy
+
+    register_custom_easy("branch_a", lambda ts: (ts[0] * 2.0,))
+    register_custom_easy("branch_b", lambda ts: (ts[0] + 1.0,))
+    pipe = launch_and_run(
+        "videotestsrc num-buffers=3 width=4 height=4 pattern=random ! "
+        "tensor_converter ! tensor_transform mode=typecast option=float32 ! "
+        "tee name=t "
+        "t. ! queue ! tensor_filter framework=custom model=branch_a ! mux.sink_0 "
+        "t. ! queue ! tensor_filter framework=custom model=branch_b ! mux.sink_1 "
+        "tensor_mux name=mux sync-mode=nosync ! tensor_sink name=s")
+    res = pipe.get("s").results
+    assert len(res) == 3
+    a, b = res[0].tensors
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b) * 2 - 2)
+
+
+# -- negative tests (SSAT "passes if launch fails") --------------------------
+
+@pytest.mark.parametrize("desc,match", [
+    ("videotestsrc ! tensor_filter model=zoo://mobilenet_v2 ! fakesink",
+     "tensor_converter|tensor stream"),         # media straight into filter
+    ("videotestsrc ! tensor_converter ! tensor_transform mode=nope ! fakesink",
+     "mode"),                                   # bad transform mode
+    ("appsrc dims=4 ! tensor_decoder mode=direct_video ! fakesink",
+     "uint8"),                                  # wrong dtype for decoder
+    ("appsrc dims=4 ! tensor_split tensorseg=9 ! fakesink",
+     "tensorseg"),                              # segments don't sum
+    ("appsrc dims=4 ! tensor_merge option=channel ! fakesink",
+     "rank|axis"),                              # keyword on rank-1
+])
+def test_negative_pipelines_fail_cleanly(desc, match):
+    with pytest.raises((NegotiationError, PipelineError), match=match):
+        pipe = nns.parse_launch(desc)
+        pipe.negotiate()
+
+
+def test_unknown_element_error_lists_alternatives():
+    with pytest.raises(Exception, match="tensor_filter"):
+        nns.parse_launch("videotestsrc ! tensor_fliter ! fakesink")
+
+
+def test_crop_resize_filter_roi_pipeline():
+    """Data-driven ROI inference: crop (flexible) → resize (static) →
+    model — SURVEY.md §7 hard part (d) end-to-end."""
+    from nnstreamer_tpu.backends.custom import register_custom_easy
+    from nnstreamer_tpu.elements import AppSrc, TensorCrop, TensorFilter, TensorSink
+    from nnstreamer_tpu.elements.transform import TensorResize
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    register_custom_easy("roi_mean", lambda ts: (ts[0].astype(np.float32).mean(
+        axis=(0, 1), keepdims=True),))
+    raw = AppSrc(spec=TensorsSpec.of(
+        TensorInfo((16, 16, 3), DType.UINT8)), name="raw")
+    info = AppSrc(spec=TensorsSpec.of(
+        TensorInfo((2, 4), DType.UINT32)), name="info")
+    crop = TensorCrop(name="c")
+    rs = TensorResize(name="r", size="8:8", channels=3)
+    f = TensorFilter(name="f", framework="custom", model="roi_mean")
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (raw, info, crop, rs, f, sink):
+        pipe.add(e)
+    pipe.link(raw, crop, 0, 0)
+    pipe.link(info, crop, 0, 1)
+    pipe.link(crop, rs)
+    pipe.link(rs, f)
+    pipe.link(f, sink)
+    runner = nns.PipelineRunner(pipe).start()
+    img = np.zeros((16, 16, 3), np.uint8)
+    img[:8, :8] = 100   # region 1 bright, region 2 dark
+    regions = np.array([[0, 0, 8, 8], [8, 8, 8, 8]], np.uint32)
+    raw.push(TensorBuffer.of(img, pts=0))
+    info.push(TensorBuffer.of(regions, pts=0))
+    raw.end(); info.end()
+    runner.wait(60)
+    res = pipe.get("s").results
+    assert len(res) == 2  # one inference per region
+    means = sorted(float(r.tensors[0].reshape(-1)[0]) for r in res)
+    assert means[0] == 0.0 and means[1] == 100.0
+    assert {r.meta["region_index"] for r in res} == {0, 1}
+
+
+def test_resize_static_bilinear_and_nearest():
+    from nnstreamer_tpu.elements.transform import TensorResize
+    from nnstreamer_tpu.elements import AppSrc, TensorSink
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    for method in ("nearest", "bilinear"):
+        src = AppSrc(spec=TensorsSpec.of(
+            TensorInfo((1, 4, 4, 1), DType.FLOAT32)), name="src")
+        rs = TensorResize(name="r", size="8:8", method=method)
+        sink = TensorSink(name="s")
+        pipe = nns.Pipeline()
+        for e in (src, rs, sink):
+            pipe.add(e)
+        pipe.link(src, rs)
+        pipe.link(rs, sink)
+        assert rs.out_specs == []  # not negotiated yet
+        runner = nns.PipelineRunner(pipe).start()
+        src.push(TensorBuffer.of(
+            np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1), pts=0))
+        src.end()
+        runner.wait(60)
+        out = pipe.get("s").results[0].tensors[0]
+        assert out.shape == (1, 8, 8, 1)
