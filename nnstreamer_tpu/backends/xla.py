@@ -82,6 +82,7 @@ class XLABackend(FilterBackend):
         self._device_params = None
         self._in_spec: Optional[TensorsSpec] = None
         self._out_spec: Optional[TensorsSpec] = None
+        self._loader_opts: Dict[str, Any] = {}
 
     # -- open / model resolution ------------------------------------------
     def open(self, props: Dict[str, Any]) -> None:
@@ -91,8 +92,11 @@ class XLABackend(FilterBackend):
         if model is None:
             raise BackendError(
                 "framework=xla requires model=<zoo://name | pkg.module:attr "
-                "| ModelBundle | jax callable>"
+                "| /path/model.{tflite,npz} | ModelBundle | jax callable>"
             )
+        from nnstreamer_tpu.modelio import parse_loader_opts
+
+        self._loader_opts = parse_loader_opts(props.get("custom") or "")
         self._bundle = self._resolve(model)
         accel = props.get("accelerator") or ""
         self._device = self._pick_device(accel)
@@ -119,6 +123,12 @@ class XLABackend(FilterBackend):
             except ImportError as e:
                 raise BackendError(f"model zoo unavailable: {e}") from e
             return build_model(model[len("zoo://"):])
+        if isinstance(model, str):
+            from nnstreamer_tpu import modelio
+
+            ext = model.rsplit(".", 1)[-1].lower() if "." in model else ""
+            if ext in modelio.MODEL_EXTENSIONS:
+                return modelio.load_model_file(model, **self._loader_opts)
         if isinstance(model, str) and ":" in model:
             mod_name, _, attr = model.partition(":")
             try:

@@ -127,6 +127,10 @@ class TensorFilter(Element):
                 by_ext = cfg.get("filter", f"priority_{ext}")
                 if by_ext:
                     return by_ext.split(",")[0]
+                from nnstreamer_tpu.modelio import MODEL_EXTENSIONS
+
+                if ext in MODEL_EXTENSIONS:
+                    return MODEL_EXTENSIONS[ext]
             if model.startswith("zoo://"):
                 return "xla"
         if callable(model) or type(model).__name__ == "ModelBundle":

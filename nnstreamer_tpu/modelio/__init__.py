@@ -1,0 +1,96 @@
+"""Model-file ingestion (the reference's "load model= files" capability,
+`tensor_filter_common.c:1208` extension auto-detect +
+`tensor_filter_tensorflow_lite.cc` and friends).
+
+Formats:
+- `.tflite` — TFLite flatbuffer, parsed with a self-contained reader and
+  lowered to one fused XLA computation (quantized uint8/int8 models
+  dequantize → bf16; see tflite.py).
+- `.npz` — this framework's own serialized params format for zoo /
+  python-defined models (params_io.py).
+
+`load_model_file(path, **opts)` dispatches on extension and returns a
+`backends.xla.ModelBundle`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.modelio.params_io import load_params, save_params
+from nnstreamer_tpu.modelio.tflite import lower_tflite, parse_tflite
+
+#: extensions this package can ingest → default backend
+MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla"}
+
+
+def load_model_file(path: str, batch: Optional[int] = None,
+                    compute_dtype: str = "bfloat16",
+                    quantize_output: bool = True):
+    """Load a model file into a ModelBundle (extension-dispatched)."""
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    if not os.path.exists(path):
+        raise BackendError(
+            f"model file {path!r} does not exist; supported formats: "
+            f"{sorted(MODEL_EXTENSIONS)}")
+    ext = path.rsplit(".", 1)[-1].lower() if "." in path else ""
+
+    if ext == "tflite":
+        lowered = lower_tflite(parse_tflite(path), batch=batch,
+                               compute_dtype=compute_dtype,
+                               quantize_output=quantize_output)
+        mk = lambda shapes, dtypes: TensorsSpec(tensors=tuple(
+            TensorInfo(shape=tuple(s), dtype=DType.from_np(d))
+            for s, d in zip(shapes, dtypes)))
+        return ModelBundle(
+            fn=lowered.fn, params=lowered.params,
+            in_spec=mk(lowered.in_shapes, lowered.in_dtypes),
+            out_spec=mk(lowered.out_shapes, lowered.out_dtypes),
+            name=lowered.name)
+
+    if ext == "npz":
+        arch, params = load_params(path)
+        from nnstreamer_tpu.backends.xla import XLABackend
+        bundle = XLABackend()._resolve(arch)
+        bundle.params = params
+        bundle.name = f"{os.path.basename(path)}({arch})"
+        return bundle
+
+    raise BackendError(
+        f"unsupported model file extension {ext!r} for {path!r}; "
+        f"supported: {sorted(MODEL_EXTENSIONS)}")
+
+
+def parse_loader_opts(custom: str) -> Dict[str, Any]:
+    """Parse the filter's `custom=` option string into loader options
+    (reference custom-prop analog): "batch=8,dtype=float32,
+    quantize_output=false"."""
+    opts: Dict[str, Any] = {}
+    for part in (custom or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        k = k.strip()
+        v = v.strip()
+        if k == "batch":
+            try:
+                opts["batch"] = int(v)
+            except ValueError:
+                raise BackendError(
+                    f"custom option batch={v!r} is not an integer") from None
+        elif k in ("dtype", "compute_dtype"):
+            opts["compute_dtype"] = v
+        elif k == "quantize_output":
+            opts["quantize_output"] = v.lower() in ("1", "true", "yes")
+    return opts
+
+
+__all__ = ["load_model_file", "load_params", "save_params",
+           "parse_tflite", "lower_tflite", "parse_loader_opts",
+           "MODEL_EXTENSIONS"]

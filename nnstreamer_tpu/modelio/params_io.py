@@ -1,0 +1,87 @@
+"""Serialized params format (.npz) for zoo / python-defined models.
+
+The reference's tensor_filter loads weight *files* per framework; for
+models defined in this framework (zoo or user python), the equivalent is
+an `.npz` archive holding the params pytree plus a JSON header naming the
+architecture that rebuilds the forward fn:
+
+    save_params("m.npz", "zoo://mobilenet_v2?width=1.0", params)
+    ... tensor_filter model=m.npz ...
+
+The arch string is any model reference the XLA backend resolves
+(`zoo://name?args` or `pkg.module:attr`), so loading = resolve arch for
+the fn + substitute the stored params. Pytree structure (nested
+dict/list/tuple with array leaves) is preserved exactly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import BackendError
+
+_FORMAT = "nnstreamer-tpu-params-v1"
+
+
+def _flatten(tree: Any, out: list) -> Any:
+    """Structure skeleton with leaves replaced by param indices."""
+    if isinstance(tree, dict):
+        return {"__kind__": "dict",
+                "items": {k: _flatten(v, out) for k, v in tree.items()}}
+    if isinstance(tree, (list, tuple)):
+        kind = "list" if isinstance(tree, list) else "tuple"
+        return {"__kind__": kind, "items": [_flatten(v, out) for v in tree]}
+    if tree is None:
+        return {"__kind__": "none"}
+    idx = len(out)
+    out.append(np.asarray(tree))
+    return {"__kind__": "leaf", "index": idx}
+
+
+def _unflatten(skel: Any, leaves: list) -> Any:
+    kind = skel["__kind__"]
+    if kind == "dict":
+        return {k: _unflatten(v, leaves) for k, v in skel["items"].items()}
+    if kind == "list":
+        return [_unflatten(v, leaves) for v in skel["items"]]
+    if kind == "tuple":
+        return tuple(_unflatten(v, leaves) for v in skel["items"])
+    if kind == "none":
+        return None
+    return leaves[skel["index"]]
+
+
+def save_params(path: str, arch: str, params: Any) -> None:
+    """Write params + the arch reference that rebuilds the forward fn."""
+    leaves: list = []
+    skel = _flatten(params, leaves)
+    meta = json.dumps({"format": _FORMAT, "arch": arch, "tree": skel})
+    arrays = {f"p{i}": a for i, a in enumerate(leaves)}
+    np.savez(path, __meta__=np.frombuffer(meta.encode(), np.uint8), **arrays)
+
+
+def load_params(path: str) -> Tuple[str, Any]:
+    """→ (arch reference, params pytree)."""
+    with np.load(path, allow_pickle=False) as z:
+        if "__meta__" not in z:
+            raise BackendError(
+                f"{path!r} is not a {_FORMAT} archive (no __meta__ header); "
+                f"write it with nnstreamer_tpu.modelio.save_params")
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta.get("format") != _FORMAT:
+            raise BackendError(
+                f"{path!r}: unknown params format {meta.get('format')!r}")
+        leaves = [z[f"p{i}"] for i in range(_count_leaves(meta["tree"]))]
+    return meta["arch"], _unflatten(meta["tree"], leaves)
+
+
+def _count_leaves(skel: Any) -> int:
+    kind = skel["__kind__"]
+    if kind == "dict":
+        return sum(_count_leaves(v) for v in skel["items"].values())
+    if kind in ("list", "tuple"):
+        return sum(_count_leaves(v) for v in skel["items"])
+    return 1 if kind == "leaf" else 0

@@ -1,0 +1,665 @@
+"""TFLite model ingestion → one fused XLA computation.
+
+This is the TPU-native answer to the reference's TFLite filter subplugin
+(`ext/nnstreamer/tensor_filter/tensor_filter_tensorflow_lite.cc:154`
+`TFLiteInterpreter`): instead of handing the file to an interpreter that
+executes op-by-op on CPU, the flatbuffer graph is parsed (via the
+self-contained reader in `flatbuf.py`, no TFLite/TF dependency) and
+lowered to a single jax-traceable function. XLA then fuses the whole
+network — including the input dequantize and output quantize steps — into
+one TPU program.
+
+Quantization strategy ("dequantize → bf16"): quantized (uint8/int8)
+weights are dequantized **once at load time** with their per-tensor or
+per-channel scale/zero-point; activations run in a float compute dtype
+(bf16 on TPU, f32 accumulation in the MXU via preferred_element_type).
+Integer saturation semantics are approximated by clamping each op output
+to its tensor's representable quantized range — this subsumes fused
+ReLU/ReLU6 activations, whose bounds are baked into those ranges by the
+TFLite converter. Graph inputs/outputs keep their stored (possibly
+integer) dtype so pipeline specs match the reference's contract; the
+final output is re-quantized with the stored scale/zero-point.
+
+Op coverage targets the reference's own test models
+(mobilenet_v2_1.0_224_quant / deeplabv3 / add: CONV_2D,
+DEPTHWISE_CONV_2D, ADD, AVERAGE_POOL_2D, RESHAPE, …) plus the common
+CNN vocabulary; unsupported ops fail loudly with the op name.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.modelio.flatbuf import Reader
+
+log = get_logger("modelio.tflite")
+
+# -- TFLite schema constants (schema.fbs, stable public format) -----------
+
+# Model table field ids
+_MODEL_OPERATOR_CODES = 1
+_MODEL_SUBGRAPHS = 2
+_MODEL_BUFFERS = 4
+# OperatorCode
+_OPCODE_DEPRECATED_BUILTIN = 0
+_OPCODE_CUSTOM = 1
+_OPCODE_BUILTIN = 3
+# SubGraph
+_SG_TENSORS = 0
+_SG_INPUTS = 1
+_SG_OUTPUTS = 2
+_SG_OPERATORS = 3
+# Tensor
+_T_SHAPE = 0
+_T_TYPE = 1
+_T_BUFFER = 2
+_T_NAME = 3
+_T_QUANT = 4
+# QuantizationParameters
+_Q_SCALE = 2
+_Q_ZERO_POINT = 3
+_Q_QUANTIZED_DIM = 6
+# Operator
+_OP_OPCODE_INDEX = 0
+_OP_INPUTS = 1
+_OP_OUTPUTS = 2
+_OP_OPTIONS = 4
+# Buffer
+_BUF_DATA = 0
+
+# TensorType enum → numpy dtype
+_TENSOR_TYPES: Dict[int, np.dtype] = {
+    0: np.dtype(np.float32), 1: np.dtype(np.float16), 2: np.dtype(np.int32),
+    3: np.dtype(np.uint8), 4: np.dtype(np.int64), 6: np.dtype(np.bool_),
+    7: np.dtype(np.int16), 9: np.dtype(np.int8), 10: np.dtype(np.float64),
+}
+
+# BuiltinOperator enum values used below
+OP = dict(
+    ADD=0, AVERAGE_POOL_2D=1, CONCATENATION=2, CONV_2D=3,
+    DEPTHWISE_CONV_2D=4, DEQUANTIZE=6, FULLY_CONNECTED=9, LOGISTIC=14,
+    MAX_POOL_2D=17, MUL=18, RELU=19, RELU6=21, RESHAPE=22,
+    RESIZE_BILINEAR=23, SOFTMAX=25, TANH=28, PAD=34, TRANSPOSE=39,
+    MEAN=40, SUB=41, DIV=42, SQUEEZE=43, STRIDED_SLICE=45,
+    LOG_SOFTMAX=50, MAXIMUM=55, ARG_MAX=56, MINIMUM=57, SLICE=65,
+    EXPAND_DIMS=70, SUM=74, PACK=83, LEAKY_RELU=98, ABS=101,
+    RESIZE_NEAREST_NEIGHBOR=97, HARD_SWISH=117, QUANTIZE=114,
+)
+_OP_NAMES = {v: k for k, v in OP.items()}
+
+# ActivationFunctionType
+_ACT_NONE, _ACT_RELU, _ACT_RELU_N1_1, _ACT_RELU6 = 0, 1, 2, 3
+# Padding enum
+_PAD_SAME, _PAD_VALID = 0, 1
+
+
+@dataclass
+class TensorDef:
+    index: int
+    shape: Tuple[int, ...]
+    dtype: np.dtype
+    name: str
+    buffer: Optional[np.ndarray]          # raw constant data or None
+    scale: Optional[np.ndarray] = None    # quant scales ([] ⇒ not quantized)
+    zero_point: Optional[np.ndarray] = None
+    qdim: int = 0
+
+    @property
+    def quantized(self) -> bool:
+        return (self.scale is not None and self.scale.size > 0
+                and self.dtype.kind in "iu")
+
+
+@dataclass
+class OpDef:
+    code: int
+    name: str
+    inputs: List[int]
+    outputs: List[int]
+    opts: Optional[int]          # options table position in the flatbuffer
+    custom_name: Optional[str] = None
+
+
+@dataclass
+class TFLiteGraph:
+    reader: Reader
+    tensors: List[TensorDef]
+    ops: List[OpDef]
+    inputs: List[int]
+    outputs: List[int]
+    path: str = ""
+
+
+def parse_tflite(path: str) -> TFLiteGraph:
+    """Parse a .tflite flatbuffer into a graph description (host-side)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    if len(buf) < 8 or buf[4:8] != b"TFL3":
+        raise BackendError(
+            f"{path!r} is not a TFLite flatbuffer (missing TFL3 identifier)")
+    r = Reader(buf)
+    model = r.root()
+
+    # operator codes: deprecated_builtin_code (int8) was the original field;
+    # values >=127 moved to the int32 builtin_code field (schema v3a)
+    codes: List[Tuple[int, Optional[str]]] = []
+    for oc in r.field_vec_tables(model, _MODEL_OPERATOR_CODES):
+        dep = r.field_scalar(oc, _OPCODE_DEPRECATED_BUILTIN, "<b", 0)
+        full = r.field_scalar(oc, _OPCODE_BUILTIN, "<i", 0)
+        codes.append((max(dep, full), r.field_string(oc, _OPCODE_CUSTOM)))
+
+    buffers = r.field_vec_tables(model, _MODEL_BUFFERS)
+    subgraphs = r.field_vec_tables(model, _MODEL_SUBGRAPHS)
+    if not subgraphs:
+        raise BackendError(f"{path!r}: no subgraphs")
+    sg = subgraphs[0]
+
+    tensors: List[TensorDef] = []
+    for i, tpos in enumerate(r.field_vec_tables(sg, _SG_TENSORS)):
+        shape_v = r.field_vec_scalars(tpos, _T_SHAPE, np.int32)
+        shape = tuple(int(d) for d in shape_v) if shape_v is not None else ()
+        ttype = r.field_scalar(tpos, _T_TYPE, "<b", 0)
+        dtype = _TENSOR_TYPES.get(ttype)
+        if dtype is None:
+            raise BackendError(
+                f"{path!r}: tensor {i} has unsupported TensorType {ttype}")
+        buf_idx = r.field_scalar(tpos, _T_BUFFER, "<I", 0)
+        data = None
+        if buf_idx and buf_idx < len(buffers):
+            raw = r.field_vec_scalars(buffers[buf_idx], _BUF_DATA, np.uint8)
+            if raw is not None and raw.size:
+                data = raw.view(dtype).reshape(shape if shape else (-1,))
+        scale = zp = None
+        qdim = 0
+        q = r.field_table(tpos, _T_QUANT)
+        if q is not None:
+            scale = r.field_vec_scalars(q, _Q_SCALE, np.float32)
+            zp = r.field_vec_scalars(q, _Q_ZERO_POINT, np.int64)
+            qdim = r.field_scalar(q, _Q_QUANTIZED_DIM, "<i", 0)
+        tensors.append(TensorDef(
+            index=i, shape=shape, dtype=dtype,
+            name=r.field_string(tpos, _T_NAME) or f"t{i}",
+            buffer=data, scale=scale, zero_point=zp, qdim=qdim))
+
+    ops: List[OpDef] = []
+    for opos in r.field_vec_tables(sg, _SG_OPERATORS):
+        idx = r.field_scalar(opos, _OP_OPCODE_INDEX, "<I", 0)
+        code, custom = codes[idx]
+        ins = r.field_vec_scalars(opos, _OP_INPUTS, np.int32)
+        outs = r.field_vec_scalars(opos, _OP_OUTPUTS, np.int32)
+        ops.append(OpDef(
+            code=code, name=_OP_NAMES.get(code, f"builtin_{code}"),
+            inputs=[int(x) for x in (ins if ins is not None else [])],
+            outputs=[int(x) for x in (outs if outs is not None else [])],
+            opts=r.field_table(opos, _OP_OPTIONS), custom_name=custom))
+
+    g_in = r.field_vec_scalars(sg, _SG_INPUTS, np.int32)
+    g_out = r.field_vec_scalars(sg, _SG_OUTPUTS, np.int32)
+    return TFLiteGraph(
+        reader=r, tensors=tensors, ops=ops,
+        inputs=[int(x) for x in (g_in if g_in is not None else [])],
+        outputs=[int(x) for x in (g_out if g_out is not None else [])],
+        path=path)
+
+
+def _is_float(dtype) -> bool:
+    """True for any float dtype incl. ml_dtypes bfloat16 (whose numpy
+    `kind` is 'V', so `kind == 'f'` checks silently miss it)."""
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(dtype, jnp.floating)
+
+
+# -- load-time weight dequantization --------------------------------------
+
+def _dequantize_const(t: TensorDef) -> np.ndarray:
+    """Dequantize a constant tensor to float32 (per-tensor or per-channel)."""
+    data = t.buffer.astype(np.float32)
+    scale = t.scale.astype(np.float32)
+    zp = t.zero_point.astype(np.float32) if t.zero_point is not None else \
+        np.zeros_like(scale)
+    if scale.size == 1:
+        return (data - zp[0]) * scale[0]
+    bshape = [1] * data.ndim
+    bshape[t.qdim] = scale.size
+    return (data - zp.reshape(bshape)) * scale.reshape(bshape)
+
+
+def _qrange(t: TensorDef) -> Tuple[float, float]:
+    """Float range representable by a quantized tensor (for saturation)."""
+    info = np.iinfo(t.dtype)
+    s = float(t.scale[0])
+    z = float(t.zero_point[0]) if t.zero_point is not None else 0.0
+    return (info.min - z) * s, (info.max - z) * s
+
+
+# -- lowering ---------------------------------------------------------------
+
+@dataclass
+class LoweredModel:
+    fn: Callable          # fn(params, *inputs) -> tuple of outputs
+    params: Dict[str, Any]
+    in_shapes: List[Tuple[int, ...]]
+    in_dtypes: List[np.dtype]
+    out_shapes: List[Tuple[int, ...]]
+    out_dtypes: List[np.dtype]
+    name: str = ""
+
+
+def lower_tflite(graph: TFLiteGraph, batch: Optional[int] = None,
+                 compute_dtype: str = "bfloat16",
+                 quantize_output: bool = True) -> LoweredModel:
+    """Lower a parsed graph to a jax-traceable fn + params pytree.
+
+    batch: override the file's (usually 1) leading batch dimension.
+    compute_dtype: activation dtype ("bfloat16" on TPU, "float32" exact).
+    quantize_output: re-quantize integer graph outputs (spec parity with
+      the file); False emits dequantized float outputs.
+    """
+    import jax.numpy as jnp
+
+    orig_batch = None
+    if batch is not None and graph.inputs:
+        in0 = graph.tensors[graph.inputs[0]]
+        orig_batch = in0.shape[0] if in0.shape else None
+
+    def bshape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        if batch is not None and shape and shape[0] == orig_batch:
+            return (batch,) + shape[1:]
+        return shape
+
+    # params: all dequantized / raw constants, keyed by tensor index.
+    # Shape-only constants (reshape targets, pad widths, reduce axes) stay
+    # host-side: they must be static at trace time.
+    params: Dict[str, Any] = {}
+    static_consts: Dict[int, np.ndarray] = {}
+    consumed_as_static = _static_input_indices(graph)
+    for t in graph.tensors:
+        if t.buffer is None:
+            continue
+        if t.index in consumed_as_static:
+            static_consts[t.index] = np.asarray(t.buffer)
+            continue
+        arr = _dequantize_const(t) if t.quantized else np.asarray(t.buffer)
+        params[f"t{t.index}"] = arr
+
+    cdt = jnp.dtype(compute_dtype)
+    ops = list(graph.ops)
+    tensors = graph.tensors
+
+    def fn(p, *inputs):
+        if len(inputs) != len(graph.inputs):
+            raise BackendError(
+                f"model {graph.path!r} expects {len(graph.inputs)} inputs, "
+                f"got {len(inputs)}")
+        vals: Dict[int, Any] = {}
+        for idx, x in zip(graph.inputs, inputs):
+            t = tensors[idx]
+            x = jnp.asarray(x)
+            if t.quantized:
+                s = float(t.scale[0])
+                z = float(t.zero_point[0]) if t.zero_point is not None else 0.0
+                x = (x.astype(jnp.float32) - z) * s
+            vals[idx] = x.astype(cdt) if _is_float(x.dtype) else x
+
+        def get(i):
+            if i in vals:
+                return vals[i]
+            key = f"t{i}"
+            if key in p:
+                arr = jnp.asarray(p[key])
+                return arr.astype(cdt) if _is_float(arr.dtype) else arr
+            raise BackendError(
+                f"op input tensor {i} ({tensors[i].name!r}) has no value "
+                f"(dynamic graph order not supported)")
+
+        for op in ops:
+            out = _eval_op(graph, op, get, static_consts, jnp, cdt)
+            outs = out if isinstance(out, tuple) else (out,)
+            for oi, o in zip(op.outputs, outs):
+                ot = tensors[oi]
+                if ot.quantized and _is_float(o.dtype):
+                    lo, hi = _qrange(ot)
+                    o = jnp.clip(o, lo, hi)
+                vals[oi] = o
+
+        results = []
+        for idx in graph.outputs:
+            t = tensors[idx]
+            y = vals[idx]
+            if t.quantized and quantize_output:
+                s = float(t.scale[0])
+                z = float(t.zero_point[0]) if t.zero_point is not None else 0.0
+                info = np.iinfo(t.dtype)
+                q = jnp.round(y.astype(jnp.float32) / s) + z
+                y = jnp.clip(q, info.min, info.max).astype(t.dtype)
+            elif _is_float(y.dtype):
+                y = y.astype(jnp.float32)
+            results.append(y)
+        return tuple(results)
+
+    def io_dtype(t: TensorDef, is_out: bool) -> np.dtype:
+        if t.quantized and (not is_out or quantize_output):
+            return t.dtype
+        return np.dtype(np.float32) if t.dtype.kind == "f" or t.quantized \
+            else t.dtype
+
+    return LoweredModel(
+        fn=fn, params=params,
+        in_shapes=[bshape(tensors[i].shape) for i in graph.inputs],
+        in_dtypes=[io_dtype(tensors[i], False) for i in graph.inputs],
+        out_shapes=[bshape(tensors[i].shape) for i in graph.outputs],
+        out_dtypes=[io_dtype(tensors[i], True) for i in graph.outputs],
+        name=os.path.basename(graph.path))
+
+
+def _static_input_indices(graph: TFLiteGraph) -> set:
+    """Tensor indices consumed as static shape/axis/padding arguments."""
+    static = set()
+    for op in graph.ops:
+        ins = op.inputs
+        if op.code == OP["RESHAPE"] and len(ins) > 1:
+            static.add(ins[1])
+        elif op.code in (OP["MEAN"], OP["SUM"]) and len(ins) > 1:
+            static.add(ins[1])
+        elif op.code == OP["PAD"] and len(ins) > 1:
+            static.add(ins[1])
+        elif op.code == OP["TRANSPOSE"] and len(ins) > 1:
+            static.add(ins[1])
+        elif op.code == OP["ARG_MAX"] and len(ins) > 1:
+            static.add(ins[1])
+        elif op.code == OP["EXPAND_DIMS"] and len(ins) > 1:
+            static.add(ins[1])
+        elif op.code in (OP["RESIZE_BILINEAR"],
+                         OP["RESIZE_NEAREST_NEIGHBOR"]) and len(ins) > 1:
+            static.add(ins[1])
+        elif op.code in (OP["SLICE"], OP["STRIDED_SLICE"]):
+            static.update(ins[1:])
+    return static
+
+
+def _resize(jnp, x, oh: int, ow: int, bilinear: bool,
+            align_corners: bool, half_pixel: bool):
+    """TFLite-exact NHWC spatial resize via gather + lerp.
+
+    TFLite has three coordinate maps (kernels/internal resize impls):
+    align_corners: src = i*(in-1)/(out-1); half_pixel_centers:
+    src = (i+0.5)*in/out - 0.5; legacy/default: src = i*in/out.
+    jax.image.resize only offers the half-pixel map, so do it by hand —
+    gathers on constant indices fold into cheap XLA ops.
+    """
+    import numpy as onp
+
+    b, h, w, c = x.shape
+
+    def coords(out_n: int, in_n: int) -> onp.ndarray:
+        i = onp.arange(out_n, dtype=onp.float64)
+        if align_corners and out_n > 1:
+            return i * (in_n - 1) / (out_n - 1)
+        if half_pixel:
+            return onp.maximum((i + 0.5) * in_n / out_n - 0.5, 0.0)
+        return i * in_n / out_n
+
+    ys, xs = coords(oh, h), coords(ow, w)
+    if not bilinear:
+        # TFLite nearest rounds half away from zero (std::round, not
+        # numpy's half-to-even) when align_corners, else floors
+        yi = onp.minimum(onp.floor(ys + 0.5) if align_corners
+                         else onp.floor(ys), h - 1).astype(onp.int32)
+        xi = onp.minimum(onp.floor(xs + 0.5) if align_corners
+                         else onp.floor(xs), w - 1).astype(onp.int32)
+        return jnp.take(jnp.take(x, yi, axis=1), xi, axis=2)
+
+    y0 = onp.clip(onp.floor(ys).astype(onp.int32), 0, h - 1)
+    x0 = onp.clip(onp.floor(xs).astype(onp.int32), 0, w - 1)
+    y1 = onp.minimum(y0 + 1, h - 1)
+    x1 = onp.minimum(x0 + 1, w - 1)
+    wy = jnp.asarray((ys - y0), x.dtype).reshape(1, oh, 1, 1)
+    wx = jnp.asarray((xs - x0), x.dtype).reshape(1, 1, ow, 1)
+    top = jnp.take(x, y0, axis=1)
+    bot = jnp.take(x, y1, axis=1)
+    tl, tr = jnp.take(top, x0, axis=2), jnp.take(top, x1, axis=2)
+    bl, br = jnp.take(bot, x0, axis=2), jnp.take(bot, x1, axis=2)
+    t = tl * (1 - wx) + tr * wx
+    bm = bl * (1 - wx) + br * wx
+    return t * (1 - wy) + bm * wy
+
+
+# -- per-op evaluation ------------------------------------------------------
+
+def _act(jnp, x, act: int):
+    if act == _ACT_NONE:
+        return x
+    if act == _ACT_RELU:
+        return jnp.maximum(x, 0)
+    if act == _ACT_RELU_N1_1:
+        return jnp.clip(x, -1, 1)
+    if act == _ACT_RELU6:
+        return jnp.clip(x, 0, 6)
+    raise BackendError(f"unsupported fused activation {act}")
+
+
+def _pad_str(padding: int) -> str:
+    return "SAME" if padding == _PAD_SAME else "VALID"
+
+
+def _eval_op(graph: TFLiteGraph, op: OpDef, get, static_consts, jnp, cdt):
+    import jax
+    from jax import lax
+
+    r = graph.reader
+    o = op.opts
+    code = op.code
+
+    def opt_i(fid, default=0):
+        return r.field_scalar(o, fid, "<i", default) if o is not None \
+            else default
+
+    def opt_b(fid, default=0):
+        return r.field_scalar(o, fid, "<b", default) if o is not None \
+            else default
+
+    def opt_f(fid, default=0.0):
+        return r.field_scalar(o, fid, "<f", default) if o is not None \
+            else default
+
+    def static(i):
+        if i in static_consts:
+            return static_consts[i]
+        t = graph.tensors[i]
+        if t.buffer is not None:
+            return np.asarray(t.buffer)
+        raise BackendError(
+            f"{op.name}: input tensor {i} must be a compile-time constant")
+
+    if code == OP["CONV_2D"]:
+        x = get(op.inputs[0])
+        w = get(op.inputs[1])                      # OHWI
+        stride = (opt_i(2, 1), opt_i(1, 1))        # (h, w)
+        dil = (opt_i(5, 1), opt_i(4, 1))
+        y = lax.conv_general_dilated(
+            x, jnp.transpose(w, (1, 2, 3, 0)),     # → HWIO
+            window_strides=stride, padding=_pad_str(opt_b(0)),
+            rhs_dilation=dil,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if len(op.inputs) > 2 and op.inputs[2] >= 0:
+            y = y + get(op.inputs[2]).astype(jnp.float32)
+        return _act(jnp, y.astype(cdt), opt_b(3))
+
+    if code == OP["DEPTHWISE_CONV_2D"]:
+        x = get(op.inputs[0])
+        w = get(op.inputs[1])                      # [1, H, W, C*mult]
+        stride = (opt_i(2, 1), opt_i(1, 1))
+        dil = (opt_i(6, 1), opt_i(5, 1))
+        c_in = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x, jnp.transpose(w, (1, 2, 0, 3)),     # → (H, W, 1, C*mult)
+            window_strides=stride, padding=_pad_str(opt_b(0)),
+            rhs_dilation=dil, feature_group_count=c_in,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if len(op.inputs) > 2 and op.inputs[2] >= 0:
+            y = y + get(op.inputs[2]).astype(jnp.float32)
+        return _act(jnp, y.astype(cdt), opt_b(4))
+
+    if code == OP["FULLY_CONNECTED"]:
+        x = get(op.inputs[0])
+        w = get(op.inputs[1])                      # [out, in]
+        if x.ndim != 2:
+            # TFLite batch = total_size / in_features, not the leading dim
+            x = x.reshape((-1, w.shape[-1]))
+        y = jnp.dot(x, w.T, preferred_element_type=jnp.float32)
+        if len(op.inputs) > 2 and op.inputs[2] >= 0:
+            y = y + get(op.inputs[2]).astype(jnp.float32)
+        return _act(jnp, y.astype(cdt), opt_b(0))
+
+    if code in (OP["AVERAGE_POOL_2D"], OP["MAX_POOL_2D"]):
+        x = get(op.inputs[0])
+        stride = (1, opt_i(2, 1), opt_i(1, 1), 1)
+        window = (1, opt_i(4, 1), opt_i(3, 1), 1)
+        padding = _pad_str(opt_b(0))
+        if code == OP["MAX_POOL_2D"]:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, stride,
+                                  padding)
+        else:
+            s = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add,
+                                  window, stride, padding)
+            ones = jnp.ones(x.shape[1:3], jnp.float32)[None, :, :, None]
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, stride,
+                                    padding)
+            y = (s / cnt).astype(cdt)
+        return _act(jnp, y, opt_b(5))
+
+    if code in (OP["ADD"], OP["MUL"], OP["SUB"], OP["DIV"],
+                OP["MAXIMUM"], OP["MINIMUM"]):
+        a, b = get(op.inputs[0]), get(op.inputs[1])
+        f = {OP["ADD"]: jnp.add, OP["MUL"]: jnp.multiply,
+             OP["SUB"]: jnp.subtract, OP["DIV"]: jnp.divide,
+             OP["MAXIMUM"]: jnp.maximum, OP["MINIMUM"]: jnp.minimum}[code]
+        act = opt_b(0) if code in (OP["ADD"], OP["MUL"], OP["SUB"],
+                                   OP["DIV"]) else _ACT_NONE
+        return _act(jnp, f(a, b), act)
+
+    if code == OP["RESHAPE"]:
+        x = get(op.inputs[0])
+        if len(op.inputs) > 1:
+            shape = [int(d) for d in static(op.inputs[1]).ravel()]
+        else:
+            shape = [int(d) for d in
+                     (r.field_vec_scalars(o, 0, np.int32) or [])]
+        out_t = graph.tensors[op.outputs[0]]
+        if len(shape) == len(out_t.shape) and shape and \
+                x.shape[0] != shape[0] and shape[0] == out_t.shape[0]:
+            shape[0] = -1          # batch-override: keep runtime batch
+        return x.reshape(shape)
+
+    if code == OP["SQUEEZE"]:
+        x = get(op.inputs[0])
+        dims = r.field_vec_scalars(o, 0, np.int32) if o is not None else None
+        if dims is None or len(dims) == 0:
+            return jnp.squeeze(x)
+        return jnp.squeeze(x, axis=tuple(int(d) for d in dims))
+
+    if code == OP["EXPAND_DIMS"]:
+        x = get(op.inputs[0])
+        axis = int(static(op.inputs[1]).ravel()[0])
+        return jnp.expand_dims(x, axis)
+
+    if code == OP["SOFTMAX"]:
+        x = get(op.inputs[0])
+        beta = opt_f(0, 1.0)
+        return jax.nn.softmax(x.astype(jnp.float32) * beta,
+                              axis=-1).astype(cdt)
+
+    if code == OP["LOG_SOFTMAX"]:
+        x = get(op.inputs[0])
+        return jax.nn.log_softmax(x.astype(jnp.float32), axis=-1).astype(cdt)
+
+    if code in (OP["MEAN"], OP["SUM"]):
+        x = get(op.inputs[0])
+        axes = tuple(int(a) for a in static(op.inputs[1]).ravel())
+        keep = bool(opt_b(0))
+        red = jnp.mean if code == OP["MEAN"] else jnp.sum
+        return red(x, axis=axes, keepdims=keep)
+
+    if code == OP["PAD"]:
+        x = get(op.inputs[0])
+        pads = static(op.inputs[1]).reshape(-1, 2)
+        return jnp.pad(x, [(int(a), int(b)) for a, b in pads])
+
+    if code == OP["CONCATENATION"]:
+        axis = opt_i(0, 0)
+        return _act(jnp,
+                    jnp.concatenate([get(i) for i in op.inputs], axis=axis),
+                    opt_b(1))
+
+    if code == OP["TRANSPOSE"]:
+        x = get(op.inputs[0])
+        perm = [int(p) for p in static(op.inputs[1]).ravel()]
+        return jnp.transpose(x, perm)
+
+    if code in (OP["RESIZE_BILINEAR"], OP["RESIZE_NEAREST_NEIGHBOR"]):
+        x = get(op.inputs[0])
+        hw = static(op.inputs[1]).ravel()
+        # ResizeBilinearOptions/ResizeNearestNeighborOptions:
+        # align_corners(2 / 0), half_pixel_centers(3 / 1)
+        if code == OP["RESIZE_BILINEAR"]:
+            align, half_pixel = bool(opt_b(2)), bool(opt_b(3))
+        else:
+            align, half_pixel = bool(opt_b(0)), bool(opt_b(1))
+        return _resize(jnp, x, int(hw[0]), int(hw[1]),
+                       bilinear=code == OP["RESIZE_BILINEAR"],
+                       align_corners=align, half_pixel=half_pixel)
+
+    if code == OP["LOGISTIC"]:
+        return jax.nn.sigmoid(get(op.inputs[0]))
+    if code == OP["RELU"]:
+        return jnp.maximum(get(op.inputs[0]), 0)
+    if code == OP["RELU6"]:
+        return jnp.clip(get(op.inputs[0]), 0, 6)
+    if code == OP["TANH"]:
+        return jnp.tanh(get(op.inputs[0]))
+    if code == OP["HARD_SWISH"]:
+        x = get(op.inputs[0])
+        return x * jnp.clip(x + 3.0, 0, 6) / 6.0
+    if code == OP["LEAKY_RELU"]:
+        x = get(op.inputs[0])
+        return jnp.where(x >= 0, x, x * opt_f(0, 0.01))
+    if code == OP["ABS"]:
+        return jnp.abs(get(op.inputs[0]))
+
+    if code in (OP["DEQUANTIZE"], OP["QUANTIZE"]):
+        # activations already live in the float compute domain; quant
+        # boundaries are handled at graph inputs/outputs
+        return get(op.inputs[0])
+
+    if code == OP["ARG_MAX"]:
+        x = get(op.inputs[0])
+        axis = int(static(op.inputs[1]).ravel()[0])
+        out_dt = graph.tensors[op.outputs[0]].dtype
+        return jnp.argmax(x, axis=axis).astype(out_dt)
+
+    if code == OP["SLICE"]:
+        x = get(op.inputs[0])
+        begin = [int(v) for v in static(op.inputs[1]).ravel()]
+        size = [int(v) for v in static(op.inputs[2]).ravel()]
+        size = [x.shape[i] - begin[i] if s == -1 else s
+                for i, s in enumerate(size)]
+        return lax.slice(x, begin, [b + s for b, s in zip(begin, size)])
+
+    if code == OP["PACK"]:
+        axis = opt_i(1, 0)
+        return jnp.stack([get(i) for i in op.inputs], axis=axis)
+
+    raise BackendError(
+        f"TFLite op {op.name} (builtin code {code}"
+        + (f", custom {op.custom_name!r}" if op.custom_name else "")
+        + f") in {graph.path!r} is not supported by the XLA lowering; "
+        f"supported: {sorted(OP)}")
