@@ -62,6 +62,12 @@ class FilterBackend:
         """Run the model on one frame's tensors (the hot loop)."""
         raise NotImplementedError
 
+    def invoke_flexible(self, regions: Sequence[Any]) -> Sequence[Any]:
+        """Run the model over variable-shape per-buffer regions (FLEXIBLE
+        streams, e.g. tensor_crop output). Default: one invoke per
+        region; XLABackend overrides with batched + bucketed compiles."""
+        return [self.invoke((r,))[0] for r in regions]
+
     def reload(self, model: Any) -> None:
         raise BackendError(
             f"backend {self.BACKEND_NAME!r} does not support model reload"

@@ -68,6 +68,11 @@ class TensorFilter(Element):
                                       "i<n>=input passthrough / o<n>=output picks"),
         "latency_mode": PropDef(str, "async", "async|sync stats timing"),
         "is_updatable": PropDef(lambda s: str(s).lower() in ("1", "true"), False),
+        "invoke_dynamic": PropDef(
+            lambda s: str(s).lower() in ("1", "true"), False,
+            "accept FLEXIBLE input (per-buffer shapes, bucketed recompile)"),
+        "shared_tensor_filter_key": PropDef(
+            str, "", "share one device model across filters with this key"),
     }
 
     def __init__(self, name=None, **props):
@@ -85,6 +90,7 @@ class TensorFilter(Element):
         self._lat_window = deque(maxlen=10)   # last-10 window, ref :443-455
         self._invoke_count = 0
         self._t_start = None
+        self._flexible = False
 
     # -- combination parsing ----------------------------------------------
     @staticmethod
@@ -138,6 +144,8 @@ class TensorFilter(Element):
         return cfg.get("filter", "default_backend") or "xla"
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        from nnstreamer_tpu.tensor.info import TensorFormat
+
         spec = self.expect_tensors(in_specs[0])
         fw = self._framework_name()
         try:
@@ -152,6 +160,31 @@ class TensorFilter(Element):
 
         if self._pre is not None or self._post is not None:
             self._fused_in_backend = self.backend.fuse(self._pre, self._post)
+
+        if spec.format == TensorFormat.FLEXIBLE:
+            if self._in_combination is not None or \
+                    self._out_combination is not None:
+                self.fail_negotiation(
+                    "input-/output-combination cannot apply to a FLEXIBLE "
+                    "stream (per-buffer region count, no fixed tensor "
+                    "indices); remove the combination properties or make "
+                    "the stream static with tensor_resize")
+            if not self.props["invoke_dynamic"]:
+                self.fail_negotiation(
+                    "input stream is FLEXIBLE (per-buffer shapes, e.g. from "
+                    "tensor_crop) but invoke-dynamic is off. Either set "
+                    "invoke_dynamic=true (shape-bucketed recompile; model "
+                    "must accept the per-buffer shapes — use "
+                    "custom=dynamic_spatial=true for shape-polymorphic "
+                    "models) or insert `tensor_resize size=H:W` to make the "
+                    "stream static")
+            self._flexible = True
+            # per-region output shapes are only known per buffer
+            model_out = self.backend.get_model_info()[1]
+            out_tensors = model_out.tensors if model_out is not None else ()
+            return [TensorsSpec(tensors=out_tensors,
+                                format=TensorFormat.FLEXIBLE,
+                                rate=spec.rate)]
 
         from nnstreamer_tpu.graph.optimize import transfer_spec
 
@@ -221,6 +254,8 @@ class TensorFilter(Element):
 
     # -- hot loop (reference §3.2) -----------------------------------------
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        if self._flexible:
+            return self._process_flexible(buf)
         inputs = buf.tensors
         if self._in_combination is not None:
             inputs = tuple(inputs[i] for i in self._in_combination)
@@ -247,6 +282,29 @@ class TensorFilter(Element):
                 sel.append(buf.tensors[idx] if kind == "i" else outputs[idx])
             outputs = tuple(sel)
         return [(0, buf.with_tensors(outputs))]
+
+    def _process_flexible(self, buf: TensorBuffer) -> List[Emission]:
+        """FLEXIBLE buffer = N variable-shape regions; one model output per
+        region (invoke-dynamic). Host-side fused chains apply per region."""
+        regions = list(buf.tensors)
+        if self._pre is not None and not self._fused_in_backend:
+            regions = [self._pre((r,))[0] for r in regions]
+        t0 = time.perf_counter()
+        try:
+            outputs = list(self.backend.invoke_flexible(regions))
+        except Exception as e:
+            raise BackendError(
+                f"tensor_filter {self.name}: flexible invoke failed on "
+                f"frame pts={buf.pts} with region shapes "
+                f"{[tuple(np_shape(r)) for r in regions]}: {e}"
+            ) from e
+        if self._post is not None and not self._fused_in_backend:
+            outputs = [self._post((o,))[0] for o in outputs]
+        if self.props["latency_mode"] == "sync":
+            outputs = [_block(o) for o in outputs]
+        self._lat_window.append(time.perf_counter() - t0)
+        self._invoke_count += 1
+        return [(0, buf.with_tensors(tuple(outputs)))]
 
     # -- stats (reference latency/throughput props) ------------------------
     @property
@@ -276,3 +334,9 @@ class TensorFilter(Element):
 
 def _block(x):
     return x.block_until_ready() if hasattr(x, "block_until_ready") else x
+
+
+def np_shape(x):
+    import numpy as np
+
+    return np.asarray(x).shape if not hasattr(x, "shape") else x.shape

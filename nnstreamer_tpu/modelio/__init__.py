@@ -88,6 +88,10 @@ def parse_loader_opts(custom: str) -> Dict[str, Any]:
             opts["compute_dtype"] = v
         elif k == "quantize_output":
             opts["quantize_output"] = v.lower() in ("1", "true", "yes")
+        elif k == "dynamic_spatial":
+            # consumed by XLABackend (flexible-shape spatial bucketing),
+            # not by the file loaders
+            opts["dynamic_spatial"] = v.lower() in ("1", "true", "yes")
     return opts
 
 

@@ -1,0 +1,263 @@
+"""Flexible-shape inference + shared device-param table (VERDICT r1
+items 4 & 5).
+
+- invoke-dynamic: FLEXIBLE streams (tensor_crop regions) through
+  tensor_filter with batch-stacked, bucketed, bounded recompiles —
+  compile-count assertions prove the bucketing policy.
+- shared-tensor-filter-key: N filters on one model hold ONE device
+  params copy; hot reload through one holder propagates to all
+  (reference tensor_filter_common.c:2911-3046).
+"""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.backends.xla import ModelBundle, XLABackend, _shared_models
+from nnstreamer_tpu.core.errors import NegotiationError, PipelineError
+from nnstreamer_tpu.elements import AppSrc, TensorCrop, TensorSink
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorInfo, TensorsSpec
+
+from test_elements import run_graph, spec_of
+
+
+def _poly_sum_bundle():
+    """Shape-polymorphic, padding-invariant toy model: spatial sum →
+    fixed 5-dim projection. Zero-padding spatial dims does not change
+    the output, so bucket padding is exactly testable."""
+    import jax.numpy as jnp
+
+    W = np.linspace(-1, 1, 3 * 5, dtype=np.float32).reshape(3, 5)
+
+    def fn(params, x):
+        s = jnp.sum(x.astype(jnp.float32), axis=(-3, -2))   # (..., C)
+        return s @ params["w"]
+
+    return ModelBundle(fn=fn, params={"w": W}, name="poly_sum")
+
+
+def _regions(shapes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 255, s).astype(np.uint8) for s in shapes]
+
+
+def _flex_buf(regions, pts=0):
+    return TensorBuffer(tensors=tuple(regions), pts=pts,
+                        format=TensorFormat.FLEXIBLE)
+
+
+# -- backend-level: bucketing policy ----------------------------------------
+
+def test_invoke_flexible_batches_same_shape_regions():
+    be = XLABackend()
+    be.open({"model": _poly_sum_bundle(), "custom": ""})
+    regions = _regions([(1, 8, 8, 3)] * 3)
+    out = be.invoke_flexible(list(regions))
+    assert len(out) == 3
+    # one batched compile for the whole same-shape group
+    assert be.compile_count == 1
+    for r, o in zip(regions, out):
+        expect = r.astype(np.float32).sum((1, 2)) @ np.linspace(
+            -1, 1, 15, dtype=np.float32).reshape(3, 5)
+        np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-5)
+    # 2 regions of the same shape: batch bucket 2 ⇒ new compile;
+    # repeating either count reuses the cache
+    be.invoke_flexible(list(_regions([(1, 8, 8, 3)] * 2)))
+    assert be.compile_count == 2
+    be.invoke_flexible(list(_regions([(1, 8, 8, 3)] * 4)))
+    be.invoke_flexible(list(_regions([(1, 8, 8, 3)] * 3)))
+    assert be.compile_count == 2  # 3 pads into the 4-bucket
+
+
+def test_invoke_flexible_spatial_bucketing():
+    be = XLABackend()
+    be.open({"model": _poly_sum_bundle(),
+             "custom": "dynamic_spatial=true"})
+    # 20x30 and 25x31 both bucket to 32x32 ⇒ ONE compile
+    be.invoke_flexible(_regions([(1, 20, 30, 3)]))
+    n0 = be.compile_count
+    be.invoke_flexible(_regions([(1, 25, 31, 3)]))
+    assert be.compile_count == n0
+    # 50x60 buckets to 64x64 ⇒ one more
+    be.invoke_flexible(_regions([(1, 50, 60, 3)]))
+    assert be.compile_count == n0 + 1
+    # padding-invariant model ⇒ padded result equals direct eval
+    r = _regions([(1, 17, 9, 3)], seed=3)[0]
+    (o,) = be.invoke_flexible([r])
+    expect = r.astype(np.float32).sum((1, 2)) @ np.linspace(
+        -1, 1, 15, dtype=np.float32).reshape(3, 5)
+    np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-5)
+
+
+def test_invoke_flexible_cache_is_bounded():
+    be = XLABackend()
+    be.open({"model": _poly_sum_bundle(),
+             "custom": "dynamic_spatial=true"})
+    be._dyn_cache_max = 2
+    shapes = [(1, 20, 20, 3), (1, 50, 50, 3), (1, 100, 100, 3)]
+    for s in shapes:
+        be.invoke_flexible(_regions([s]))
+    n = be.compile_count
+    assert len(be._dyn_jits) <= 2
+    # the oldest bucket was evicted ⇒ revisiting it recompiles
+    be.invoke_flexible(_regions([shapes[0]]))
+    assert be.compile_count == n + 1
+
+
+def test_invoke_flexible_sequential_fallback_for_fixed_batch_model():
+    """A model with a baked-in batch (shape-checked) can't be stacked:
+    the eval_shape probe fails and regions run one-by-one."""
+    import jax.numpy as jnp
+
+    def rigid(params, x):
+        assert x.shape[0] == 1, "batch is baked in"
+        return jnp.sum(x.astype(jnp.float32), axis=(1, 2))
+
+    be = XLABackend()
+    be.open({"model": ModelBundle(fn=rigid, params=None), "custom": ""})
+    out = be.invoke_flexible(list(_regions([(1, 4, 4, 3)] * 3)))
+    assert len(out) == 3 and np.asarray(out[0]).shape == (1, 3)
+
+
+# -- pipeline-level: crop → filter (invoke-dynamic) --------------------------
+
+def test_crop_filter_invoke_dynamic_pipeline():
+    raw_spec = spec_of((1, 16, 16, 3), dtype=DType.UINT8)
+    src = AppSrc(spec=raw_spec, name="raw")
+    info = AppSrc(spec=spec_of((2, 4), dtype=DType.UINT32), name="info")
+    crop = TensorCrop(name="c")
+    filt = TensorFilter(name="f", model=_poly_sum_bundle(),
+                        invoke_dynamic="true",
+                        custom="dynamic_spatial=true")
+    sink = TensorSink(name="s")
+    img = np.arange(16 * 16 * 3, dtype=np.uint8).reshape(1, 16, 16, 3)
+    regions = np.array([[2, 1, 4, 3], [0, 0, 8, 8]], np.uint32)
+    pipe = run_graph(
+        [src, info, crop, filt, sink],
+        [(src, crop, 0, 0), (info, crop, 0, 1), (crop, filt), (filt, sink)],
+        {"raw": [TensorBuffer.of(img, pts=0)],
+         "info": [TensorBuffer.of(regions, pts=0)]})
+    out = pipe.get("s").results[0]
+    assert out.format == TensorFormat.FLEXIBLE
+    assert len(out.tensors) == 2
+    W = np.linspace(-1, 1, 15, dtype=np.float32).reshape(3, 5)
+    for (x, y, w, h), o in zip(regions, out.tensors):
+        patch = img[:, y:y + h, x:x + w]
+        np.testing.assert_allclose(
+            np.asarray(o), patch.astype(np.float32).sum((1, 2)) @ W,
+            rtol=1e-5)
+
+
+def test_crop_resize_filter_static_pipeline():
+    """The semantic fixed-model path: crop → tensor_resize → filter."""
+    from nnstreamer_tpu.backends.custom import register_custom_easy
+
+    register_custom_easy("mean8", lambda ts: (
+        np.asarray(ts[0], np.float32).mean(axis=(0, 1), keepdims=False)[None],))
+    raw_spec = spec_of((16, 16, 3), dtype=DType.UINT8)
+    src = AppSrc(spec=raw_spec, name="raw")
+    info = AppSrc(spec=spec_of((2, 4), dtype=DType.UINT32), name="info")
+    crop = TensorCrop(name="c")
+    from nnstreamer_tpu.elements.transform import TensorResize
+
+    rs = TensorResize(name="r", size="8:8", channels=3)
+    filt = TensorFilter(name="f", framework="custom", model="mean8")
+    sink = TensorSink(name="s")
+    img = np.arange(16 * 16 * 3, dtype=np.uint8).reshape(16, 16, 3)
+    regions = np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.uint32)
+    pipe = run_graph(
+        [src, info, crop, rs, filt, sink],
+        [(src, crop, 0, 0), (info, crop, 0, 1), (crop, rs), (rs, filt),
+         (filt, sink)],
+        {"raw": [TensorBuffer.of(img, pts=0)],
+         "info": [TensorBuffer.of(regions, pts=0)]})
+    res = pipe.get("s").results
+    assert len(res) == 2  # one STATIC buffer per region
+    assert res[0].meta["num_regions"] == 2
+
+
+def test_flexible_without_invoke_dynamic_fails_actionably():
+    raw_spec = spec_of((1, 8, 8, 3), dtype=DType.UINT8)
+    src = AppSrc(spec=raw_spec, name="raw")
+    info = AppSrc(spec=spec_of((1, 4), dtype=DType.UINT32), name="info")
+    crop = TensorCrop(name="c")
+    filt = TensorFilter(name="f", model=_poly_sum_bundle())
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (src, info, crop, filt, sink):
+        pipe.add(e)
+    pipe.link(src, crop, 0, 0)
+    pipe.link(info, crop, 0, 1)
+    pipe.link(crop, filt)
+    pipe.link(filt, sink)
+    with pytest.raises((NegotiationError, PipelineError),
+                       match="invoke.dynamic|tensor_resize"):
+        nns.PipelineRunner(pipe).start()
+
+
+# -- shared device-param table ----------------------------------------------
+
+def test_shared_key_dedupes_device_params():
+    _shared_models.clear()
+    b1 = XLABackend()
+    b2 = XLABackend()
+    bundle = _poly_sum_bundle()
+    b1.open({"model": bundle, "shared_tensor_filter_key": "k1"})
+    b2.open({"model": bundle, "shared_tensor_filter_key": "k1"})
+    # literally the same device arrays (one HBM copy)
+    assert b1._current_params()["w"] is b2._current_params()["w"]
+    x = np.ones((1, 4, 4, 3), np.uint8)
+    np.testing.assert_allclose(np.asarray(b1.invoke((x,))[0]),
+                               np.asarray(b2.invoke((x,))[0]))
+    b1.close()
+    assert "k1" in _shared_models      # still held by b2
+    b2.close()
+    assert "k1" not in _shared_models  # refcount reached zero
+
+
+def test_shared_key_reload_propagates_to_all_holders():
+    _shared_models.clear()
+    b1, b2 = XLABackend(), XLABackend()
+    b1.open({"model": _poly_sum_bundle(), "shared_tensor_filter_key": "k2"})
+    b2.open({"model": _poly_sum_bundle(), "shared_tensor_filter_key": "k2"})
+    x = np.ones((1, 2, 2, 3), np.uint8)
+    before = np.asarray(b2.invoke((x,))[0])
+
+    import jax.numpy as jnp
+
+    swapped = ModelBundle(
+        fn=lambda p, t: jnp.sum(t.astype(jnp.float32), axis=(1, 2)) @ p["w"],
+        params={"w": np.zeros((3, 5), np.float32)}, name="zeros")
+    b1.reload(swapped)
+    after = np.asarray(b2.invoke((x,))[0])   # holder b2 sees the swap
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(after, 0.0)
+    b1.close()
+    b2.close()
+
+
+def test_pipeline_two_filters_share_one_model():
+    _shared_models.clear()
+    bundle = _poly_sum_bundle()
+    src = AppSrc(spec=spec_of((1, 4, 4, 3), dtype=DType.UINT8), name="a")
+    from nnstreamer_tpu.elements import Tee
+
+    tee = Tee(name="t")
+    f1 = TensorFilter(name="f1", model=bundle,
+                      shared_tensor_filter_key="pk")
+    f2 = TensorFilter(name="f2", model=bundle,
+                      shared_tensor_filter_key="pk")
+    s1, s2 = TensorSink(name="s1"), TensorSink(name="s2")
+    x = np.ones((1, 4, 4, 3), np.uint8)
+    pipe = run_graph(
+        [src, tee, f1, f2, s1, s2],
+        [(src, tee), (tee, f1), (tee, f2), (f1, s1), (f2, s2)],
+        {"a": [TensorBuffer.of(x, pts=0)]})
+    p1 = pipe.get("f1").backend._current_params()
+    p2 = pipe.get("f2").backend._current_params()
+    assert p1["w"] is p2["w"]
+    np.testing.assert_allclose(np.asarray(pipe.get("s1").results[0].tensors[0]),
+                               np.asarray(pipe.get("s2").results[0].tensors[0]))
