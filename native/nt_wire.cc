@@ -54,7 +54,8 @@ int64_t nt_wire_tensor_size(const uint8_t* p, uint64_t len) {
   uint64_t elems = 1;
   for (uint32_t i = 0; i < rank; i++) {
     uint32_t d = rd32(p + fixed + 4ull * i);
-    if (d == 0) return -1;
+    // d == 0 is legal: the python codec emits zero-element tensors
+    // (e.g. an empty FLEXIBLE crop region) with a 0 dim
     elems *= d;
     if (elems > kMaxFrame) return -1;
   }

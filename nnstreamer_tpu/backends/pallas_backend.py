@@ -39,7 +39,9 @@ class _PallasEntry:
 
 
 _kernels: Dict[str, _PallasEntry] = {}
-_lock = threading.Lock()
+# RLock: _builtins() holds it across its check-then-register sequence,
+# and register_pallas_filter re-acquires it on the same thread
+_lock = threading.RLock()
 
 
 def register_pallas_filter(name: str, out_like=None):
@@ -55,17 +57,17 @@ def _builtins() -> None:
     """Register the stock pallas_ops kernels lazily."""
     from nnstreamer_tpu.backends import pallas_ops
 
-    with _lock:
+    with _lock:   # held across check+register (RLock; no concurrent dupes)
         if "normalize_u8" in _kernels:
             return
 
-    def norm_spec(spec: TensorsSpec) -> TensorsSpec:
-        return TensorsSpec(tensors=tuple(
-            TensorInfo(t.shape, DType.FLOAT32) for t in spec.tensors),
-            rate=spec.rate)
+        def norm_spec(spec: TensorsSpec) -> TensorsSpec:
+            return TensorsSpec(tensors=tuple(
+                TensorInfo(t.shape, DType.FLOAT32) for t in spec.tensors),
+                rate=spec.rate)
 
-    register_pallas_filter("normalize_u8", out_like=norm_spec)(
-        lambda ts: tuple(pallas_ops.normalize_u8(t) for t in ts))
+        register_pallas_filter("normalize_u8", out_like=norm_spec)(
+            lambda ts: tuple(pallas_ops.normalize_u8(t) for t in ts))
 
 
 @register_backend("pallas")

@@ -17,6 +17,7 @@ in their connect handshake plus per-memory headers).
 from __future__ import annotations
 
 import json
+import math
 import struct
 from typing import Tuple
 
@@ -117,7 +118,13 @@ def decode_buffer(data: bytes) -> Tuple[TensorBuffer, int]:
     for _ in range(num):
         hdr, used = MetaHeader.unpack(data[off:])
         off += used
-        n_bytes = int(np.prod(hdr.shape)) * hdr.dtype.itemsize
+        # math.prod on python ints: arbitrary precision, no silent int64
+        # wraparound from a crafted header's u32 dims
+        n_elems = math.prod(int(d) for d in hdr.shape)
+        if n_elems > MAX_FRAME_BYTES:
+            raise ValueError(
+                f"corrupt frame: header claims {n_elems} elements")
+        n_bytes = n_elems * hdr.dtype.itemsize
         if n_bytes > MAX_FRAME_BYTES or n_bytes > len(data) - off:
             raise ValueError(
                 f"corrupt frame: tensor payload {n_bytes}B overruns frame")

@@ -126,18 +126,49 @@ class _SyncCollect:
             return group
         # basepad
         bq = self.queues[self.base_pad]
-        base = bq[0].pts or 0
-        group: List[Optional[TensorBuffer]] = [None] * self.n
-        for i, q in enumerate(self.queues):
-            if i == self.base_pad:
-                continue
-            while len(q) > 1 and self._dist(q[1], base) <= self._dist(q[0], base):
+        while bq:
+            base = bq[0].pts or 0
+            group: List[Optional[TensorBuffer]] = [None] * self.n
+            # queues whose matched head should be consumed — deferred to
+            # group success, so an aborted (waiting) group loses nothing
+            pops: List[Deque[TensorBuffer]] = []
+            expired = waiting = False
+            for i, q in enumerate(self.queues):
+                if i == self.base_pad:
+                    continue
+                while (len(q) > 1
+                       and self._dist(q[1], base) <= self._dist(q[0], base)):
+                    q.popleft()
+                if self.window_ns and self._dist(q[0], base) > self.window_ns:
+                    # q[0] is the best queued candidate (catch-up loop
+                    # above). PTS is monotonic per pad, so once the NEWEST
+                    # queued frame is past base+window no future frame can
+                    # match either — expire the base head (drop + log)
+                    # instead of stalling the group forever (ref drops on
+                    # window miss, nnstreamer_plugin_api_impl.c:267)
+                    if (q[-1].pts or 0) > base + self.window_ns:
+                        log.warning(
+                            "%s: basepad head pts=%s expired (pad %d has no "
+                            "frame within ±%dns); dropping",
+                            self.e.name, base, i, self.window_ns)
+                        bq.popleft()
+                        expired = True
+                    else:
+                        # partner lags behind: a closer frame may still come
+                        waiting = True
+                    break
+                group[i] = q[0]
+                if len(q) > 1:
+                    pops.append(q)   # consume on success; reuse if last
+            if waiting:
+                return None
+            if expired:
+                continue   # retry with the next base head
+            for q in pops:
                 q.popleft()
-            if self.window_ns and self._dist(q[0], base) > self.window_ns:
-                return None  # partner outside window: wait for closer frame
-            group[i] = q[0] if len(q) == 1 else q.popleft()
-        group[self.base_pad] = bq.popleft()
-        return [g for g in group]  # type: ignore
+            group[self.base_pad] = bq.popleft()
+            return [g for g in group]  # type: ignore
+        return None
 
     @staticmethod
     def _dist(buf: TensorBuffer, base: int) -> int:

@@ -95,7 +95,8 @@ def _ref_pad(node: Dict, direction: str) -> Optional[int]:
     prefix, _, idx = pad.rpartition("_")
     if prefix == direction and idx.isdigit():
         return int(idx)
-    if prefix and prefix != direction:
+    other = "src" if direction == "sink" else "sink"
+    if prefix == other and idx.isdigit():
         return None  # qualified for the other direction
     raise PipelineError(
         f"bad pad reference {node['name']}.{pad!r}: expected sink_<n>, "

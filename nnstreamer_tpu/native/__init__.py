@@ -117,7 +117,8 @@ class ShmRing:
             raise StreamError(f"ring {self.name!r} closed or broken ({rc})")
 
     def read(self, timeout_ms: int = 100) -> Optional[bytes]:
-        """→ one frame, None on timeout; raises StreamError at EOS."""
+        """→ one frame, None on timeout; raises EOFError at EOS
+        (callers: `except EOFError`, see elements/ipc.py)."""
         n = self._lib.nt_ring_next_len(self._h, timeout_ms)
         if n == 0:
             return None

