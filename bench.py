@@ -1,105 +1,438 @@
-"""Benchmark — BASELINE.md config 1 on the real chip.
+"""Benchmark — all four BASELINE.md configs on the real chip.
 
-Runs the flagship streaming pipeline (source → converter-equivalent
-normalize → MobileNetV2 → label decode, all fused into one XLA
-computation by the graph optimizer) and reports steady-state
-frames/sec/chip. Baseline: the driver target of 30 FPS/chip
-(BASELINE.json — the reference publishes no numbers of its own;
-SURVEY.md §6).
+Configs (reference pipeline shapes, BASELINE.md table):
+  1. label     — MobileNetV2 224² image labeling. Real quantized weights
+                 (reference's own .tflite via modelio) when available;
+                 ingest normalize runs as a **compiled Pallas kernel** on
+                 TPU (Orc-SIMD analog, gsttensor_transform.c:463-493).
+  2. ssd       — SSD-MobileNet 300² + bounding_boxes decoder (NMS).
+  3. posenet   — PoseNet 257² + pose_estimation decoder.
+  4. composite — 2-tensor demux → 2× tensor_filter (shared device model)
+                 → mux, aggregate FPS.
 
-Prints ONE JSON line:
-  {"metric": "mobilenet_v2_224_fps_per_chip", "value": N,
-   "unit": "frames/s", "vs_baseline": N/30}
+Per config: steady-state FPS/chip (open-loop, pipelined) and p50/p99
+end-to-end latency (closed-loop, per-frame push→sink). Config 1 adds a
+batch sweep {1,8,32,64} with achieved TFLOP/s and MFU (XLA-measured
+FLOPs vs the chip's bf16 peak).
+
+Environment note: this driver reaches the chip through a network tunnel
+whose D2H reads are expensive (~10ms RTT, ~20MB/s) AND degrade
+subsequent dispatch in-process (measured 0.1→10ms/frame after any host
+read; slow recovery). Local TPU hosts do the same D2H in microseconds.
+The bench therefore (a) runs the pure-compute batch sweep FIRST, (b)
+reports `label_device` (sink blocks on device arrays, no D2H — the
+round-1-comparable headline) alongside the honest e2e configs whose
+decoders read results back per frame, and (c) probes the tunnel so the
+numbers can be interpreted (`env` field).
+
+Prints ONE JSON line; headline metric stays mobilenet FPS/chip
+vs the 30 FPS driver target (BASELINE.json).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
+MOBILENET_TFLITE = ("/root/reference/tests/test_models/models/"
+                    "mobilenet_v2_1.0_224_quant.tflite")
+LABELS = "/root/reference/tests/test_models/labels/labels.txt"
+BASELINE_FPS = 30.0          # BASELINE.json driver target, FPS/chip
+PEAK_BF16_TFLOPS = 197.0     # TPU v5e public peak, bf16
 
-def bench_pipeline(n_frames: int = 256, warmup: int = 16,
-                   batch: int = 1) -> float:
-    """Steady-state FPS of the stock pipeline at the given batch size
-    (batch>1 = the converter frames-per-tensor streaming-batch config;
-    FPS counts individual frames)."""
+
+def _percentile(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, int(round(p / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[k]
+
+
+class _Bench:
+    """Open-loop FPS + closed-loop latency on one built pipeline."""
+
+    def __init__(self, build, frames_per_push=1):
+        import nnstreamer_tpu as nns
+
+        self.pipe, self.src, self.sink, self.frame = build()
+        self.frames_per_push = frames_per_push
+        self.runner = nns.PipelineRunner(self.pipe, queue_capacity=4).start()
+        self._pts = 0
+
+    def _push(self):
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        f = self.frame
+        self.src.push(TensorBuffer.of(
+            *(f if isinstance(f, tuple) else (f,)), pts=self._pts))
+        self._pts += 1
+
+    def _wait(self, target, poll=0.002, timeout=300.0):
+        t0 = time.perf_counter()
+        while self.sink.count < target:
+            err = self.runner._error
+            if err is not None:
+                self.runner.stop()
+                raise RuntimeError(f"pipeline failed: {err}") from err
+            if time.perf_counter() - t0 > timeout:
+                raise RuntimeError(
+                    f"bench stalled: sink at {self.sink.count}/{target}")
+            time.sleep(poll)
+
+    def run(self, n_frames=None, warmup=12, n_lat=None):
+        if n_frames is None:
+            n_frames = 128 if _on_tpu() else 8
+        if n_lat is None:
+            n_lat = 60 if _on_tpu() else 4
+        try:
+            return self._run(n_frames, warmup, n_lat)
+        except BaseException:
+            # tear the pipeline down so a failed config's threads don't
+            # keep contending for the chip under later configs
+            try:
+                self.runner.stop()
+            except Exception:
+                pass
+            raise
+
+    def _run(self, n_frames, warmup, n_lat):
+        for _ in range(warmup):
+            self._push()
+        self._wait(warmup)
+        # open-loop throughput: keep the device fed
+        t0 = time.perf_counter()
+        for _ in range(n_frames):
+            self._push()
+        self._wait(warmup + n_frames)
+        dt = time.perf_counter() - t0
+        fps = n_frames * self.frames_per_push / dt
+        # closed-loop latency: one frame in flight
+        lats = []
+        base = warmup + n_frames
+        for i in range(n_lat):
+            t = time.perf_counter()
+            self._push()
+            self._wait(base + i + 1, poll=0.0005)
+            lats.append((time.perf_counter() - t) * 1e3)
+        lats.sort()
+        self.src.end()
+        self.runner.wait(60)
+        return {
+            "fps": round(fps, 2),
+            "p50_ms": round(_percentile(lats, 50), 3),
+            "p99_ms": round(_percentile(lats, 99), 3),
+        }
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+# -- config builders ---------------------------------------------------------
+
+def _probe_env():
+    """Tunnel D2H characteristics, so FPS numbers are interpretable."""
+    import jax
+    import numpy as np
+
+    x = jax.device_put(np.ones((1, 1001), np.uint8))
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        _ = np.asarray(x)
+    d2h_small = (time.perf_counter() - t0) / 5 * 1e3
+    return {"d2h_1k_ms": round(d2h_small, 2),
+            "backend": jax.default_backend()}
+
+
+def _build_label_device():
+    """Config 1 without the per-frame host readback: sink blocks on the
+    device arrays only (round-1-comparable; a local TPU host's D2H is µs
+    so this ≈ the e2e number off the tunnel)."""
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements import FakeSink, TensorFilter, TensorTransform
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    pipe = nns.Pipeline("label_device")
+    src = AppSrc(spec=TensorsSpec.of(
+        TensorInfo((1, 224, 224, 3), DType.UINT8)), name="src")
+    if os.path.exists(MOBILENET_TFLITE):
+        stages = [src, TensorFilter(name="f", model=MOBILENET_TFLITE)]
+    else:
+        norm = (TensorFilter(name="n", framework="pallas",
+                             model="normalize_u8") if _on_tpu() else
+                TensorTransform(name="n", mode="arithmetic",
+                                option="typecast:float32,add:-127.5,div:127.5"))
+        stages = [src, norm, TensorFilter(name="f",
+                                          model="zoo://mobilenet_v2")]
+    sink = FakeSink(name="sink", sync_device=True)
+    stages.append(sink)
+    for e in stages:
+        pipe.add(e)
+    for a, b in zip(stages, stages[1:]):
+        pipe.link(a, b)
+    frame = np.random.default_rng(0).integers(
+        0, 256, (1, 224, 224, 3), np.uint8)
+    return pipe, src, sink, frame
+
+
+def _build_label():
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements import FakeSink, TensorFilter, TensorTransform
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    use_tflite = os.path.exists(MOBILENET_TFLITE)
+    pipe = nns.Pipeline("label")
+    src = AppSrc(spec=TensorsSpec.of(
+        TensorInfo((1, 224, 224, 3), DType.UINT8)), name="src")
+    sink = FakeSink(name="sink", sync_device=True)
+    stages = [src]
+    if use_tflite:
+        # real quantized weights; uint8 in, dequant fused into the model
+        stages.append(TensorFilter(name="f", model=MOBILENET_TFLITE))
+        if os.path.exists(LABELS):
+            from nnstreamer_tpu.elements.decoder import TensorDecoder
+
+            stages.append(TensorDecoder(name="d", mode="image_labeling",
+                                        option1=LABELS))
+    else:
+        if _on_tpu():
+            # compiled Pallas ingest kernel (normalize_u8) as the filter
+            stages.append(TensorFilter(name="n", framework="pallas",
+                                       model="normalize_u8"))
+        else:
+            stages.append(TensorTransform(
+                name="n", mode="arithmetic",
+                option="typecast:float32,add:-127.5,div:127.5"))
+        stages.append(TensorFilter(name="f", model="zoo://mobilenet_v2"))
+    stages.append(sink)
+    for e in stages:
+        pipe.add(e)
+    for a, b in zip(stages, stages[1:]):
+        pipe.link(a, b)
+    frame = np.random.default_rng(0).integers(
+        0, 256, (1, 224, 224, 3), np.uint8)
+    return pipe, src, sink, frame
+
+
+def _build_ssd():
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+
+    pipe = nns.parse_launch(
+        "appsrc name=src dims=3:300:300:1 types=float32 ! "
+        "tensor_filter model=zoo://ssd_mobilenet ! "
+        "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
+        "option3=0.5:0.5 option4=300:300 ! "
+        "fakesink name=sink sync-device=true")
+    frame = np.random.default_rng(1).uniform(
+        -1, 1, (1, 300, 300, 3)).astype(np.float32)
+    return pipe, pipe.get("src"), pipe.get("sink"), frame
+
+
+def _build_posenet():
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+
+    pipe = nns.parse_launch(
+        "appsrc name=src dims=3:257:257:1 types=float32 ! "
+        "tensor_filter model=zoo://posenet ! "
+        "tensor_decoder mode=pose_estimation option1=257:257 option4=0.0 ! "
+        "fakesink name=sink sync-device=true")
+    frame = np.random.default_rng(2).uniform(
+        -1, 1, (1, 257, 257, 3)).astype(np.float32)
+    return pipe, pipe.get("src"), pipe.get("sink"), frame
+
+
+def _build_composite():
+    """2-tensor stream → demux → 2× filter (ONE shared device model) →
+    mux → sink (BASELINE config 4)."""
     import numpy as np
 
     import nnstreamer_tpu as nns
     from nnstreamer_tpu.elements import (
-        AppSrc, FakeSink, TensorFilter, TensorTransform)
-    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        FakeSink, TensorDemux, TensorFilter, TensorMux)
+    from nnstreamer_tpu.elements.sources import AppSrc
     from nnstreamer_tpu.tensor.dtypes import DType
     from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
 
-    spec = TensorsSpec.of(TensorInfo((batch, 224, 224, 3), DType.UINT8))
-    src = AppSrc(spec=spec, name="src")
-    # the reference's stock pipeline shape: typecast+normalize, then model
-    # (transform fuses into the filter's XLA computation at negotiation)
-    trans = TensorTransform(
-        name="t", mode="arithmetic",
-        option="typecast:float32,add:-127.5,div:127.5")
-    filt = TensorFilter(name="f", framework="xla",
-                        model=f"zoo://mobilenet_v2?batch={batch}")
+    pipe = nns.Pipeline("composite")
+    src = AppSrc(spec=TensorsSpec.of(
+        TensorInfo((1, 224, 224, 3), DType.FLOAT32),
+        TensorInfo((1, 224, 224, 3), DType.FLOAT32)), name="src")
+    demux = TensorDemux(name="dm")
+    model = "zoo://mobilenet_v2?dtype=bfloat16"
+    fa = TensorFilter(name="fa", model=model, shared_tensor_filter_key="bench")
+    fb = TensorFilter(name="fb", model=model, shared_tensor_filter_key="bench")
+    mux = TensorMux(name="mx", sync_mode="nosync")
     sink = FakeSink(name="sink", sync_device=True)
-
-    pipe = nns.Pipeline("bench")
-    for e in (src, trans, filt, sink):
+    for e in (src, demux, fa, fb, mux, sink):
         pipe.add(e)
-    pipe.link(src, trans)
-    pipe.link(trans, filt)
-    pipe.link(filt, sink)
+    pipe.link(src, demux)
+    pipe.link(demux, fa, 0, 0)
+    pipe.link(demux, fb, 1, 0)
+    pipe.link(fa, mux, 0, 0)
+    pipe.link(fb, mux, 0, 1)
+    pipe.link(mux, sink)
+    rng = np.random.default_rng(3)
+    x = rng.uniform(-1, 1, (1, 224, 224, 3)).astype(np.float32)
+    return pipe, src, sink, (x, x.copy())
 
-    runner = nns.PipelineRunner(pipe, queue_capacity=4).start()
-    frame = np.random.default_rng(0).integers(
-        0, 256, (batch, 224, 224, 3), np.uint8)
 
-    def wait_count(target: int, poll: float) -> None:
-        while sink.count < target:
-            err = runner._error
-            if err is not None:  # fail fast, don't spin forever
-                runner.stop()
-                raise RuntimeError(f"pipeline failed: {err}") from err
-            time.sleep(poll)
+# -- batch sweep + MFU -------------------------------------------------------
 
-    # warmup (compile)
-    for i in range(warmup):
-        src.push(TensorBuffer.of(frame, pts=i))
-    wait_count(warmup, 0.005)
+def batch_sweep(batches=None, n=None):
+    """Raw fused-forward throughput per batch + achieved TFLOP/s + MFU
+    (XLA cost analysis for FLOPs; MFU only meaningful on the TPU)."""
+    import jax
+    import numpy as np
 
-    t0 = time.perf_counter()
-    for i in range(n_frames):
-        src.push(TensorBuffer.of(frame, pts=warmup + i))
-    wait_count(warmup + n_frames, 0.002)
-    dt = time.perf_counter() - t0
-    src.end()
-    runner.wait(30)
-    return n_frames * batch / dt
+    out = {}
+    on_tpu = _on_tpu()
+    if batches is None:
+        batches = (1, 8, 32, 64) if on_tpu else (1, 8)
+    if n is None:
+        n = 96 if on_tpu else 4
+    for b in batches:
+        if os.path.exists(MOBILENET_TFLITE):
+            from nnstreamer_tpu.modelio import load_model_file
+
+            bundle = load_model_file(MOBILENET_TFLITE, batch=b)
+        else:
+            from nnstreamer_tpu.models.zoo import build_model
+
+            bundle = build_model(f"mobilenet_v2?batch={b}")
+        params = jax.device_put(bundle.params)
+        fn = jax.jit(bundle.fn)
+        x = np.random.default_rng(0).integers(
+            0, 256, (b, 224, 224, 3), np.uint8)
+        if bundle.in_spec and \
+                bundle.in_spec.tensors[0].dtype.np_dtype == np.float32:
+            x = ((x.astype(np.float32) - 127.5) / 127.5)
+        lowered = fn.lower(params, x)
+        compiled = lowered.compile()
+        cost = compiled.cost_analysis()
+        flops = float((cost or {}).get("flops", 0.0))
+        jax.block_until_ready(fn(params, x))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            y = fn(params, x)
+        jax.block_until_ready(y)
+        dt = time.perf_counter() - t0
+        fps = n * b / dt
+        tflops = fps / b * flops / 1e12 if flops else 0.0
+        out[str(b)] = {
+            "fps": round(fps, 1),
+            "tflops": round(tflops, 3),
+            "mfu_pct": round(100 * tflops / PEAK_BF16_TFLOPS, 2)
+            if on_tpu and tflops else 0.0,
+        }
+    # knee: largest per-batch FPS gain ratio step
+    fps_list = [(int(k), v["fps"]) for k, v in out.items()]
+    fps_list.sort()
+    knee = fps_list[0][0]
+    for (b0, f0), (b1, f1) in zip(fps_list, fps_list[1:]):
+        if f1 / f0 > 1.3:
+            knee = b1
+    out["knee_batch"] = knee
+    return out
+
+
+def pallas_check():
+    """Prove the Pallas ingest kernels compile (not interpret) and match
+    numpy on this platform (VERDICT r1 item 7)."""
+    import jax
+    import numpy as np
+
+    from nnstreamer_tpu.backends import pallas_ops
+
+    x = np.random.default_rng(0).integers(0, 256, (224, 224, 3), np.uint8)
+    f = jax.jit(lambda a: pallas_ops.normalize_u8(a))
+    y = np.asarray(f(x))
+    np.testing.assert_allclose(
+        y, (x.astype(np.float32) - 127.5) / 127.5, rtol=1e-6)
+    g = jax.jit(lambda a: pallas_ops.clamp_scale(a, 0.0, 1.0))
+    np.testing.assert_allclose(np.asarray(g(y)), np.clip(y, 0, 1), rtol=1e-6)
+    compiled = not pallas_ops._interpret()
+    hlo = f.lower(x).compile().as_text()
+    return {
+        "platform": jax.default_backend(),
+        "compiled": compiled,
+        "mosaic_custom_call": ("tpu_custom_call" in hlo) if compiled else False,
+        "numerics": "ok",
+    }
 
 
 def main() -> int:
+    results = {}
+    errors = {}
+    # pure-compute measurements FIRST: the tunnel's dispatch path degrades
+    # in-process once any per-frame host readback has happened (see module
+    # docstring), so order matters for honest compute numbers
     try:
-        fps = bench_pipeline()
-        fps_b8 = bench_pipeline(n_frames=64, batch=8)
-        baseline = 30.0  # BASELINE.json driver target, FPS/chip
-        print(json.dumps({
-            "metric": "mobilenet_v2_224_fps_per_chip",
-            "value": round(fps, 2),
-            "unit": "frames/s",
-            "vs_baseline": round(fps / baseline, 3),
-            "batched8_fps": round(fps_b8, 2),
-        }))
-        return 0
-    except Exception as e:  # one JSON line even on failure
-        print(json.dumps({
-            "metric": "mobilenet_v2_224_fps_per_chip",
-            "value": 0.0,
-            "unit": "frames/s",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}",
-        }))
-        return 1
+        sweep = batch_sweep()
+    except Exception as e:
+        sweep = {}
+        errors["batch_sweep"] = f"{type(e).__name__}: {e}"
+    # label_device: no per-frame D2H — the round-1-comparable headline
+    try:
+        results["label_device"] = _Bench(_build_label_device).run()
+    except Exception as e:
+        errors["label_device"] = f"{type(e).__name__}: {e}"
+    # composite also keeps everything on device (fakesink)
+    try:
+        results["composite"] = _Bench(_build_composite,
+                                      frames_per_push=2).run()
+    except Exception as e:
+        errors["composite"] = f"{type(e).__name__}: {e}"
+    try:
+        pallas = pallas_check()
+    except Exception as e:
+        pallas = {}
+        errors["pallas"] = f"{type(e).__name__}: {e}"
+    try:
+        env = _probe_env()
+    except Exception as e:
+        env = {}
+        errors["env"] = f"{type(e).__name__}: {e}"
+    # honest e2e configs (decoders read results to host per frame)
+    for name, build, fpp in (("label", _build_label, 1),
+                             ("ssd", _build_ssd, 1),
+                             ("posenet", _build_posenet, 1)):
+        try:
+            results[name] = _Bench(build, frames_per_push=fpp).run()
+        except Exception as e:
+            errors[name] = f"{type(e).__name__}: {e}"
+
+    headline = results.get("label_device", {}).get("fps", 0.0)
+    out = {
+        "metric": "mobilenet_v2_224_fps_per_chip",
+        "value": headline,
+        "unit": "frames/s",
+        "vs_baseline": round(headline / BASELINE_FPS, 3),
+        "configs": results,
+        "batch_sweep": sweep,
+        "pallas": pallas,
+        "env": env,
+    }
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out))
+    return 1 if (errors or not headline) else 0
 
 
 if __name__ == "__main__":

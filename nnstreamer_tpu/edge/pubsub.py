@@ -35,6 +35,8 @@ class EdgeSink(SinkElement):
     not block the stream: sends are best-effort per connection.
     """
 
+    WANTS_HOST = True
+
     ELEMENT_NAME = "edgesink"
     PROPS = {
         "host": PropDef(str, "127.0.0.1"),

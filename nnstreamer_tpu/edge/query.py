@@ -198,6 +198,8 @@ class TensorQueryServerSink(SinkElement):
     """Server exit pad: routes each result back to its client by the
     client_id riding buffer meta."""
 
+    WANTS_HOST = True
+
     ELEMENT_NAME = "tensor_query_serversink"
     PROPS = {
         "id": PropDef(int, 0, "server pair id"),
@@ -224,6 +226,8 @@ class TensorQueryServerSink(SinkElement):
 class TensorQueryClient(Element):
     """Sync RPC offload: push frame to server, block (with timeout) for
     the result, emit it downstream (tensor_query_client.c:657-699)."""
+
+    WANTS_HOST = True
 
     ELEMENT_NAME = "tensor_query_client"
     PROPS = {

@@ -45,6 +45,7 @@ def register_decoder(mode: str):
 @register_element("tensor_decoder")
 class TensorDecoder(Element):
     ELEMENT_NAME = "tensor_decoder"
+    WANTS_HOST = True
     PROPS = {
         "mode": PropDef(str, None, "decoder subplugin name"),
         # reference passes up to 9 positional option strings; we accept

@@ -31,6 +31,7 @@ log = get_logger("elements.ipc")
 
 @register_element("ipc_sink")
 class IpcSink(SinkElement):
+    WANTS_HOST = True
     ELEMENT_NAME = "ipc_sink"
     PROPS = {
         "ring": PropDef(str, None, "shm ring name, e.g. /nns-cam0"),

@@ -24,6 +24,7 @@ class TensorSink(SinkElement):
     `.results` is always unthrottled (appsink pull analog).
     """
 
+    WANTS_HOST = True
     ELEMENT_NAME = "tensor_sink"
     PROPS = {
         "new_data": PropDef(lambda s: s, None, "callback(buffer) (programmatic)"),

@@ -67,6 +67,10 @@ class Element:
     NUM_SINK_PADS: int = 1
     NUM_SRC_PADS: int = 1
     PROPS: Dict[str, PropDef] = {}
+    #: element consumes host arrays (decoders, sinks, wire encoders): the
+    #: scheduler starts async D2H copies when queueing buffers toward it,
+    #: overlapping transfers with other in-flight frames
+    WANTS_HOST: bool = False
 
     def __init__(self, name: Optional[str] = None, **props):
         self.name = name or f"{self.ELEMENT_NAME}{id(self) & 0xFFFF:x}"

@@ -203,6 +203,11 @@ class PipelineRunner:
             raise PipelineError(
                 f"element {elem.name} emitted on unlinked src pad {src_pad}"
             )
+        if link.dst.WANTS_HOST and isinstance(item, TensorBuffer) \
+                and item.on_device:
+            # start the D2H transfer now; the consumer's to_host() then
+            # overlaps with compute of other in-flight frames
+            item.prefetch_host()
         q = self._queues[link.dst.name]
         while not self._stop_evt.is_set():
             try:
