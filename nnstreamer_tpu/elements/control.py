@@ -47,9 +47,18 @@ _OPS = {
     "gt": operator.gt, "ge": operator.ge,
     "lt": operator.lt, "le": operator.le,
 }
+#: two-operand range operators (gsttensor_if.h:67-70); supplied_value is
+#: "lo:hi" for these
+_RANGE_OPS = {
+    "range_inclusive": lambda v, lo, hi: lo <= v <= hi,
+    "range_exclusive": lambda v, lo, hi: lo < v < hi,
+    "not_in_range_inclusive": lambda v, lo, hi: not (lo <= v <= hi),
+    "not_in_range_exclusive": lambda v, lo, hi: not (lo < v < hi),
+}
 
 CV_MODES = ("a_value", "average", "custom")
-ACTIONS = ("passthrough", "skip", "fill_zero", "tensorpick")
+ACTIONS = ("passthrough", "skip", "fill_zero", "fill_values",
+           "fill_with_file", "repeat_previous", "tensorpick")
 
 
 @register_element("tensor_if")
@@ -58,9 +67,14 @@ class TensorIf(Element):
 
     compared_value: a_value (option "<tensor>:<flat_index>"), average
     (option "<tensor>"), or custom (option = registered predicate name).
-    operator: eq|ne|gt|ge|lt|le  against supplied_value (float).
-    then/else: passthrough | skip | fill_zero | tensorpick (option =
-    comma indices).
+    operator: eq|ne|gt|ge|lt|le against supplied_value (single float), or
+    range_inclusive|range_exclusive|not_in_range_inclusive|
+    not_in_range_exclusive against supplied_value "lo:hi"
+    (gsttensor_if.h:60-71, all 10 operators).
+    then/else: passthrough | skip | fill_zero | fill_values (option =
+    per-tensor values, comma-separated, single value broadcasts) |
+    fill_with_file (option = raw payload file) | repeat_previous |
+    tensorpick (option = comma indices). (gsttensor_if.h:79-91.)
     """
 
     ELEMENT_NAME = "tensor_if"
@@ -68,8 +82,10 @@ class TensorIf(Element):
     PROPS = {
         "compared_value": PropDef(str, "a_value", "|".join(CV_MODES)),
         "compared_value_option": PropDef(str, "0:0"),
-        "operator": PropDef(str, "gt", "|".join(_OPS)),
-        "supplied_value": PropDef(float, 0.0),
+        "operator": PropDef(str, "gt",
+                            "|".join(list(_OPS) + list(_RANGE_OPS))),
+        "supplied_value": PropDef(lambda s: s, 0.0,
+                                  "float, or 'lo:hi' for range operators"),
         "then": PropDef(str, "passthrough", "|".join(ACTIONS)),
         "then_option": PropDef(str, ""),
         "else_": PropDef(str, "skip", "|".join(ACTIONS)),
@@ -80,16 +96,58 @@ class TensorIf(Element):
         props = {("else_" if k in ("else", "else-") else k): v
                  for k, v in props.items()}
         super().__init__(name, **props)
-        if self.props["operator"] not in _OPS:
+        op = self.props["operator"]
+        if op not in _OPS and op not in _RANGE_OPS:
             raise PipelineError(
-                f"tensor_if {self.name}: unknown operator "
-                f"{self.props['operator']!r}; valid: {sorted(_OPS)}"
+                f"tensor_if {self.name}: unknown operator {op!r}; valid: "
+                f"{sorted(_OPS) + sorted(_RANGE_OPS)}"
             )
         if self.props["compared_value"] not in CV_MODES:
             raise PipelineError(
                 f"tensor_if {self.name}: unknown compared_value "
                 f"{self.props['compared_value']!r}; valid: {CV_MODES}"
             )
+        self._sv = self._parse_supplied(self.props["supplied_value"], op)
+        for which in ("then", "else_"):
+            if self.props[which] not in ACTIONS:
+                raise PipelineError(
+                    f"tensor_if {self.name}: unknown {which.rstrip('_')} "
+                    f"action {self.props[which]!r}; valid: {ACTIONS}"
+                )
+        self._fill_bytes: Optional[bytes] = None
+        for which in ("then", "else_"):
+            if self.props[which] == "fill_with_file":
+                path = self.props[f"{which.rstrip('_')}_option"]
+                try:
+                    with open(path, "rb") as f:
+                        self._fill_bytes = f.read()
+                except OSError as e:
+                    raise PipelineError(
+                        f"tensor_if {self.name}: fill_with_file cannot "
+                        f"read {path!r}: {e}"
+                    ) from None
+        self._prev_out: Dict[int, TensorBuffer] = {}
+
+    def _parse_supplied(self, sv, op: str):
+        parts = str(sv).split(":")
+        try:
+            vals = tuple(float(p) for p in parts if p != "")
+        except ValueError:
+            raise PipelineError(
+                f"tensor_if {self.name}: bad supplied_value {sv!r}"
+            ) from None
+        need = 2 if op in _RANGE_OPS else 1
+        if len(vals) != need:
+            raise PipelineError(
+                f"tensor_if {self.name}: operator {op!r} needs "
+                f"{need} supplied value(s), got {len(vals)} from {sv!r}"
+                + (" (use supplied_value=lo:hi)" if need == 2 else "")
+            )
+        if need == 2 and vals[0] > vals[1]:
+            raise PipelineError(
+                f"tensor_if {self.name}: range lo {vals[0]} > hi {vals[1]}"
+            )
+        return vals
 
     def _out_spec_for(self, action: str, option: str,
                       spec: TensorsSpec) -> TensorsSpec:
@@ -144,7 +202,10 @@ class TensorIf(Element):
             # device-side reduce → single scalar D2H
             val = float(np.asarray(t.mean() if hasattr(t, "mean")
                                    else np.mean(t)))
-        return _OPS[self.props["operator"]](val, self.props["supplied_value"])
+        op = self.props["operator"]
+        if op in _RANGE_OPS:
+            return _RANGE_OPS[op](val, self._sv[0], self._sv[1])
+        return _OPS[op](val, self._sv[0])
 
     def _apply(self, action: str, option: str, pad: int,
                buf: TensorBuffer) -> List[Emission]:
@@ -156,6 +217,50 @@ class TensorIf(Element):
             # build zeros from shape/dtype — never pull the payload to host
             zeros = tuple(np.zeros(t.shape, t.dtype) for t in buf.tensors)
             return [(pad, buf.with_tensors(zeros))]
+        if action == "fill_values":
+            try:
+                vals = [float(v) for v in option.split(",") if v.strip()]
+            except ValueError:
+                raise PipelineError(
+                    f"tensor_if {self.name}: fill_values option {option!r} "
+                    f"is not a comma-separated value list"
+                ) from None
+            if not vals:
+                raise PipelineError(
+                    f"tensor_if {self.name}: fill_values needs option="
+                    f"<v>[,<v>…] (one per tensor, or one broadcast)")
+            if len(vals) == 1:
+                vals = vals * buf.num_tensors
+            if len(vals) != buf.num_tensors:
+                raise PipelineError(
+                    f"tensor_if {self.name}: fill_values got {len(vals)} "
+                    f"values for {buf.num_tensors} tensors")
+            filled = tuple(np.full(t.shape, v, t.dtype)
+                           for t, v in zip(buf.tensors, vals))
+            return [(pad, buf.with_tensors(filled))]
+        if action == "fill_with_file":
+            tensors = []
+            off = 0
+            data = self._fill_bytes or b""
+            for i, t in enumerate(buf.tensors):
+                dt = np.dtype(str(t.dtype)) if not isinstance(t, np.ndarray) \
+                    else t.dtype
+                n = int(np.prod(t.shape)) * dt.itemsize
+                if off + n > len(data):
+                    raise PipelineError(
+                        f"tensor_if {self.name}: fill file has "
+                        f"{len(data)} bytes but tensor {i} needs bytes "
+                        f"[{off}, {off + n})")
+                tensors.append(np.frombuffer(
+                    data, dt, count=int(np.prod(t.shape)),
+                    offset=off).reshape(t.shape))
+                off += n
+            return [(pad, buf.with_tensors(tuple(tensors)))]
+        if action == "repeat_previous":
+            prev = self._prev_out.get(pad)
+            if prev is None:
+                return []   # nothing to repeat yet (reference skips)
+            return [(pad, prev.with_tensors(prev.tensors, pts=buf.pts))]
         if action == "tensorpick":
             idxs = [int(x) for x in option.split(",") if x.strip()]
             return [(pad, buf.subset(idxs))]
@@ -168,12 +273,16 @@ class TensorIf(Element):
         cond = self._decide(buf)
         has_else = len(self.out_specs) == 2
         if cond:
-            return self._apply(self.props["then"],
-                               self.props["then_option"], 0, buf)
-        if has_else:
-            return self._apply(self.props["else_"],
-                               self.props["else_option"], 1, buf)
-        return []
+            out = self._apply(self.props["then"],
+                              self.props["then_option"], 0, buf)
+        elif has_else:
+            out = self._apply(self.props["else_"],
+                              self.props["else_option"], 1, buf)
+        else:
+            out = []
+        for p, b in out:
+            self._prev_out[p] = b   # repeat_previous source material
+        return out
 
 
 # -- tensor_rate -------------------------------------------------------------
@@ -184,9 +293,11 @@ class TensorRate(Element):
 
     PTS-based like the reference (gsttensor_rate.c): each output slot i
     has target time i/rate; incoming frames fill slots up to their PTS
-    (dup when source is slower, drop when faster). `silent=false` logs
-    drop/dup counts. `throttle=true` merely tags buffers with QoS meta —
-    backpressure is inherent to the bounded queues.
+    (dup when source is slower, drop when faster). `throttle=true` posts
+    an upstream QoS event with the target inter-frame interval
+    (gsttensor_rate.c:22-34) so sources can *skip generating* frames
+    that would be dropped here (skip-before-compute); bounded queues
+    still provide generic backpressure either way.
     """
 
     ELEMENT_NAME = "tensor_rate"
@@ -210,6 +321,7 @@ class TensorRate(Element):
         self._prev: Optional[TensorBuffer] = None
         self.dropped = 0
         self.duplicated = 0
+        self._qos_posted = False
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         spec = self.expect_tensors(in_specs[0])
@@ -236,6 +348,13 @@ class TensorRate(Element):
             # candidate; a faster-than-rate source overwrites (drop)
             if self._prev is not None and buf.pts < self._slot_pts(self._next_slot):
                 self.dropped += 1
+                if self.props["throttle"] and not self._qos_posted:
+                    # upstream QoS: ask sources to pace at the target rate
+                    self._qos_posted = True
+                    self.post_upstream_event({
+                        "type": "qos",
+                        "min_interval_ns": int(1_000_000_000 / self._rate),
+                    })
             self._prev = buf
         return out
 

@@ -60,7 +60,17 @@ class VideoTestSrc(SourceElement):
         frame_ns = int(NS / rate) if rate else 0
         pattern = self.props["pattern"]
         rng = np.random.default_rng(self.props["seed"])
+        next_qos_pts = 0
         for i in range(self.props["num_buffers"]):
+            pts = i * frame_ns
+            # downstream throttle QoS (tensor_rate): skip BEFORE computing
+            # the frame — the whole point of the upstream event
+            qos = self.qos_min_interval_ns
+            if qos and pts < next_qos_pts:
+                self.qos_skipped += 1
+                continue
+            if qos:
+                next_qos_pts = pts + qos
             if pattern == "random":
                 frame = rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
             elif pattern == "solid":
@@ -78,7 +88,7 @@ class VideoTestSrc(SourceElement):
                 )
             if self.props["is_live"] and frame_ns:
                 time.sleep(frame_ns / NS)
-            yield TensorBuffer.of(frame, pts=i * frame_ns,
+            yield TensorBuffer.of(frame, pts=pts,
                                   duration=frame_ns or None)
 
 

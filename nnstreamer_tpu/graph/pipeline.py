@@ -112,6 +112,21 @@ class Element:
     def num_src_pads(self) -> int:
         return self.NUM_SRC_PADS
 
+    # -- upstream events (GStreamer upstream-event analog) ------------------
+    def post_upstream_event(self, event: dict) -> None:
+        """Send an event toward the pipeline's sources (e.g. tensor_rate
+        throttle QoS, gsttensor_rate.c:22-34). Routed against the link
+        graph by the runner; each upstream element's
+        handle_upstream_event() may consume it (return True) or let it
+        propagate further. No-op outside a running pipeline."""
+        router = getattr(self, "_event_router", None)
+        if router is not None:
+            router(self, event)
+
+    def handle_upstream_event(self, event: dict) -> bool:
+        """Return True to consume the event (stops propagation)."""
+        return False
+
     # -- negotiation -------------------------------------------------------
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         """Compute output specs from input specs. Runs once, build time."""
@@ -150,6 +165,18 @@ class Element:
 
 class SourceElement(Element):
     NUM_SINK_PADS = 0
+
+    #: QoS pacing requested from downstream (0 = none): sources should
+    #: not *generate* frames closer together than this (skip-before-
+    #: compute, the point of the reference's upstream QoS events)
+    qos_min_interval_ns: int = 0
+    qos_skipped: int = 0
+
+    def handle_upstream_event(self, event: dict) -> bool:
+        if event.get("type") == "qos":
+            self.qos_min_interval_ns = int(event.get("min_interval_ns", 0))
+            return True
+        return False
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         return [self.output_spec()]

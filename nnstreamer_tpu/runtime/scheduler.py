@@ -104,6 +104,7 @@ class PipelineRunner:
         for name in pipe.elements:
             self._stats.setdefault(name, ElementStats())
         for e in pipe.elements.values():
+            e._event_router = self._route_upstream
             e.start()
         for l in pipe.links:
             self._route[(l.src.name, l.src_pad)] = l
@@ -185,6 +186,26 @@ class PipelineRunner:
         return out
 
     # -- internals ---------------------------------------------------------
+    def _route_upstream(self, origin: Element, event: dict) -> None:
+        """Walk the link graph upstream from `origin`, offering `event`
+        to each element until consumed (upstream QoS event path)."""
+        seen = {origin.name}
+        frontier = [origin]
+        while frontier:
+            e = frontier.pop()
+            for l in self.pipeline.links_to(e):
+                u = l.src
+                if u.name in seen:
+                    continue
+                seen.add(u.name)
+                try:
+                    consumed = u.handle_upstream_event(event)
+                except Exception:
+                    log.exception("upstream event failed at %s", u.name)
+                    consumed = True
+                if not consumed:
+                    frontier.append(u)
+
     def _fail(self, elem: Element, exc: BaseException) -> None:
         with self._error_lock:
             if self._error is None:

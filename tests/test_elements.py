@@ -412,3 +412,174 @@ def test_parse_bad_pad_reference_raises():
     with pytest.raises(PE, match="pad reference"):
         nns.parse_launch(
             "appsrc dims=2 name=a ! m.foo_1 tensor_mux name=m ! fakesink")
+
+
+# -- tensor_if range operators + fill actions (VERDICT r1 item 8) -----------
+
+def _if_graph(iff, bufs, two_branches=True):
+    src = AppSrc(spec=spec_of((4,)), name="src")
+    s_then = TensorSink(name="then_s")
+    elems = [src, iff, s_then]
+    links = [(src, iff), (iff, s_then, 0, 0)]
+    s_else = None
+    if two_branches:
+        s_else = TensorSink(name="else_s")
+        elems.append(s_else)
+        links.append((iff, s_else, 1, 0))
+    pipe = run_graph(elems, links, {"src": bufs})
+    return s_then, s_else
+
+
+def _val_buf(v, pts=0):
+    return TensorBuffer.of(np.full((4,), v, np.float32), pts=pts)
+
+
+def test_if_range_inclusive_routes_both_branches():
+    iff = TensorIf(name="i", compared_value="a_value",
+                   compared_value_option="0:0",
+                   operator="range_inclusive", supplied_value="2:5",
+                   else_="passthrough")
+    s_then, s_else = _if_graph(
+        iff, [_val_buf(2, 0), _val_buf(5, 1), _val_buf(6, 2)])
+    assert len(s_then.results) == 2       # 2 and 5 inclusive
+    assert len(s_else.results) == 1       # 6 outside
+
+
+def test_if_range_exclusive_and_not_in_range():
+    iff = TensorIf(name="i", operator="range_exclusive",
+                   supplied_value="2:5", else_="passthrough")
+    s_then, s_else = _if_graph(iff, [_val_buf(2, 0), _val_buf(3, 1)])
+    assert len(s_then.results) == 1 and len(s_else.results) == 1
+    iff2 = TensorIf(name="i2", operator="not_in_range_inclusive",
+                    supplied_value="2:5", else_="passthrough")
+    s_then, s_else = _if_graph(iff2, [_val_buf(2, 0), _val_buf(9, 1)])
+    assert len(s_then.results) == 1       # 9 not in [2,5]
+    assert float(s_then.results[0].tensors[0][0]) == 9.0
+
+
+def test_if_range_needs_two_values():
+    with pytest.raises(nns.core.errors.PipelineError, match="2 supplied"):
+        TensorIf(name="i", operator="range_inclusive", supplied_value="3")
+    with pytest.raises(nns.core.errors.PipelineError, match="lo.*hi|> hi"):
+        TensorIf(name="i", operator="range_inclusive", supplied_value="5:2")
+
+
+def test_if_fill_values_broadcast_and_per_tensor():
+    iff = TensorIf(name="i", operator="gt", supplied_value="10",
+                   then="passthrough", else_="fill_values",
+                   else_option="7.5")
+    s_then, s_else = _if_graph(iff, [_val_buf(1, 0)])
+    np.testing.assert_array_equal(s_else.results[0].tensors[0],
+                                  np.full((4,), 7.5, np.float32))
+
+
+def test_if_fill_values_wrong_count_fails():
+    iff = TensorIf(name="i", operator="gt", supplied_value="10",
+                   else_="fill_values", else_option="1,2,3")
+    from nnstreamer_tpu.core.errors import StreamError
+
+    with pytest.raises((nns.core.errors.PipelineError, StreamError),
+                       match="fill_values"):
+        _if_graph(iff, [_val_buf(1, 0)])
+
+
+def test_if_fill_with_file(tmp_path):
+    payload = np.arange(4, dtype=np.float32)
+    f = tmp_path / "fill.raw"
+    f.write_bytes(payload.tobytes())
+    iff = TensorIf(name="i", operator="gt", supplied_value="10",
+                   else_="fill_with_file", else_option=str(f))
+    s_then, s_else = _if_graph(iff, [_val_buf(1, 0)])
+    np.testing.assert_array_equal(s_else.results[0].tensors[0], payload)
+
+
+def test_if_fill_with_file_too_small(tmp_path):
+    f = tmp_path / "small.raw"
+    f.write_bytes(b"\x00" * 4)   # needs 16
+    iff = TensorIf(name="i", operator="gt", supplied_value="10",
+                   else_="fill_with_file", else_option=str(f))
+    from nnstreamer_tpu.core.errors import StreamError
+
+    with pytest.raises((nns.core.errors.PipelineError, StreamError),
+                       match="fill file"):
+        _if_graph(iff, [_val_buf(1, 0)])
+
+
+def test_if_fill_with_file_missing_fails_at_build():
+    with pytest.raises(nns.core.errors.PipelineError, match="cannot read"):
+        TensorIf(name="i", else_="fill_with_file",
+                 else_option="/nonexistent/fill.raw")
+
+
+def test_if_repeat_previous():
+    iff = TensorIf(name="i", operator="gt", supplied_value="5",
+                   then="passthrough", else_="repeat_previous")
+    # else routes to pad 1; repeat_previous repeats what pad 1 last saw —
+    # nothing yet, so frame 1 vanishes; then frame 3 repeats frame 2?
+    # No: pads are separate. Route then+else into the SAME sink via two
+    # sinks and check the else-pad repetition of its own history.
+    src = AppSrc(spec=spec_of((4,)), name="src")
+    s_then, s_else = TensorSink(name="t"), TensorSink(name="e")
+    pipe = run_graph(
+        [src, iff, s_then, s_else],
+        [(src, iff), (iff, s_then, 0, 0), (iff, s_else, 1, 0)],
+        {"src": [_val_buf(1, 0), _val_buf(9, 1), _val_buf(2, 2)]})
+    assert len(pipe.get("t").results) == 1            # the 9
+    # frame 0: no previous on else pad → skipped; frame 2: still no else-
+    # pad history? fill happened: _prev_out tracks per-pad; frame 0
+    # emitted nothing, so pad1 history starts empty; frame 2 also emits
+    # nothing. else sink stays empty.
+    assert len(pipe.get("e").results) == 0
+
+
+def test_if_repeat_previous_passthrough_history():
+    """then=repeat_previous repeats the last then-pad emission."""
+    iff = TensorIf(name="i", operator="le", supplied_value="5",
+                   then="passthrough", else_="skip")
+    # sanity base: le routes 1,2 to then
+    iff2 = TensorIf(name="i", operator="gt", supplied_value="5",
+                    then="repeat_previous", else_="passthrough")
+    src = AppSrc(spec=spec_of((4,)), name="src")
+    s_then, s_else = TensorSink(name="t"), TensorSink(name="e")
+    pipe = run_graph(
+        [src, iff2, s_then, s_else],
+        [(src, iff2), (iff2, s_then, 0, 0), (iff2, s_else, 1, 0)],
+        {"src": [_val_buf(9, 0), _val_buf(1, 1), _val_buf(8, 2)]})
+    # frame 0 (9>5): then=repeat_previous, no history → nothing
+    # frame 1 (1≤5): else passthrough
+    # frame 2 (8>5): repeat_previous: still no then-pad history → nothing
+    assert len(pipe.get("t").results) == 0
+    assert len(pipe.get("e").results) == 1
+
+
+# -- tensor_rate upstream QoS (skip-before-compute) --------------------------
+
+def test_rate_throttle_posts_qos_and_source_skips():
+    pipe = nns.parse_launch(
+        "videotestsrc num-buffers=40 framerate=100/1 pattern=solid ! "
+        "tensor_converter ! "
+        "tensor_rate name=r framerate=10/1 throttle=true ! "
+        "tensor_sink name=s")
+    nns.run_pipeline(pipe, timeout=60)
+    src = next(e for e in pipe.elements.values()
+               if e.ELEMENT_NAME == "videotestsrc")
+    rate = pipe.get("r")
+    # the source stopped generating frames that would be dropped: after
+    # the first drop triggers QoS, generation paces at 10/1
+    assert src.qos_skipped > 10
+    # only the in-flight window (bounded queues) could still drop —
+    # far fewer than the ~36 drops without throttle
+    assert rate.dropped < 15
+
+
+def test_rate_no_throttle_source_never_skips():
+    pipe = nns.parse_launch(
+        "videotestsrc num-buffers=40 framerate=100/1 pattern=solid ! "
+        "tensor_converter ! "
+        "tensor_rate name=r framerate=10/1 throttle=false ! "
+        "tensor_sink name=s")
+    nns.run_pipeline(pipe, timeout=60)
+    src = next(e for e in pipe.elements.values()
+               if e.ELEMENT_NAME == "videotestsrc")
+    assert src.qos_skipped == 0
+    assert pipe.get("r").dropped > 20
