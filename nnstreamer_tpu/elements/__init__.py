@@ -11,6 +11,7 @@ from nnstreamer_tpu.elements import (  # noqa: F401
     debug,
     decoder,
     filter as filter_element,
+    iio,
     ipc,
     repo,
     routing,
@@ -21,6 +22,24 @@ from nnstreamer_tpu.elements import (  # noqa: F401
     wire_codec,
 )
 from nnstreamer_tpu.trainer import element as _trainer_element  # noqa: F401
+# schema'd interop codecs register decoder/converter subplugins
+# "protobuf" and "flexbuf" (SURVEY.md §2.4 codec pairs); grpc_elements
+# registers tensor_src_grpc / tensor_sink_grpc (§2.5). Soft dependency:
+# a stripped install without protobuf/flatbuffers/grpcio still gets the
+# full non-interop element set (the reference gates the same subplugins
+# behind meson feature flags).
+try:
+    from nnstreamer_tpu.interop import (  # noqa: F401
+        flexbuf_codec,
+        grpc_elements,
+        protobuf_codec,
+    )
+except ImportError as _interop_err:  # pragma: no cover - env without deps
+    from nnstreamer_tpu.core.log import get_logger as _get_logger
+
+    _get_logger("elements").info(
+        "interop codecs unavailable (%s); protobuf/flexbuf/grpc elements "
+        "not registered", _interop_err)
 
 from nnstreamer_tpu.elements.aggregator import TensorAggregator
 from nnstreamer_tpu.elements.control import (

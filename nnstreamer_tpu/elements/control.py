@@ -114,19 +114,37 @@ class TensorIf(Element):
                     f"tensor_if {self.name}: unknown {which.rstrip('_')} "
                     f"action {self.props[which]!r}; valid: {ACTIONS}"
                 )
-        self._fill_bytes: Optional[bytes] = None
-        for which in ("then", "else_"):
+        # per-branch action material, keyed by src pad (then=0, else=1):
+        # both branches may use fill_with_file/fill_values with different
+        # options, so nothing here may be shared state
+        self._fill_bytes: Dict[int, bytes] = {}
+        self._fill_vals: Dict[int, List[float]] = {}
+        for pad_i, which in enumerate(("then", "else_")):
+            option = self.props[f"{which.rstrip('_')}_option"]
             if self.props[which] == "fill_with_file":
-                path = self.props[f"{which.rstrip('_')}_option"]
                 try:
-                    with open(path, "rb") as f:
-                        self._fill_bytes = f.read()
+                    with open(option, "rb") as f:
+                        self._fill_bytes[pad_i] = f.read()
                 except OSError as e:
                     raise PipelineError(
                         f"tensor_if {self.name}: fill_with_file cannot "
-                        f"read {path!r}: {e}"
+                        f"read {option!r}: {e}"
                     ) from None
-        self._prev_out: Dict[int, TensorBuffer] = {}
+            elif self.props[which] == "fill_values":
+                try:
+                    vals = [float(v) for v in str(option).split(",")
+                            if v.strip()]
+                except ValueError:
+                    raise PipelineError(
+                        f"tensor_if {self.name}: fill_values option "
+                        f"{option!r} is not a comma-separated value list"
+                    ) from None
+                if not vals:
+                    raise PipelineError(
+                        f"tensor_if {self.name}: fill_values needs option="
+                        f"<v>[,<v>…] (one per tensor, or one broadcast)")
+                self._fill_vals[pad_i] = vals
+        self._last_fwd: Optional[TensorBuffer] = None
 
     def _parse_supplied(self, sv, op: str):
         parts = str(sv).split(":")
@@ -218,17 +236,7 @@ class TensorIf(Element):
             zeros = tuple(np.zeros(t.shape, t.dtype) for t in buf.tensors)
             return [(pad, buf.with_tensors(zeros))]
         if action == "fill_values":
-            try:
-                vals = [float(v) for v in option.split(",") if v.strip()]
-            except ValueError:
-                raise PipelineError(
-                    f"tensor_if {self.name}: fill_values option {option!r} "
-                    f"is not a comma-separated value list"
-                ) from None
-            if not vals:
-                raise PipelineError(
-                    f"tensor_if {self.name}: fill_values needs option="
-                    f"<v>[,<v>…] (one per tensor, or one broadcast)")
+            vals = self._fill_vals[pad]   # parsed/validated in __init__
             if len(vals) == 1:
                 vals = vals * buf.num_tensors
             if len(vals) != buf.num_tensors:
@@ -241,7 +249,7 @@ class TensorIf(Element):
         if action == "fill_with_file":
             tensors = []
             off = 0
-            data = self._fill_bytes or b""
+            data = self._fill_bytes.get(pad, b"")
             for i, t in enumerate(buf.tensors):
                 dt = np.dtype(str(t.dtype)) if not isinstance(t, np.ndarray) \
                     else t.dtype
@@ -257,9 +265,15 @@ class TensorIf(Element):
                 off += n
             return [(pad, buf.with_tensors(tuple(tensors)))]
         if action == "repeat_previous":
-            prev = self._prev_out.get(pad)
+            # re-emit the element's last forwarded frame (either branch)
+            # with the current frame's timestamp: then=passthrough /
+            # else=repeat_previous gives downstream the last good frame
+            # when the condition fails. Declared-but-unimplemented in the
+            # reference chain (gsttensor_if.c:1171 default case), so the
+            # useful semantics are defined here. Skip when no history.
+            prev = self._last_fwd
             if prev is None:
-                return []   # nothing to repeat yet (reference skips)
+                return []   # nothing to repeat yet
             return [(pad, prev.with_tensors(prev.tensors, pts=buf.pts))]
         if action == "tensorpick":
             idxs = [int(x) for x in option.split(",") if x.strip()]
@@ -280,8 +294,8 @@ class TensorIf(Element):
                               self.props["else_option"], 1, buf)
         else:
             out = []
-        for p, b in out:
-            self._prev_out[p] = b   # repeat_previous source material
+        for _, b in out:
+            self._last_fwd = b   # repeat_previous source material
         return out
 
 

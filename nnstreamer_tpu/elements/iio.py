@@ -79,9 +79,10 @@ class _Channel:
         mask = np.uint64((1 << self.used_bits) - 1)
         v = v & mask
         if self.signed:
-            sign_bit = np.uint64(1 << (self.used_bits - 1))
-            vi = v.astype(np.int64)
-            vi = np.where(v & sign_bit, vi - (1 << self.used_bits), vi)
+            # sign-extend any width (incl. 64-bit timestamps) without
+            # overflow: left-align in the 64-bit word, arithmetic shift back
+            sh = np.uint64(64 - self.used_bits)
+            vi = (v << sh).view(np.int64) >> np.int64(sh)
             out = vi.astype(np.float32)
         else:
             out = v.astype(np.float32)
@@ -224,9 +225,25 @@ class TensorSrcIIO(SourceElement):
         return TensorsSpec(tensors=infos, rate=self._rate)
 
     # -- capture loop ------------------------------------------------------
+    def _layout(self) -> List[int]:
+        """Byte offset of each channel in a frame. The kernel aligns every
+        scan element to its own storage size (gsttensor_srciio.c:1503-1522:
+        location = align(running_size, storage_bytes)), so mixed-width
+        channels (e.g. 3×s16 + u64 timestamp) have padding holes."""
+        offs, size = [], 0
+        for c in self._channels:
+            sb = c.storage_bits // 8
+            rem = size % sb
+            loc = size if rem == 0 else size - rem + sb
+            offs.append(loc)
+            size = loc + sb
+        return offs
+
     @property
     def _frame_bytes(self) -> int:
-        return sum(c.storage_bits // 8 for c in self._channels)
+        offs = self._layout()
+        last = self._channels[-1]
+        return offs[-1] + last.storage_bits // 8
 
     def _data_path(self) -> str:
         if self.props["data"]:
@@ -248,24 +265,37 @@ class TensorSrcIIO(SourceElement):
                 f"{path!r}: {e}") from None
         with f:
             while not limit or emitted < limit:
-                data = f.read(block)
-                if data is None or len(data) < block:
+                # raw char devices legally return short reads when fewer
+                # samples are buffered: accumulate until a full block or
+                # true EOF (empty read)
+                data = b""
+                while len(data) < block:
+                    chunk = f.read(block - len(data))
+                    if not chunk:
+                        break
+                    data += chunk
+                if len(data) < block:
+                    if data:
+                        log.warning(
+                            "%s: discarding %d trailing bytes (< one "
+                            "%d-byte block) at EOF", self.name, len(data),
+                            block)
                     break   # EOF (regular file) or device stopped
                 yield self._decode_block(data, fpt, emitted, period_ns)
                 emitted += 1
 
     def _decode_block(self, data: bytes, fpt: int, seq: int,
                       period_ns: int) -> TensorBuffer:
-        # split interleaved storage: frame = concat(channel storages by idx)
+        # split interleaved storage: channels sit at their aligned
+        # locations within each frame (kernel scan-element layout)
         cols = []
         stride = self._frame_bytes
-        off = 0
+        offs = self._layout()
         raw = np.frombuffer(data, np.uint8).reshape(fpt, stride)
-        for c in self._channels:
+        for c, off in zip(self._channels, offs):
             size = c.storage_bits // 8
             col = raw[:, off:off + size].copy().view(c.np_dtype)[:, 0]
             cols.append(c.decode(col))
-            off += size
         pts = seq * period_ns if period_ns else seq
         if self.props["merge_channels"]:
             return TensorBuffer.of(np.stack(cols, axis=1), pts=pts)

@@ -63,6 +63,11 @@ class VideoTestSrc(SourceElement):
         next_qos_pts = 0
         for i in range(self.props["num_buffers"]):
             pts = i * frame_ns
+            # a live source models a camera: the frame interval elapses
+            # whether or not the frame is kept, so pace BEFORE the QoS
+            # skip or throttled live capture runs ahead of real time
+            if self.props["is_live"] and frame_ns:
+                time.sleep(frame_ns / NS)
             # downstream throttle QoS (tensor_rate): skip BEFORE computing
             # the frame — the whole point of the upstream event
             qos = self.qos_min_interval_ns
@@ -86,8 +91,6 @@ class VideoTestSrc(SourceElement):
                     f"videotestsrc pattern {pattern!r} unknown "
                     f"(gradient|random|solid)"
                 )
-            if self.props["is_live"] and frame_ns:
-                time.sleep(frame_ns / NS)
             yield TensorBuffer.of(frame, pts=pts,
                                   duration=frame_ns or None)
 

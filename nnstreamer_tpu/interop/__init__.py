@@ -1,0 +1,16 @@
+"""Interop serialization + RPC: published formats external processes speak.
+
+The private wire codec (edge/wire.py) is for nnstreamer_tpu↔nnstreamer_tpu
+links; this package covers the reference's schema'd interop surface
+(SURVEY.md §2.4 flatbuf/flexbuf/protobuf codec pairs, §2.5 gRPC):
+
+- protobuf_codec — nnstreamer.protobuf.Tensors frames (tensors.proto)
+- flexbuf_codec  — schema-less flexbuffers map frames
+- gst_meta       — GstTensorMetaInfo v1 header for flexible payloads
+- grpc_elements  — tensor_src_grpc / tensor_sink_grpc over real gRPC
+
+Importing the codec modules registers decoder modes "protobuf"/"flexbuf"
+and converter subplugins of the same names.
+"""
+
+from nnstreamer_tpu.interop import tensors_pb2  # noqa: F401

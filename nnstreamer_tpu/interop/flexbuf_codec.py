@@ -1,0 +1,146 @@
+"""FlexBuffers tensor-stream codec — schema-less interop serialization.
+
+Reference parity: tensordec-flexbuf.cc + tensor_converter_flexbuf.cc.
+FlexBuffers is flatbuffers' schema-less sibling; the layout here is the
+reference's documented map (tensordec-flexbuf.cc:26-41,:139-168):
+
+    Map {
+      "num_tensors": UInt,
+      "rate_n": Int, "rate_d": Int, "format": Int,
+      "tensor_#i": Vector [ name:String, type:Int,
+                            dimension:TypedVector<UInt> (rank-4, 1-padded),
+                            data:Blob ],
+    }
+
+Any process with a flexbuffers library reads these frames without our
+code or a schema file; an unmodified nnstreamer flexbuf converter parses
+them directly. FLEXIBLE/SPARSE data blobs are GstTensorMetaInfo-prefixed
+exactly like the reference (is_flexible branch, tensordec-flexbuf.cc:147).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+from flatbuffers import flexbuffers
+
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.elements.converter import ConverterSubplugin, register_converter
+from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
+from nnstreamer_tpu.graph.media import MediaSpec, OctetSpec
+from nnstreamer_tpu.interop.gst_meta import (
+    HEADER_SIZE,
+    check_wire_dtype,
+    pack_gst_meta,
+    parse_gst_meta,
+    shape_from_wire,
+    wire_dims,
+)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+
+
+def encode_flexbuf(buf: TensorBuffer, rate=None) -> bytes:
+    """TensorBuffer → flexbuffers frame (reference map layout)."""
+    fbb = flexbuffers.Builder()
+    non_static = buf.format != TensorFormat.STATIC
+    frac = (rate if isinstance(rate, tuple) else (rate or 0, 1))
+    with fbb.Map():
+        fbb.Key("num_tensors")
+        fbb.UInt(buf.num_tensors)
+        fbb.Key("rate_n")
+        fbb.Int(int(frac[0]))
+        fbb.Key("rate_d")
+        fbb.Int(int(frac[1]))
+        fbb.Key("format")
+        fbb.Int(int(buf.format))
+        for i, t in enumerate(buf.tensors):
+            arr = np.ascontiguousarray(np.asarray(t))
+            dt = DType.from_np(arr.dtype)
+            check_wire_dtype(dt)
+            raw = arr.tobytes()
+            if non_static:
+                raw = pack_gst_meta(arr.shape, dt, buf.format) + raw
+            fbb.Key(f"tensor_{i}")
+            with fbb.Vector():
+                fbb.String(str(buf.meta.get("tensor_names", {}).get(i, "")))
+                fbb.Int(int(dt))
+                fbb.TypedVectorFromElements(wire_dims(arr.shape))
+                fbb.Blob(raw)
+    return bytes(fbb.Finish())
+
+
+def decode_flexbuf(frame: bytes) -> TensorBuffer:
+    """flexbuffers frame → TensorBuffer (host numpy)."""
+    try:
+        root = flexbuffers.GetRoot(bytearray(frame)).AsMap
+        num = root["num_tensors"].AsInt
+        try:
+            fmt = TensorFormat(root["format"].AsInt)
+        except KeyError:   # older reference frames omit the format key
+            fmt = TensorFormat.STATIC
+    except Exception as e:
+        raise StreamError(f"corrupt flexbuf tensor frame: {e}") from None
+    arrays, names = [], {}
+    for i in range(num):
+        try:
+            vec = root[f"tensor_{i}"].AsVector
+            name = vec[0].AsString
+            dt = DType(vec[1].AsInt)
+            dims = [e.AsInt for e in vec[2].AsTypedVector]
+            raw = bytes(vec[3].AsBlob)
+        except Exception as e:
+            raise StreamError(
+                f"corrupt flexbuf tensor frame at tensor_{i}: {e}"
+            ) from None
+        if fmt != TensorFormat.STATIC and len(raw) >= HEADER_SIZE:
+            shape, hdt, _, _, _, off = parse_gst_meta(raw)
+            arr = np.frombuffer(raw, hdt.np_dtype, offset=off,
+                                count=math.prod(shape)).reshape(shape).copy()
+        else:
+            shape = shape_from_wire(dims)
+            n = math.prod(shape) if shape else 1
+            if n * dt.itemsize != len(raw):
+                raise StreamError(
+                    f"flexbuf tensor_{i}: {len(raw)} payload bytes != {n} "
+                    f"elements of {dt.type_name} from dims {dims}"
+                )
+            arr = np.frombuffer(raw, dt.np_dtype).reshape(shape).copy()
+        arrays.append(arr)
+        if name:
+            names[i] = name
+    meta = {"tensor_names": names} if names else {}
+    return TensorBuffer(tensors=tuple(arrays), format=fmt, meta=meta)
+
+
+@register_decoder("flexbuf")
+class FlexbufEncode(DecoderSubplugin):
+    """tensors → flexbuffers bytes (tensordec-flexbuf analog)."""
+
+    def negotiate(self, in_spec: TensorsSpec) -> OctetSpec:
+        for ti in in_spec.tensors:
+            check_wire_dtype(ti.dtype)
+        self._rate = in_spec.rate
+        return OctetSpec(rate=in_spec.rate)
+
+    def decode(self, buf: TensorBuffer) -> TensorBuffer:
+        frame = encode_flexbuf(buf, rate=getattr(self, "_rate", None))
+        return buf.with_tensors((np.frombuffer(frame, np.uint8).copy(),))
+
+
+@register_converter("flexbuf")
+class FlexbufDecode(ConverterSubplugin):
+    """flexbuffers bytes → tensors (tensor_converter_flexbuf analog)."""
+
+    def negotiate(self, in_spec: MediaSpec) -> TensorsSpec:
+        return TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
+                           rate=in_spec.rate)
+
+    def convert(self, buf: TensorBuffer) -> TensorBuffer:
+        data = np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes()
+        out = decode_flexbuf(data)
+        if buf.pts is not None:
+            out = out.with_tensors(out.tensors, pts=buf.pts)
+        return out

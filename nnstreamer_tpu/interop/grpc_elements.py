@@ -1,0 +1,322 @@
+"""tensor_src_grpc / tensor_sink_grpc — streaming tensors over real gRPC.
+
+Reference parity: ext/nnstreamer/tensor_source/tensor_src_grpc.c +
+tensor_sink/tensor_sink_grpc.c over the shared engine
+ext/nnstreamer/extra/nnstreamer_grpc_common.cc. Same contract:
+
+- service nnstreamer.protobuf.TensorService (interop/tensors.proto),
+  SendTensors (client-streaming) / RecvTensors (server-streaming);
+- every element can run as gRPC *server* or *client* (`server` prop,
+  tensor_src_grpc.c:148-160), so all four pairings work:
+    sink(server) ← src(client) pull, sink(client) → src(server) push;
+- frames are self-describing Tensors messages, so an external process
+  with any gRPC stack + the schema can feed or tap a pipeline.
+
+No generated stubs: method handlers and multicallables are registered
+by path (grpc generic-handler API), the schema module provides the
+serializers. PTS is not part of the interop schema; buffers arrive
+without timestamps and downstream elements treat them as live frames.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Iterator, List, Optional
+
+from google.protobuf import empty_pb2
+
+from nnstreamer_tpu.core.errors import PipelineError, StreamError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.interop import tensors_pb2 as pb
+from nnstreamer_tpu.interop.protobuf_codec import buffer_to_msg, msg_to_buffer
+from nnstreamer_tpu.graph.pipeline import (
+    PropDef, SinkElement, SourceElement, StreamSpec, prop_bool)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+log = get_logger("interop.grpc")
+
+_SERVICE = "nnstreamer.protobuf.TensorService"
+_SEND = f"/{_SERVICE}/SendTensors"
+_RECV = f"/{_SERVICE}/RecvTensors"
+_EOS = object()
+
+
+def _grpc():
+    import grpc  # deferred: keep module import cheap for non-gRPC pipelines
+
+    return grpc
+
+
+def _generic_handler(send_behavior=None, recv_behavior=None):
+    grpc = _grpc()
+    rpcs = {}
+    if send_behavior is not None:
+        rpcs["SendTensors"] = grpc.stream_unary_rpc_method_handler(
+            send_behavior,
+            request_deserializer=pb.Tensors.FromString,
+            response_serializer=empty_pb2.Empty.SerializeToString)
+    if recv_behavior is not None:
+        rpcs["RecvTensors"] = grpc.unary_stream_rpc_method_handler(
+            recv_behavior,
+            request_deserializer=empty_pb2.Empty.FromString,
+            response_serializer=pb.Tensors.SerializeToString)
+    return grpc.method_handlers_generic_handler(_SERVICE, rpcs)
+
+
+def _start_server(handler, host: str, port: int):
+    """→ (server, bound_port). port=0 picks a free port."""
+    grpc = _grpc()
+    from concurrent import futures
+
+    # no SO_REUSEPORT: a port collision must fail loudly, not silently
+    # split traffic between two servers
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8),
+                         options=(("grpc.so_reuseport", 0),))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise PipelineError(f"cannot bind gRPC server on {host}:{port}")
+    server.start()
+    return server, bound
+
+
+@register_element("tensor_sink_grpc")
+class TensorSinkGrpc(SinkElement):
+    """Pipeline egress over gRPC.
+
+    server=true: host RecvTensors; every connected external client gets
+    the stream (fan-out, per-client bounded queue — a slow client drops
+    its own frames, never stalls the pipeline).
+    server=false: connect out and SendTensors the stream.
+    """
+
+    ELEMENT_NAME = "tensor_sink_grpc"
+    WANTS_HOST = True
+    PROPS = {
+        "host": PropDef(str, "127.0.0.1"),
+        "port": PropDef(int, None, "listen/connect port (0 = pick free)"),
+        "server": PropDef(prop_bool, True, "host the service vs connect out"),
+        "queue_size": PropDef(int, 64, "per-client buffer before dropping"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if self.props["port"] is None:
+            raise PipelineError(f"{self.name}: port= is required")
+        self._server = None
+        self._clients: set = set()
+        self._clients_lock = threading.Lock()
+        self._sendq: Optional[_queue.Queue] = None
+        self._sender: Optional[threading.Thread] = None
+        self._send_err: Optional[BaseException] = None
+        self.bound_port: Optional[int] = None
+        self._rate = None
+
+    def negotiate(self, in_specs):
+        self._rate = getattr(in_specs[0], "rate", None)
+        return super().negotiate(in_specs)
+
+    # -- server mode -------------------------------------------------------
+    def _recv_tensors(self, request, context):
+        q: _queue.Queue = _queue.Queue(maxsize=self.props["queue_size"])
+        with self._clients_lock:
+            self._clients.add(q)
+        try:
+            while True:
+                item = q.get()
+                if item is _EOS:
+                    return
+                yield item
+        finally:
+            with self._clients_lock:
+                self._clients.discard(q)
+
+    # -- client mode -------------------------------------------------------
+    def _send_loop(self):
+        grpc = _grpc()
+        chan = grpc.insecure_channel(f"{self.props['host']}:{self.props['port']}")
+        send = chan.stream_unary(
+            _SEND,
+            request_serializer=pb.Tensors.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+
+        def frames():
+            while True:
+                item = self._sendq.get()
+                if item is _EOS:
+                    return
+                yield item
+
+        try:
+            # wait_for_ready: don't fail fast if our peer pipeline is
+            # still binding its server (startup ordering is unsynchronized)
+            send(frames(), wait_for_ready=True)
+        except BaseException as e:  # surfaced on the next render()
+            self._send_err = e
+        finally:
+            chan.close()
+
+    # -- element lifecycle ---------------------------------------------------
+    def start(self) -> None:
+        if self.props["server"]:
+            self._server, self.bound_port = _start_server(
+                _generic_handler(recv_behavior=self._recv_tensors),
+                self.props["host"], self.props["port"])
+            log.info("%s: serving RecvTensors on :%d", self.name, self.bound_port)
+        else:
+            self._sendq = _queue.Queue(maxsize=self.props["queue_size"])
+            self._sender = threading.Thread(
+                target=self._send_loop, name=f"{self.name}-send", daemon=True)
+            self._sender.start()
+
+    def render(self, buf: TensorBuffer) -> None:
+        msg = buffer_to_msg(buf, rate=self._rate)
+        if self.props["server"]:
+            with self._clients_lock:
+                clients = list(self._clients)
+            for q in clients:
+                try:
+                    q.put_nowait(msg)
+                except _queue.Full:
+                    pass  # that client lags; drop its frame, not the stream
+        else:
+            if self._send_err is not None:
+                raise StreamError(
+                    f"{self.name}: gRPC send stream failed: {self._send_err}")
+            self._sendq.put(msg)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            with self._clients_lock:
+                for q in self._clients:
+                    q.put(_EOS)
+            self._server.stop(grace=0.5)
+            self._server = None
+        if self._sender is not None:
+            self._sendq.put(_EOS)
+            self._sender.join(timeout=5)
+            self._sender = None
+
+
+@register_element("tensor_src_grpc")
+class TensorSrcGrpc(SourceElement):
+    """Pipeline ingress over gRPC.
+
+    server=true: host SendTensors; external clients stream frames in.
+    server=false: connect out and pull via RecvTensors.
+    Output spec comes from dims=/types= or is sniffed from frame 1
+    (ipc_src convention).
+    """
+
+    ELEMENT_NAME = "tensor_src_grpc"
+    PROPS = {
+        "host": PropDef(str, "127.0.0.1"),
+        "port": PropDef(int, None, "listen/connect port (0 = pick free)"),
+        "server": PropDef(prop_bool, True),
+        "dims": PropDef(str, "", "expected dims (else sniffed from frame 1)"),
+        "types": PropDef(str, "float32"),
+        "sniff_timeout": PropDef(float, 10.0, "first-frame wait, s"),
+        "queue_size": PropDef(int, 64),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if self.props["port"] is None:
+            raise PipelineError(f"{self.name}: port= is required")
+        self._q: _queue.Queue = _queue.Queue(maxsize=self.props["queue_size"])
+        self._stop = threading.Event()
+        self._server = None
+        self._puller: Optional[threading.Thread] = None
+        self._pull_err: Optional[BaseException] = None
+        self._sniffed: Optional[TensorBuffer] = None
+        self.bound_port: Optional[int] = None
+
+    # -- server mode ---------------------------------------------------------
+    def _send_tensors(self, request_iterator, context):
+        for msg in request_iterator:
+            if self._stop.is_set():
+                break
+            self._q.put(msg)
+        return empty_pb2.Empty()
+
+    # -- client mode ---------------------------------------------------------
+    def _pull_loop(self):
+        grpc = _grpc()
+        chan = grpc.insecure_channel(f"{self.props['host']}:{self.props['port']}")
+        recv = chan.unary_stream(
+            _RECV,
+            request_serializer=empty_pb2.Empty.SerializeToString,
+            response_deserializer=pb.Tensors.FromString)
+        try:
+            for msg in recv(empty_pb2.Empty(), wait_for_ready=True):
+                if self._stop.is_set():
+                    break
+                self._q.put(msg)
+        except BaseException as e:
+            if not self._stop.is_set():
+                self._pull_err = e
+        finally:
+            chan.close()
+            self._q.put(_EOS)
+
+    def _ensure_running(self):
+        if self.props["server"]:
+            if self._server is None:
+                self._server, self.bound_port = _start_server(
+                    _generic_handler(send_behavior=self._send_tensors),
+                    self.props["host"], self.props["port"])
+                log.info("%s: serving SendTensors on :%d",
+                         self.name, self.bound_port)
+        elif self._puller is None:
+            self._puller = threading.Thread(
+                target=self._pull_loop, name=f"{self.name}-pull", daemon=True)
+            self._puller.start()
+
+    def _next_msg(self, timeout: float):
+        try:
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def output_spec(self) -> StreamSpec:
+        if self.props["dims"]:
+            return TensorsSpec.from_strings(self.props["dims"],
+                                            self.props["types"])
+        self._ensure_running()
+        msg = self._next_msg(self.props["sniff_timeout"])
+        if msg is None or msg is _EOS:
+            raise PipelineError(
+                f"{self.name}: no frame arrived within "
+                f"{self.props['sniff_timeout']}s to sniff the stream type; "
+                f"declare dims=/types= to negotiate without sniffing")
+        self._sniffed = msg_to_buffer(msg)
+        return self._sniffed.spec()
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        self._ensure_running()
+        if self._sniffed is not None:
+            yield self._sniffed
+            self._sniffed = None
+        while not self._stop.is_set():
+            msg = self._next_msg(0.1)
+            if msg is _EOS:
+                if self._pull_err is not None:
+                    raise StreamError(
+                        f"{self.name}: gRPC receive stream failed: "
+                        f"{self._pull_err}")
+                return
+            if msg is None:
+                continue
+            yield msg_to_buffer(msg)
+
+    def interrupt(self) -> None:
+        self._stop.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None

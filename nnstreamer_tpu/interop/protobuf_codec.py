@@ -1,0 +1,153 @@
+"""Protobuf tensor-stream codec — schema'd interop serialization.
+
+Reference parity: tensordec-protobuf.cc + tensor_converter_protobuf.cc
+(both thin wrappers over ext/nnstreamer/extra/nnstreamer_protobuf.cc).
+Unlike edge/wire.py (our private schema-free codec), this format is a
+published contract: any external process holding interop/tensors.proto —
+including an unmodified nnstreamer with its protobuf subplugins — can
+produce and consume these frames.
+
+Pipeline usage mirrors the reference:
+
+    ... ! tensor_decoder mode=protobuf ! <byte transport> !
+    tensor_converter mode=custom:protobuf ! ...
+
+Format semantics (from nnstreamer_protobuf.cc:60-200):
+  - dimension[] is innermost-first (reverse numpy shape), padded with 1
+    to rank 4 (gst_tensor_parse_dimension pads with 1,
+    nnstreamer_plugin_api_util_impl.c:911-912). Rank is not on the wire,
+    so decode canonicalizes by stripping trailing 1-dims.
+  - FLEXIBLE/SPARSE: each data blob is prefixed with a GstTensorMetaInfo
+    v1 header (interop/gst_meta.py — the reference's own layout), which
+    *does* preserve exact rank/shape; the padded dims are advisory.
+  - float16/bfloat16 have no slot in the 10-value enum; encoding them
+    raises with a typecast hint rather than shipping wrong bytes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.elements.converter import ConverterSubplugin, register_converter
+from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
+from nnstreamer_tpu.graph.media import MediaSpec, OctetSpec
+from nnstreamer_tpu.interop import tensors_pb2 as pb
+from nnstreamer_tpu.interop.gst_meta import (
+    HEADER_SIZE,
+    check_wire_dtype,
+    pack_gst_meta,
+    parse_gst_meta,
+    shape_from_wire,
+    wire_dims,
+)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+
+def buffer_to_msg(buf: TensorBuffer, rate=None) -> "pb.Tensors":
+    """TensorBuffer → nnstreamer.protobuf.Tensors message."""
+    msg = pb.Tensors()
+    msg.num_tensor = buf.num_tensors
+    msg.format = int(buf.format)
+    if rate is not None and rate:
+        frac = rate if isinstance(rate, tuple) else (rate, 1)
+        msg.fr.rate_n, msg.fr.rate_d = int(frac[0]), int(frac[1])
+    non_static = buf.format != TensorFormat.STATIC
+    for i, t in enumerate(buf.tensors):
+        arr = np.ascontiguousarray(np.asarray(t))
+        dt = DType.from_np(arr.dtype)
+        check_wire_dtype(dt)
+        entry = msg.tensor.add()
+        entry.name = str(buf.meta.get("tensor_names", {}).get(i, ""))
+        entry.type = int(dt)
+        entry.dimension.extend(wire_dims(arr.shape))
+        raw = arr.tobytes()
+        if non_static:
+            # flexible/sparse payloads carry a GstTensorMetaInfo header
+            # so exact shape survives the rank-4 dims
+            # (nnstreamer_protobuf.cc:80, is_flexible branch)
+            raw = pack_gst_meta(arr.shape, dt, buf.format) + raw
+        entry.data = raw
+    return msg
+
+
+def encode_protobuf(buf: TensorBuffer, rate=None) -> bytes:
+    """TensorBuffer → serialized Tensors frame."""
+    return buffer_to_msg(buf, rate).SerializeToString()
+
+
+def decode_protobuf(frame: bytes) -> TensorBuffer:
+    """Serialized Tensors frame → TensorBuffer (host numpy)."""
+    msg = pb.Tensors()
+    try:
+        msg.ParseFromString(bytes(frame))
+    except Exception as e:
+        raise StreamError(f"corrupt protobuf tensor frame: {e}") from None
+    return msg_to_buffer(msg)
+
+
+def msg_to_buffer(msg: "pb.Tensors") -> TensorBuffer:
+    """Tensors message → TensorBuffer (host numpy)."""
+    fmt = TensorFormat(msg.format)
+    arrays, names = [], {}
+    for i, entry in enumerate(msg.tensor):
+        dt = DType(entry.type)
+        raw = entry.data
+        if fmt != TensorFormat.STATIC and len(raw) >= HEADER_SIZE:
+            shape, hdt, _, _, _, off = parse_gst_meta(raw)
+            arr = np.frombuffer(raw, hdt.np_dtype, offset=off,
+                                count=math.prod(shape))
+            arr = arr.reshape(shape).copy()
+        else:
+            shape = shape_from_wire(entry.dimension)
+            n = math.prod(shape) if shape else 1
+            if n * dt.itemsize != len(raw):
+                raise StreamError(
+                    f"protobuf tensor #{i}: {len(raw)} payload bytes != "
+                    f"{n} elements of {dt.type_name} "
+                    f"({n * dt.itemsize} bytes) from dims {list(entry.dimension)}"
+                )
+            arr = np.frombuffer(raw, dt.np_dtype).reshape(shape).copy()
+        arrays.append(arr)
+        if entry.name:
+            names[i] = entry.name
+    meta = {"tensor_names": names} if names else {}
+    return TensorBuffer(tensors=tuple(arrays), format=fmt, meta=meta)
+
+
+@register_decoder("protobuf")
+class ProtobufEncode(DecoderSubplugin):
+    """tensors → protobuf frame bytes (tensordec-protobuf analog)."""
+
+    def negotiate(self, in_spec: TensorsSpec) -> OctetSpec:
+        for ti in in_spec.tensors:
+            check_wire_dtype(ti.dtype)
+        self._rate = in_spec.rate
+        return OctetSpec(rate=in_spec.rate)
+
+    def decode(self, buf: TensorBuffer) -> TensorBuffer:
+        frame = encode_protobuf(buf, rate=getattr(self, "_rate", None))
+        return buf.with_tensors((np.frombuffer(frame, np.uint8).copy(),))
+
+
+@register_converter("protobuf")
+class ProtobufDecode(ConverterSubplugin):
+    """protobuf frame bytes → tensors (tensor_converter_protobuf analog).
+
+    Output is FLEXIBLE: every frame is self-describing and shapes may
+    vary per buffer, exactly like the wire/flexbuf converters."""
+
+    def negotiate(self, in_spec: MediaSpec) -> TensorsSpec:
+        return TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
+                           rate=in_spec.rate)
+
+    def convert(self, buf: TensorBuffer) -> TensorBuffer:
+        data = np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes()
+        out = decode_protobuf(data)
+        if buf.pts is not None:
+            out = out.with_tensors(out.tensors, pts=buf.pts)
+        return out

@@ -511,45 +511,65 @@ def test_if_fill_with_file_missing_fails_at_build():
                  else_option="/nonexistent/fill.raw")
 
 
-def test_if_repeat_previous():
+def test_if_repeat_previous_no_history_skips():
     iff = TensorIf(name="i", operator="gt", supplied_value="5",
-                   then="passthrough", else_="repeat_previous")
-    # else routes to pad 1; repeat_previous repeats what pad 1 last saw —
-    # nothing yet, so frame 1 vanishes; then frame 3 repeats frame 2?
-    # No: pads are separate. Route then+else into the SAME sink via two
-    # sinks and check the else-pad repetition of its own history.
+                   then="repeat_previous", else_="skip")
     src = AppSrc(spec=spec_of((4,)), name="src")
     s_then, s_else = TensorSink(name="t"), TensorSink(name="e")
     pipe = run_graph(
         [src, iff, s_then, s_else],
         [(src, iff), (iff, s_then, 0, 0), (iff, s_else, 1, 0)],
-        {"src": [_val_buf(1, 0), _val_buf(9, 1), _val_buf(2, 2)]})
-    assert len(pipe.get("t").results) == 1            # the 9
-    # frame 0: no previous on else pad → skipped; frame 2: still no else-
-    # pad history? fill happened: _prev_out tracks per-pad; frame 0
-    # emitted nothing, so pad1 history starts empty; frame 2 also emits
-    # nothing. else sink stays empty.
+        {"src": [_val_buf(9, 0), _val_buf(8, 1)]})
+    # nothing was ever forwarded, so there is nothing to repeat
+    assert len(pipe.get("t").results) == 0
     assert len(pipe.get("e").results) == 0
 
 
-def test_if_repeat_previous_passthrough_history():
-    """then=repeat_previous repeats the last then-pad emission."""
-    iff = TensorIf(name="i", operator="le", supplied_value="5",
-                   then="passthrough", else_="skip")
-    # sanity base: le routes 1,2 to then
-    iff2 = TensorIf(name="i", operator="gt", supplied_value="5",
-                    then="repeat_previous", else_="passthrough")
+def test_if_repeat_previous_repeats_last_forwarded():
+    """else=repeat_previous re-sends the last good (then) frame with the
+    failing frame's PTS — the hold-last-value idiom."""
+    iff = TensorIf(name="i", operator="gt", supplied_value="5",
+                   then="passthrough", else_="repeat_previous")
     src = AppSrc(spec=spec_of((4,)), name="src")
     s_then, s_else = TensorSink(name="t"), TensorSink(name="e")
     pipe = run_graph(
-        [src, iff2, s_then, s_else],
-        [(src, iff2), (iff2, s_then, 0, 0), (iff2, s_else, 1, 0)],
-        {"src": [_val_buf(9, 0), _val_buf(1, 1), _val_buf(8, 2)]})
-    # frame 0 (9>5): then=repeat_previous, no history → nothing
-    # frame 1 (1≤5): else passthrough
-    # frame 2 (8>5): repeat_previous: still no then-pad history → nothing
-    assert len(pipe.get("t").results) == 0
-    assert len(pipe.get("e").results) == 1
+        [src, iff, s_then, s_else],
+        [(src, iff), (iff, s_then, 0, 0), (iff, s_else, 1, 0)],
+        {"src": [_val_buf(9, 0), _val_buf(1, 1), _val_buf(7, 2),
+                 _val_buf(2, 3)]})
+    t_res, e_res = pipe.get("t").results, pipe.get("e").results
+    assert len(t_res) == 2                           # 9, 7 pass
+    assert len(e_res) == 2                           # 1, 2 repeat history
+    np.testing.assert_array_equal(e_res[0].tensors[0],
+                                  t_res[0].tensors[0])   # repeats the 9
+    np.testing.assert_array_equal(e_res[1].tensors[0],
+                                  t_res[1].tensors[0])   # repeats the 7
+    assert e_res[0].pts == _val_buf(1, 1).pts        # current frame's PTS
+
+
+def test_if_fill_actions_are_per_branch(tmp_path):
+    """then and else each have their own fill material (regression: a
+    shared attribute let else's file clobber then's)."""
+    a, b = (np.full(4, 11, np.float32), np.full(4, 22, np.float32))
+    fa, fb = tmp_path / "a.raw", tmp_path / "b.raw"
+    fa.write_bytes(a.tobytes())
+    fb.write_bytes(b.tobytes())
+    iff = TensorIf(name="i", operator="gt", supplied_value="5",
+                   then="fill_with_file", then_option=str(fa),
+                   else_="fill_with_file", else_option=str(fb))
+    src = AppSrc(spec=spec_of((4,)), name="src")
+    s_then, s_else = TensorSink(name="t"), TensorSink(name="e")
+    pipe = run_graph(
+        [src, iff, s_then, s_else],
+        [(src, iff), (iff, s_then, 0, 0), (iff, s_else, 1, 0)],
+        {"src": [_val_buf(9, 0), _val_buf(1, 1)]})
+    np.testing.assert_array_equal(pipe.get("t").results[0].tensors[0], a)
+    np.testing.assert_array_equal(pipe.get("e").results[0].tensors[0], b)
+
+
+def test_if_fill_values_bad_option_fails_at_build():
+    with pytest.raises(nns.core.errors.PipelineError, match="fill_values"):
+        TensorIf(name="i", else_="fill_values", else_option="1,x")
 
 
 # -- tensor_rate upstream QoS (skip-before-compute) --------------------------

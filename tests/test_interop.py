@@ -1,0 +1,363 @@
+"""Interop serialization + gRPC tests.
+
+The load-bearing property here is *externality*: frames our codecs emit
+must parse in a process that knows nothing about nnstreamer_tpu (only
+the published schema / a stock flexbuffers or gRPC library), and frames
+such a process emits must parse in ours. Reference analog:
+tests/nnstreamer_converter_{protobuf,flexbuf}, nnstreamer_decoder_*,
+nnstreamer_grpc (SURVEY.md §4).
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.interop.flexbuf_codec import decode_flexbuf, encode_flexbuf
+from nnstreamer_tpu.interop.gst_meta import (
+    pack_gst_meta, parse_gst_meta, shape_from_wire, wire_dims)
+from nnstreamer_tpu.interop.protobuf_codec import (
+    decode_protobuf, encode_protobuf)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorFormat
+
+INTEROP_DIR = "nnstreamer_tpu/interop"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- GstTensorMetaInfo header -------------------------------------------------
+
+def test_gst_meta_roundtrip_preserves_rank():
+    for shape in [(7,), (3, 4), (1, 8, 8, 3), (2, 1, 1, 1, 5)]:
+        hdr = pack_gst_meta(shape, DType.FLOAT32)
+        assert len(hdr) == 128
+        out_shape, dt, fmt, _, _, off = parse_gst_meta(hdr + b"payload")
+        assert out_shape == shape
+        assert dt == DType.FLOAT32 and off == 128
+
+
+def test_gst_meta_rejects_garbage_and_zero_dims():
+    with pytest.raises(StreamError, match="version"):
+        parse_gst_meta(b"\x00" * 128)
+    with pytest.raises(StreamError, match="zero"):
+        pack_gst_meta((0, 3), DType.UINT8)
+    with pytest.raises(StreamError, match="small"):
+        parse_gst_meta(b"\xde\x00\x00\x00")
+
+
+def test_wire_dims_convention():
+    # innermost-first, 1-padded to rank 4 (reference pad convention)
+    assert wire_dims((1, 224, 224, 3)) == [3, 224, 224, 1]
+    assert wire_dims((5,)) == [5, 1, 1, 1]
+    assert shape_from_wire([3, 224, 224, 1]) == (224, 224, 3)
+    assert shape_from_wire([5, 1, 1, 1]) == (5,)
+
+
+# -- codec roundtrips ---------------------------------------------------------
+
+CODECS = [(encode_protobuf, decode_protobuf, "protobuf"),
+          (encode_flexbuf, decode_flexbuf, "flexbuf")]
+
+
+@pytest.mark.parametrize("enc,dec,name", CODECS)
+def test_static_roundtrip_multi_tensor(enc, dec, name):
+    buf = TensorBuffer.of(
+        np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+        np.arange(6, dtype=np.uint8),
+        np.array([1.5, -2.5], np.float64))
+    out = dec(enc(buf, rate=(30, 1)))
+    assert out.num_tensors == 3 and out.format == TensorFormat.STATIC
+    for got, want in zip(out.tensors, buf.tensors):
+        np.testing.assert_array_equal(got, want)
+        assert got.dtype == want.dtype
+
+
+@pytest.mark.parametrize("enc,dec,name", CODECS)
+def test_static_leading_one_dims_canonicalize(enc, dec, name):
+    # rank is not on the static wire (fixed rank-4, 1-padded dims), so a
+    # leading batch-1 dim canonicalizes away; FLEXIBLE preserves it
+    buf = TensorBuffer.of(np.zeros((1, 2), np.float64))
+    out = dec(enc(buf))
+    assert out.tensors[0].shape == (2,)
+
+
+@pytest.mark.parametrize("enc,dec,name", CODECS)
+def test_flexible_roundtrip_preserves_exact_shape(enc, dec, name):
+    # leading-1 rank would be lost in padded dims; the GstTensorMetaInfo
+    # prefix must preserve it on FLEXIBLE streams
+    buf = TensorBuffer.of(np.ones((1, 8, 8, 3), np.uint8),
+                          format=TensorFormat.FLEXIBLE)
+    out = dec(enc(buf))
+    assert out.tensors[0].shape == (1, 8, 8, 3)
+    assert out.format == TensorFormat.FLEXIBLE
+
+
+@pytest.mark.parametrize("enc,dec,name", CODECS)
+def test_tensor_names_travel(enc, dec, name):
+    buf = TensorBuffer.of(np.zeros(3, np.int32))
+    buf.meta["tensor_names"] = {0: "logits"}
+    out = dec(enc(buf))
+    assert out.meta["tensor_names"][0] == "logits"
+
+
+@pytest.mark.parametrize("enc,dec,name", CODECS)
+def test_bfloat16_rejected_with_typecast_hint(enc, dec, name):
+    import ml_dtypes
+    buf = TensorBuffer.of(np.zeros(4, dtype=ml_dtypes.bfloat16))
+    with pytest.raises(StreamError, match="typecast"):
+        enc(buf)
+
+
+@pytest.mark.parametrize("dec", [decode_protobuf, decode_flexbuf])
+def test_corrupt_frames_rejected(dec):
+    with pytest.raises(StreamError, match="corrupt|payload bytes"):
+        dec(b"\xff" * 64)
+
+
+def test_protobuf_payload_size_mismatch_rejected():
+    from nnstreamer_tpu.interop import tensors_pb2 as pb
+    msg = pb.Tensors(num_tensor=1)
+    t = msg.tensor.add()
+    t.type = int(DType.FLOAT32)
+    t.dimension.extend([4, 1, 1, 1])
+    t.data = b"\x00" * 7   # 4 floats need 16 bytes
+    with pytest.raises(StreamError, match="payload bytes"):
+        decode_protobuf(msg.SerializeToString())
+
+
+# -- externality: a process that never imports nnstreamer_tpu ----------------
+
+def _run_external(script: str, stdin: bytes = b"") -> bytes:
+    """Run a python snippet with the repo OFF sys.path except the interop
+    dir (for the generated pb2 module only)."""
+    proc = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(script)],
+        input=stdin, capture_output=True, timeout=60, cwd="/tmp")
+    assert proc.returncode == 0, proc.stderr.decode()
+    return proc.stdout
+
+
+def test_external_process_parses_our_protobuf_frames(tmp_path):
+    buf = TensorBuffer.of(np.arange(12, dtype=np.float32).reshape(3, 4))
+    frame = encode_protobuf(buf, rate=(30, 1))
+    out = _run_external(f"""
+        import sys
+        sys.path.insert(0, {str(nns.__path__[0] + '/interop')!r})
+        import numpy as np
+        import tensors_pb2  # generated from the published schema only
+        msg = tensors_pb2.Tensors()
+        msg.ParseFromString(sys.stdin.buffer.read())
+        assert msg.num_tensor == 1
+        assert msg.fr.rate_n == 30 and msg.fr.rate_d == 1
+        t = msg.tensor[0]
+        assert list(t.dimension) == [4, 3, 1, 1]
+        arr = np.frombuffer(t.data, np.float32)
+        sys.stdout.buffer.write(arr.tobytes())
+    """, stdin=frame)
+    np.testing.assert_array_equal(
+        np.frombuffer(out, np.float32).reshape(3, 4), buf.tensors[0])
+
+
+def test_our_decoder_parses_external_protobuf_frames():
+    frame = _run_external(f"""
+        import sys
+        sys.path.insert(0, {str(nns.__path__[0] + '/interop')!r})
+        import numpy as np
+        import tensors_pb2
+        msg = tensors_pb2.Tensors(num_tensor=1)
+        msg.fr.rate_n, msg.fr.rate_d = 15, 1
+        t = msg.tensor.add()
+        t.name = "ext"
+        t.type = 7  # NNS_FLOAT32
+        t.dimension.extend([2, 5, 1, 1])   # innermost-first
+        t.data = np.arange(10, dtype=np.float32).tobytes()
+        sys.stdout.buffer.write(msg.SerializeToString())
+    """)
+    out = decode_protobuf(frame)
+    np.testing.assert_array_equal(
+        out.tensors[0], np.arange(10, dtype=np.float32).reshape(5, 2))
+    assert out.meta["tensor_names"][0] == "ext"
+
+
+def test_external_process_parses_our_flexbuf_frames():
+    buf = TensorBuffer.of(np.arange(6, dtype=np.uint8).reshape(2, 3))
+    frame = encode_flexbuf(buf, rate=(10, 1))
+    out = _run_external("""
+        import sys
+        from flatbuffers import flexbuffers  # stock library, no schema
+        root = flexbuffers.GetRoot(bytearray(sys.stdin.buffer.read())).AsMap
+        assert root["num_tensors"].AsInt == 1
+        assert root["rate_n"].AsInt == 10
+        vec = root["tensor_0"].AsVector
+        assert [e.AsInt for e in vec[2].AsTypedVector] == [3, 2, 1, 1]
+        sys.stdout.buffer.write(bytes(vec[3].AsBlob))
+    """, stdin=frame)
+    np.testing.assert_array_equal(
+        np.frombuffer(out, np.uint8).reshape(2, 3), buf.tensors[0])
+
+
+def test_our_converter_parses_external_flexbuf_frames():
+    frame = _run_external("""
+        import sys
+        import numpy as np
+        from flatbuffers import flexbuffers
+        fbb = flexbuffers.Builder()
+        with fbb.Map():
+            fbb.Key("num_tensors"); fbb.UInt(1)
+            fbb.Key("rate_n"); fbb.Int(0)
+            fbb.Key("rate_d"); fbb.Int(1)
+            fbb.Key("format"); fbb.Int(0)
+            fbb.Key("tensor_0")
+            with fbb.Vector():
+                fbb.String(""); fbb.Int(5)  # NNS_UINT8
+                fbb.TypedVectorFromElements([4, 2, 1, 1])
+                fbb.Blob(np.arange(8, dtype=np.uint8).tobytes())
+        sys.stdout.buffer.write(bytes(fbb.Finish()))
+    """)
+    out = decode_flexbuf(frame)
+    np.testing.assert_array_equal(
+        out.tensors[0], np.arange(8, dtype=np.uint8).reshape(2, 4))
+
+
+# -- pipeline integration -----------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["protobuf", "flexbuf"])
+def test_pipeline_decoder_converter_roundtrip(codec):
+    pipe = nns.parse_launch(
+        f"appsrc name=in dims=3:4 types=float32 ! "
+        f"tensor_decoder mode={codec} ! "
+        f"tensor_converter mode=custom:{codec} ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    src = pipe.get("in")
+    frames = [np.random.default_rng(i).standard_normal((4, 3)).astype(np.float32)
+              for i in range(3)]
+    for f in frames:
+        src.push(TensorBuffer.of(f, pts=1000))
+    src.end()
+    runner.wait(30)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 3
+    for got, want in zip(res, frames):
+        np.testing.assert_array_equal(got.tensors[0], want)
+        assert got.pts == 1000  # PTS survives the byte hop
+
+
+# -- gRPC elements ------------------------------------------------------------
+
+def _grpc_channel(port):
+    import grpc
+    from google.protobuf import empty_pb2
+    from nnstreamer_tpu.interop import tensors_pb2 as pb
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    grpc.channel_ready_future(chan).result(timeout=10)
+    return chan, pb, empty_pb2
+
+
+def test_grpc_sink_server_streams_to_external_client():
+    port = free_port()
+    pipe = nns.parse_launch(
+        f"appsrc name=in dims=4:2 types=float32 ! "
+        f"tensor_sink_grpc name=out port={port} server=true")
+    runner = nns.PipelineRunner(pipe).start()
+    chan, pb, empty_pb2 = _grpc_channel(port)
+    recv = chan.unary_stream(
+        "/nnstreamer.protobuf.TensorService/RecvTensors",
+        request_serializer=empty_pb2.Empty.SerializeToString,
+        response_deserializer=pb.Tensors.FromString)
+    got = []
+    stream = recv(empty_pb2.Empty())
+    collector = threading.Thread(
+        target=lambda: [got.append(m) for m in stream], daemon=True)
+    collector.start()
+    time.sleep(0.3)   # let the client subscribe before frames flow
+    src = pipe.get("in")
+    frames = [np.full((2, 4), i, np.float32) for i in range(4)]
+    for f in frames:
+        src.push(TensorBuffer.of(f))
+    src.end()
+    runner.wait(30)
+    runner.stop()      # EOS closes client streams
+    collector.join(timeout=10)
+    chan.close()
+    assert len(got) == 4
+    arr = np.frombuffer(got[2].tensor[0].data, np.float32)
+    np.testing.assert_array_equal(arr, np.full(8, 2, np.float32))
+
+
+def test_grpc_src_server_accepts_external_client_stream():
+    port = free_port()
+    pipe = nns.parse_launch(
+        f"tensor_src_grpc name=in port={port} server=true dims=4:2 "
+        f"types=float32 ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    chan, pb, empty_pb2 = _grpc_channel(port)
+    send = chan.stream_unary(
+        "/nnstreamer.protobuf.TensorService/SendTensors",
+        request_serializer=pb.Tensors.SerializeToString,
+        response_deserializer=empty_pb2.Empty.FromString)
+
+    def frames():
+        for i in range(3):
+            msg = pb.Tensors(num_tensor=1)
+            t = msg.tensor.add()
+            t.type = 7
+            t.dimension.extend([4, 2, 1, 1])
+            t.data = np.full((2, 4), i, np.float32).tobytes()
+            yield msg
+
+    send(frames())
+    deadline = time.time() + 15
+    sink = pipe.get("out")
+    while len(sink.results) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    pipe.get("in").interrupt()
+    runner.stop()
+    chan.close()
+    assert len(sink.results) == 3
+    np.testing.assert_array_equal(
+        sink.results[1].tensors[0], np.full((2, 4), 1, np.float32))
+
+
+def test_grpc_pipeline_to_pipeline_bridge():
+    """sink(client) --SendTensors--> src(server): two pipelines bridged
+    over real gRPC, the reference's grpc loopback test shape."""
+    port = free_port()
+    recv_pipe = nns.parse_launch(
+        f"tensor_src_grpc name=in port={port} server=true dims=3 "
+        f"types=int32 ! tensor_sink name=out")
+    recv_runner = nns.PipelineRunner(recv_pipe).start()
+
+    send_pipe = nns.parse_launch(
+        f"appsrc name=src dims=3 types=int32 ! "
+        f"tensor_sink_grpc port={port} server=false")
+    send_runner = nns.PipelineRunner(send_pipe).start()
+    src = send_pipe.get("src")
+    for i in range(5):
+        src.push(TensorBuffer.of(np.array([i, i + 1, i + 2], np.int32)))
+    src.end()
+    send_runner.wait(30)
+
+    sink = recv_pipe.get("out")
+    deadline = time.time() + 15
+    while len(sink.results) < 5 and time.time() < deadline:
+        time.sleep(0.05)
+    send_runner.stop()
+    recv_pipe.get("in").interrupt()
+    recv_runner.stop()
+    assert len(sink.results) == 5
+    np.testing.assert_array_equal(sink.results[4].tensors[0],
+                                  np.array([4, 5, 6], np.int32))
