@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 
 def _inspect(name: str | None) -> int:
@@ -66,8 +67,26 @@ def main(argv=None) -> int:
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture an xprof/TensorBoard device trace of the "
                          "run into DIR (jax.profiler)")
+    ap.add_argument("--broker", nargs="?", const=1883, default=None,
+                    type=int, metavar="PORT",
+                    help="run a standalone EdgeBroker (discovery + pub/sub "
+                         "+ clock service) on PORT (default 1883)")
+    ap.add_argument("--bind", default="0.0.0.0",
+                    help="bind address for --broker (default 0.0.0.0)")
     args = ap.parse_args(argv)
 
+    if args.broker is not None:
+        from nnstreamer_tpu.edge.broker import EdgeBroker
+
+        broker = EdgeBroker(args.bind, args.broker)
+        print(f"edge broker listening on {args.bind}:{broker.port} "
+              f"(^C to stop)", file=sys.stderr)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            broker.close()
+            return 0
     if args.inspect is not None:
         return _inspect(args.inspect or None)
     if args.models:

@@ -12,8 +12,11 @@ Modules:
 - protocol.py — length-prefixed TCP message transport (client/server)
 - query.py    — tensor_query_client / serversrc / serversink elements
 - pubsub.py   — edgesink (publisher) / edgesrc (subscriber) elements
+- broker.py   — EdgeBroker: HYBRID discovery + brokered pub/sub + clock
+                alignment (MQTT/NTP analog); mqttsink/mqttsrc ride it
 """
 
+from nnstreamer_tpu.edge.broker import BrokerClient, EdgeBroker
 from nnstreamer_tpu.edge.query import (
     QueryServer, TensorQueryClient, TensorQueryServerSink,
     TensorQueryServerSrc)
@@ -21,6 +24,8 @@ from nnstreamer_tpu.edge.pubsub import EdgeSink, EdgeSrc
 from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
 
 __all__ = [
+    "BrokerClient",
+    "EdgeBroker",
     "EdgeSink",
     "EdgeSrc",
     "QueryServer",

@@ -148,12 +148,22 @@ class TensorQueryServerSrc(SourceElement):
         "id": PropDef(int, 0, "server pair id"),
         "dims": PropDef(str, None, "accepted input dims"),
         "types": PropDef(str, "float32"),
+        # HYBRID connect type (tensor_query_common.c:35-39): advertise
+        # this server under topic= at an EdgeBroker so clients find it by
+        # name instead of host:port
+        "broker_host": PropDef(str, "127.0.0.1"),
+        "broker_port": PropDef(int, 0, "EdgeBroker port (0 = no broker)"),
+        "topic": PropDef(str, "", "service name to register at the broker"),
+        "advertise_host": PropDef(
+            str, "", "address clients should dial (required when binding "
+                     "a wildcard like 0.0.0.0)"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._srv: Optional[QueryServer] = None
         self._stop = threading.Event()
+        self._broker = None
 
     def output_spec(self) -> StreamSpec:
         if not self.props["dims"]:
@@ -167,6 +177,23 @@ class TensorQueryServerSrc(SourceElement):
         self._srv = QueryServer.get(self.props["id"])
         self._srv.in_spec = self.out_specs[0]
         self._srv.start(self.props["host"], self.props["port"])
+        if self.props["broker_port"]:
+            if not self.props["topic"]:
+                raise PipelineError(
+                    f"{self.name}: broker registration needs topic=<name>")
+            from nnstreamer_tpu.edge.broker import BrokerClient
+
+            advertise = self.props["advertise_host"] or self.props["host"]
+            if advertise in ("0.0.0.0", "::"):
+                raise PipelineError(
+                    f"{self.name}: binding {advertise} but registering at "
+                    f"a broker — clients cannot dial a wildcard address; "
+                    f"set advertise_host=<reachable address>")
+            # the registration lives as long as this connection: broker
+            # drops it if we crash (no stale addresses)
+            self._broker = BrokerClient(self.props["broker_host"],
+                                        self.props["broker_port"])
+            self._broker.register(self.props["topic"], advertise, self.port)
 
     @property
     def port(self) -> int:
@@ -182,6 +209,9 @@ class TensorQueryServerSrc(SourceElement):
                 pass
 
     def stop(self) -> None:
+        if self._broker is not None:
+            self._broker.close()
+            self._broker = None
         if self._srv is not None:
             self._srv.stop()
 
@@ -232,8 +262,13 @@ class TensorQueryClient(Element):
     ELEMENT_NAME = "tensor_query_client"
     PROPS = {
         "host": PropDef(str, "127.0.0.1"),
-        "port": PropDef(int, None, "server port (required)"),
+        "port": PropDef(int, None, "server port (tcp) / broker port (hybrid)"),
         "timeout": PropDef(float, 10.0, "per-frame reply timeout, s"),
+        # connect_type=hybrid: host/port point at an EdgeBroker; the
+        # server address is discovered by topic= (MQTT-discovery + TCP-
+        # data pattern, tensor_query_common.c:39)
+        "connect_type": PropDef(str, "tcp", "tcp | hybrid"),
+        "topic": PropDef(str, "", "service name (hybrid)"),
     }
 
     def __init__(self, name=None, **props):
@@ -246,10 +281,31 @@ class TensorQueryClient(Element):
         spec = self.expect_tensors(in_specs[0])
         if not self.props["port"]:
             self.fail_negotiation("port= of the query server is required")
+        host, port = self.props["host"], int(self.props["port"])
+        if self.props["connect_type"] == "hybrid":
+            if not self.props["topic"]:
+                self.fail_negotiation(
+                    "connect_type=hybrid needs topic=<service name> "
+                    "(host/port address the broker)")
+            from nnstreamer_tpu.edge.broker import BrokerClient
+
+            try:
+                bc = BrokerClient(host, port)
+                host, port = bc.lookup(self.props["topic"],
+                                       timeout=self.props["timeout"])
+                bc.close()
+            except StreamError as e:
+                self.fail_negotiation(
+                    f"hybrid discovery of {self.props['topic']!r} via "
+                    f"broker {self.props['host']}:{self.props['port']} "
+                    f"failed: {e}")
+        elif self.props["connect_type"] != "tcp":
+            self.fail_negotiation(
+                f"connect_type must be tcp|hybrid, got "
+                f"{self.props['connect_type']!r}")
         try:
-            self._client = P.MsgClient(
-                self.props["host"], int(self.props["port"]),
-                on_message=self._on_message)
+            self._client = P.MsgClient(host, port,
+                                       on_message=self._on_message)
         except StreamError as e:
             self.fail_negotiation(str(e))
         dims, types, _ = spec.to_strings()

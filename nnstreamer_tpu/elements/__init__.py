@@ -13,6 +13,7 @@ from nnstreamer_tpu.elements import (  # noqa: F401
     filter as filter_element,
     iio,
     ipc,
+    mqtt,
     repo,
     routing,
     sinks,

@@ -194,6 +194,16 @@ class TensorIf(Element):
         if n_out == 2:
             outs.append(self._out_spec_for(self.props["else_"],
                                            self.props["else_option"], spec))
+        # repeat_previous replays the last forwarded buffer, which may
+        # come from the other branch: that is only spec-safe if the other
+        # branch is shape-preserving (tensorpick would replay a subset
+        # onto a pad negotiated for the full tensor set)
+        acts = (self.props["then"], self.props["else_"])
+        if "repeat_previous" in acts and "tensorpick" in acts:
+            self.fail_negotiation(
+                "repeat_previous cannot pair with tensorpick on the other "
+                "branch: the repeated buffer would not match this pad's "
+                "negotiated tensor set")
         return outs
 
     # -- condition evaluation (tensor_data.c scalar math analog) -----------

@@ -1,0 +1,182 @@
+"""mqttsink / mqttsrc — brokered pub/sub with cross-host time alignment.
+
+Reference parity: gst/mqtt/ (3437 LoC, paho-based) — publish any tensor
+stream to a topic on a broker, subscribe from any number of pipelines on
+any host, and keep timestamps comparable across machines via NTP
+(mqttsrc.c:26, GstMQTTMessageHdr mqttcommon.h:43-63, ntputil.c:140).
+
+TPU-first redesign: the broker is our own EdgeBroker (edge/broker.py) —
+no external MQTT daemon dependency — and the NTP daemon collapses into
+the broker's TIME exchange: mqttsink stamps every frame with *broker
+time* (local clock + measured offset), and mqttsrc exposes that stamp
+plus its own offset so receivers on a different host can rebase PTS into
+the shared broker timeline (`sync=broker` rewrites PTS; `sync=none`
+leaves sender PTS). Payloads are standard wire frames, so caps, meta and
+PTS all travel.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Iterator, Optional, Sequence
+
+from nnstreamer_tpu.core.errors import PipelineError, StreamError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.edge.broker import BrokerClient
+from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
+from nnstreamer_tpu.graph.pipeline import (
+    PropDef, SinkElement, SourceElement, StreamSpec)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+log = get_logger("elements.mqtt")
+
+
+def _broker_props():
+    return {
+        "host": PropDef(str, "127.0.0.1", "broker host"),
+        "port": PropDef(int, None, "broker port (required)"),
+        "topic": PropDef(str, None, "topic (required)"),
+    }
+
+
+@register_element("mqttsink")
+class MqttSink(SinkElement):
+    """Publish the stream to a broker topic, stamped in broker time."""
+
+    ELEMENT_NAME = "mqttsink"
+    WANTS_HOST = True
+    PROPS = {**_broker_props()}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if self.props["port"] is None or not self.props["topic"]:
+            raise PipelineError(
+                f"{self.name}: port= (broker) and topic= are required")
+        self._bc: Optional[BrokerClient] = None
+
+    def start(self) -> None:
+        self._bc = BrokerClient(self.props["host"], self.props["port"])
+        # one clock sync up front; frames stamp broker_now from it
+        off = self._bc.clock_offset_ns()
+        log.info("%s: broker clock offset %+d us", self.name, off // 1000)
+
+    def render(self, buf: TensorBuffer) -> None:
+        if not self._bc.alive:
+            raise StreamError(
+                f"{self.name}: broker connection lost (topic "
+                f"{self.props['topic']!r})")
+        self._bc.publish(self.props["topic"], encode_buffer(buf))
+
+    def stop(self) -> None:
+        if self._bc is not None:
+            self._bc.close()
+            self._bc = None
+
+
+@register_element("mqttsrc")
+class MqttSrc(SourceElement):
+    """Subscribe to a broker topic and emit its frames.
+
+    sync=none: keep the publisher's PTS. sync=broker: rewrite PTS to the
+    publish timestamp on the shared broker timeline, rebased so the first
+    frame is 0 — streams from different hosts become directly
+    mux/merge-able (the reference's NTP-sync use case).
+    dims/types declare the spec, or it is sniffed from frame 1.
+    """
+
+    ELEMENT_NAME = "mqttsrc"
+    PROPS = {
+        **_broker_props(),
+        "dims": PropDef(str, "", "expected dims (else sniffed)"),
+        "types": PropDef(str, "float32"),
+        "sync": PropDef(str, "none", "none|broker PTS handling"),
+        "sniff_timeout": PropDef(float, 10.0),
+        "queue_size": PropDef(int, 64, "pending frames before dropping old"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if self.props["port"] is None or not self.props["topic"]:
+            raise PipelineError(
+                f"{self.name}: port= (broker) and topic= are required")
+        if self.props["sync"] not in ("none", "broker"):
+            raise PipelineError(
+                f"{self.name}: sync= must be none|broker, got "
+                f"{self.props['sync']!r}")
+        self._bc: Optional[BrokerClient] = None
+        self._q: _queue.Queue = _queue.Queue(maxsize=self.props["queue_size"])
+        self._stop = threading.Event()
+        self._sniffed = None
+        self._base_pub_ns: Optional[int] = None
+
+    def _on_frame(self, pub_broker_ns: int, frame: bytes) -> None:
+        try:
+            buf, _ = decode_buffer(frame)
+        except (ValueError, StreamError) as e:
+            log.error("%s: dropping corrupt frame on %r: %s",
+                      self.name, self.props["topic"], e)
+            return
+        buf.meta["pub_broker_ns"] = pub_broker_ns
+        if self.props["sync"] == "broker":
+            if self._base_pub_ns is None:
+                self._base_pub_ns = pub_broker_ns
+            buf = buf.with_tensors(buf.tensors,
+                                   pts=pub_broker_ns - self._base_pub_ns)
+        try:
+            self._q.put_nowait(buf)
+        except _queue.Full:
+            try:   # drop the OLDEST so a stalled pipeline sees fresh data
+                self._q.get_nowait()
+                self._q.put_nowait(buf)
+            except (_queue.Empty, _queue.Full):
+                pass
+
+    def _ensure_connected(self) -> None:
+        if self._bc is None:
+            self._bc = BrokerClient(self.props["host"], self.props["port"])
+            if self.props["sync"] == "broker":
+                self._bc.clock_offset_ns()
+            self._bc.subscribe(self.props["topic"], self._on_frame)
+
+    def output_spec(self) -> StreamSpec:
+        if self.props["dims"]:
+            return TensorsSpec.from_strings(self.props["dims"],
+                                            self.props["types"])
+        self._ensure_connected()
+        try:
+            self._sniffed = self._q.get(timeout=self.props["sniff_timeout"])
+        except _queue.Empty:
+            raise PipelineError(
+                f"{self.name}: nothing published on "
+                f"{self.props['topic']!r} within "
+                f"{self.props['sniff_timeout']}s; declare dims=/types= to "
+                f"negotiate without sniffing") from None
+        return self._sniffed.spec()
+
+    def generate(self) -> Iterator[TensorBuffer]:
+        self._ensure_connected()
+        if self._sniffed is not None:
+            yield self._sniffed
+            self._sniffed = None
+        while not self._stop.is_set():
+            try:
+                buf = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                if self._bc is not None and not self._bc.alive:
+                    raise StreamError(
+                        f"{self.name}: broker connection lost (topic "
+                        f"{self.props['topic']!r})")
+                continue
+            yield buf
+
+    def interrupt(self) -> None:
+        self._stop.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._bc is not None:
+            self._bc.close()
+            self._bc = None

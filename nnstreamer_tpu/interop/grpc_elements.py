@@ -188,15 +188,29 @@ class TensorSinkGrpc(SinkElement):
                     f"{self.name}: gRPC send stream failed: {self._send_err}")
             self._sendq.put(msg)
 
+    @staticmethod
+    def _signal_eos(q: _queue.Queue) -> None:
+        """Non-blocking EOS: on a full queue (stalled client), drop one
+        frame to make room — never hang teardown on a slow reader."""
+        try:
+            q.put_nowait(_EOS)
+        except _queue.Full:
+            try:
+                q.get_nowait()
+                q.put_nowait(_EOS)
+            except (_queue.Empty, _queue.Full):
+                pass
+
     def stop(self) -> None:
         if self._server is not None:
             with self._clients_lock:
-                for q in self._clients:
-                    q.put(_EOS)
+                clients = list(self._clients)
+            for q in clients:
+                self._signal_eos(q)
             self._server.stop(grace=0.5)
             self._server = None
         if self._sender is not None:
-            self._sendq.put(_EOS)
+            self._signal_eos(self._sendq)
             self._sender.join(timeout=5)
             self._sender = None
 
@@ -235,11 +249,22 @@ class TensorSrcGrpc(SourceElement):
         self.bound_port: Optional[int] = None
 
     # -- server mode ---------------------------------------------------------
+    def _enqueue(self, msg) -> bool:
+        """Bounded put that keeps observing _stop: a stopped pipeline no
+        longer drains _q, and a blocking put would park a non-daemon gRPC
+        executor thread forever (hanging interpreter exit)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
     def _send_tensors(self, request_iterator, context):
         for msg in request_iterator:
-            if self._stop.is_set():
+            if not self._enqueue(msg):
                 break
-            self._q.put(msg)
         return empty_pb2.Empty()
 
     # -- client mode ---------------------------------------------------------
@@ -252,15 +277,14 @@ class TensorSrcGrpc(SourceElement):
             response_deserializer=pb.Tensors.FromString)
         try:
             for msg in recv(empty_pb2.Empty(), wait_for_ready=True):
-                if self._stop.is_set():
+                if not self._enqueue(msg):
                     break
-                self._q.put(msg)
         except BaseException as e:
             if not self._stop.is_set():
                 self._pull_err = e
         finally:
             chan.close()
-            self._q.put(_EOS)
+            TensorSinkGrpc._signal_eos(self._q)
 
     def _ensure_running(self):
         if self.props["server"]:

@@ -98,7 +98,9 @@ def parse_gst_meta(data: bytes):
         )
     vals = struct.unpack_from("<21I", data, 0)
     version = vals[0]
-    if (version & _MASK_VALID) != _MASK_VALID:
+    # mask the FULL tag byte: (v & 0xDE000000) == 0xDE000000 would also
+    # accept 0xFF/0xFE/0xDF tags (bit-superset false positives)
+    if (version & 0xFF000000) != _MASK_VALID:
         raise StreamError(
             f"bad GstTensorMetaInfo version 0x{version:08x}; not a "
             f"reference-flexible tensor payload"

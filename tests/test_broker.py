@@ -1,0 +1,302 @@
+"""EdgeBroker tests: HYBRID discovery, brokered pub/sub (mqtt elements),
+and clock alignment — loopback on localhost, the reference's technique
+(tests/gstreamer_mqtt + nnstreamer_edge query suites, SURVEY.md §4;
+NTP mocking analog: unittest_ntp_util_mock.cc)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.edge import QueryServer
+from nnstreamer_tpu.edge.broker import (
+    BrokerClient, EdgeBroker, pack_publish, unpack_publish)
+from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+
+@pytest.fixture()
+def broker():
+    b = EdgeBroker("127.0.0.1", 0)
+    yield b
+    b.close()
+
+
+@pytest.fixture(autouse=True)
+def _clean_servers():
+    yield
+    QueryServer.reset_all()
+
+
+# -- publish framing ----------------------------------------------------------
+
+def test_publish_frame_codec():
+    topic, ts, frame = unpack_publish(pack_publish("cam/0", 12345, b"xyz"))
+    assert (topic, ts, frame) == ("cam/0", 12345, b"xyz")
+
+
+def test_publish_frame_rejects_truncation():
+    with pytest.raises(StreamError, match="truncated"):
+        unpack_publish(b"\xff\xff hi")
+
+
+# -- discovery ----------------------------------------------------------------
+
+def test_register_lookup_roundtrip(broker):
+    srv = BrokerClient("127.0.0.1", broker.port)
+    srv.register("infer/mobilenet", "10.0.0.7", 5001)
+    cli = BrokerClient("127.0.0.1", broker.port)
+    assert cli.lookup("infer/mobilenet") == ("10.0.0.7", 5001)
+    srv.close()
+    cli.close()
+
+
+def test_lookup_unknown_name_fails(broker):
+    cli = BrokerClient("127.0.0.1", broker.port)
+    with pytest.raises(StreamError, match="no service registered"):
+        cli.lookup("nope")
+    cli.close()
+
+
+def test_registration_dies_with_owner(broker):
+    srv = BrokerClient("127.0.0.1", broker.port)
+    srv.register("ephemeral", "127.0.0.1", 9)
+    srv.close()          # owner leaves → registration must vanish
+    cli = BrokerClient("127.0.0.1", broker.port)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            cli.lookup("ephemeral")
+            time.sleep(0.05)     # reaper hasn't run yet
+        except StreamError:
+            break
+    else:
+        pytest.fail("stale registration survived owner disconnect")
+    cli.close()
+
+
+def test_name_collision_refused(broker):
+    a = BrokerClient("127.0.0.1", broker.port)
+    b = BrokerClient("127.0.0.1", broker.port)
+    a.register("svc", "127.0.0.1", 1)
+    with pytest.raises(StreamError, match="already registered"):
+        b.register("svc", "127.0.0.1", 2)
+    # same owner may re-register (address update)
+    a.register("svc", "127.0.0.1", 3)
+    assert b.lookup("svc") == ("127.0.0.1", 3)
+    a.close()
+    b.close()
+
+
+def test_unregister(broker):
+    a = BrokerClient("127.0.0.1", broker.port)
+    a.register("svc", "127.0.0.1", 1)
+    a.unregister("svc")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            a.lookup("svc")
+            time.sleep(0.05)
+        except StreamError:
+            break
+    else:
+        pytest.fail("unregistered service still resolvable")
+    a.close()
+
+
+# -- clock --------------------------------------------------------------------
+
+def test_clock_offset_near_zero_same_host(broker):
+    cli = BrokerClient("127.0.0.1", broker.port)
+    off = cli.clock_offset_ns()
+    assert abs(off) < 1_000_000_000   # same clock, sub-second bound
+    assert abs(cli.broker_now_ns() - time.time_ns()) < 2_000_000_000
+    cli.close()
+
+
+# -- pub/sub ------------------------------------------------------------------
+
+def test_pubsub_fanout_no_self_echo(broker):
+    got_a, got_b, got_pub = [], [], []
+    a = BrokerClient("127.0.0.1", broker.port)
+    b = BrokerClient("127.0.0.1", broker.port)
+    pub = BrokerClient("127.0.0.1", broker.port)
+    a.subscribe("t", lambda ts, f: got_a.append(f))
+    b.subscribe("t", lambda ts, f: got_b.append(f))
+    pub.subscribe("t", lambda ts, f: got_pub.append(f))
+    time.sleep(0.2)
+    frame = encode_buffer(TensorBuffer.of(np.arange(3).astype(np.float32)))
+    pub.publish("t", frame)
+    deadline = time.time() + 5
+    while (len(got_a) < 1 or len(got_b) < 1) and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(got_a) == 1 and len(got_b) == 1
+    assert got_pub == []     # publisher does not hear itself
+    out, _ = decode_buffer(got_a[0])
+    np.testing.assert_array_equal(out.tensors[0],
+                                  np.arange(3).astype(np.float32))
+    for c in (a, b, pub):
+        c.close()
+
+
+# -- mqtt elements ------------------------------------------------------------
+
+def test_mqtt_sink_to_src_pipeline(broker):
+    recv = nns.parse_launch(
+        f"mqttsrc name=in port={broker.port} topic=cam dims=4 "
+        f"types=float32 ! tensor_sink name=out")
+    rr = nns.PipelineRunner(recv).start()
+    time.sleep(0.3)   # subscription in flight
+    send = nns.parse_launch(
+        f"appsrc name=src dims=4 types=float32 ! "
+        f"mqttsink port={broker.port} topic=cam")
+    sr = nns.PipelineRunner(send).start()
+    src = send.get("src")
+    frames = [np.full(4, i, np.float32) for i in range(3)]
+    for i, f in enumerate(frames):
+        src.push(TensorBuffer.of(f, pts=i * 1000))
+    src.end()
+    sr.wait(30)
+    sink = recv.get("out")
+    deadline = time.time() + 10
+    while len(sink.results) < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    sr.stop()
+    recv.get("in").interrupt()
+    rr.stop()
+    assert len(sink.results) == 3
+    np.testing.assert_array_equal(sink.results[1].tensors[0], frames[1])
+    assert sink.results[1].pts == 1000                  # sync=none keeps PTS
+    assert "pub_broker_ns" in sink.results[1].meta      # broker stamp rides
+
+
+def test_mqtt_sync_broker_rebases_pts(broker):
+    recv = nns.parse_launch(
+        f"mqttsrc name=in port={broker.port} topic=s dims=1 types=uint8 "
+        f"sync=broker ! tensor_sink name=out")
+    rr = nns.PipelineRunner(recv).start()
+    time.sleep(0.3)
+    pub = BrokerClient("127.0.0.1", broker.port)
+    for i in range(2):
+        pub.publish("s", encode_buffer(
+            TensorBuffer.of(np.array([i], np.uint8), pts=999_999)))
+        time.sleep(0.05)
+    sink = recv.get("out")
+    deadline = time.time() + 10
+    while len(sink.results) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    recv.get("in").interrupt()
+    rr.stop()
+    pub.close()
+    assert len(sink.results) == 2
+    # PTS rebased onto the broker timeline: first = 0, second = the
+    # publish gap (~50ms), publisher's own PTS discarded
+    assert sink.results[0].pts == 0
+    assert 0 < sink.results[1].pts < 5_000_000_000
+
+
+def test_mqttsrc_sniffs_spec(broker):
+    pub = BrokerClient("127.0.0.1", broker.port)
+    import threading
+
+    def feed():
+        for _ in range(20):
+            try:
+                pub.publish("sniff", encode_buffer(
+                    TensorBuffer.of(np.zeros((2, 3), np.int16))))
+            except StreamError:
+                return   # test closed the client; done feeding
+            time.sleep(0.1)
+
+    t = threading.Thread(target=feed, daemon=True)
+    recv = nns.parse_launch(
+        f"mqttsrc name=in port={broker.port} topic=sniff ! "
+        f"tensor_sink name=out")
+    t.start()
+    rr = nns.PipelineRunner(recv).start()
+    sink = recv.get("out")
+    deadline = time.time() + 10
+    while len(sink.results) < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    recv.get("in").interrupt()
+    rr.stop()
+    pub.close()
+    t.join(timeout=5)
+    assert sink.results and sink.results[0].tensors[0].shape == (2, 3)
+
+
+# -- HYBRID query discovery ---------------------------------------------------
+
+def test_query_hybrid_discovery_end_to_end(broker):
+    from nnstreamer_tpu.backends.custom import register_custom_easy
+
+    register_custom_easy("hybrid_double", lambda t: (t[0] * 2,))
+    server = nns.parse_launch(
+        f"tensor_query_serversrc name=ssrc id=7 dims=4 types=float32 "
+        f"port=0 broker_port={broker.port} topic=infer/double ! "
+        f"tensor_filter framework=custom model=hybrid_double ! "
+        f"tensor_query_serversink id=7")
+    srunner = nns.PipelineRunner(server).start()
+    # client knows only the broker address + service name
+    client = nns.parse_launch(
+        f"appsrc name=in dims=4 types=float32 ! "
+        f"tensor_query_client connect_type=hybrid port={broker.port} "
+        f"topic=infer/double ! tensor_sink name=out")
+    crunner = nns.PipelineRunner(client).start()
+    src = client.get("in")
+    src.push(TensorBuffer.of(np.arange(4, dtype=np.float32)))
+    src.end()
+    crunner.wait(30)
+    crunner.stop()
+    server.get("ssrc").interrupt()
+    srunner.stop()
+    res = client.get("out").results
+    assert len(res) == 1
+    np.testing.assert_array_equal(
+        res[0].tensors[0], np.arange(4, dtype=np.float32) * 2)
+
+
+def test_query_hybrid_unknown_topic_fails_negotiation(broker):
+    with pytest.raises(nns.core.errors.NegotiationError,
+                       match="hybrid discovery"):
+        pipe = nns.parse_launch(
+            f"appsrc dims=4 types=float32 ! "
+            f"tensor_query_client connect_type=hybrid port={broker.port} "
+            f"topic=ghost ! fakesink")
+        nns.PipelineRunner(pipe).start()
+
+
+# -- robustness regressions ---------------------------------------------------
+
+def test_broker_survives_malformed_payloads(broker):
+    """Garbage JSON / invalid UTF-8 must not kill reader threads or the
+    service (standalone brokers face arbitrary network clients)."""
+    from nnstreamer_tpu.edge import protocol as P
+    import nnstreamer_tpu.edge.broker as B
+
+    evil = P.MsgClient("127.0.0.1", broker.port,
+                       on_message=lambda t, p: None)
+    evil.send(B.T_LOOKUP, b"\xff\xfe not json")
+    evil.send(B.T_LOOKUP, b"[]")            # valid JSON, wrong shape
+    evil.send(B.T_SUBSCRIBE, b"\xff\xfe")   # invalid utf8 topic
+    evil.send(B.T_UNREGISTER, b"{")
+    evil.send(B.T_PUBLISH, b"\xff\xff")     # truncated publish
+    time.sleep(0.3)
+    # broker still fully functional afterwards
+    ok = BrokerClient("127.0.0.1", broker.port)
+    ok.register("still/alive", "127.0.0.1", 1)
+    assert ok.lookup("still/alive") == ("127.0.0.1", 1)
+    evil.close()
+    ok.close()
+
+
+def test_serversrc_refuses_wildcard_advertise(broker):
+    pipe = nns.parse_launch(
+        f"tensor_query_serversrc name=s id=8 dims=2 types=float32 "
+        f"host=0.0.0.0 port=0 broker_port={broker.port} topic=w ! "
+        f"fakesink")
+    with pytest.raises(nns.core.errors.PipelineError,
+                       match="advertise_host"):
+        nns.PipelineRunner(pipe).start()

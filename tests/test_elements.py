@@ -603,3 +603,18 @@ def test_rate_no_throttle_source_never_skips():
                if e.ELEMENT_NAME == "videotestsrc")
     assert src.qos_skipped == 0
     assert pipe.get("r").dropped > 20
+
+
+def test_if_repeat_previous_rejects_tensorpick_pairing():
+    """Cross-branch replay is only spec-safe for shape-preserving
+    partners; tensorpick would leak a subset onto the full-spec pad."""
+    iff = TensorIf(name="i", operator="gt", supplied_value="5",
+                   then="tensorpick", then_option="0",
+                   else_="repeat_previous")
+    src = AppSrc(spec=spec_of((4,)), name="src")
+    s_then, s_else = TensorSink(name="t"), TensorSink(name="e")
+    with pytest.raises(nns.core.errors.NegotiationError,
+                       match="repeat_previous cannot pair"):
+        run_graph([src, iff, s_then, s_else],
+                  [(src, iff), (iff, s_then, 0, 0), (iff, s_else, 1, 0)],
+                  {"src": []})

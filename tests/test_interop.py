@@ -361,3 +361,14 @@ def test_grpc_pipeline_to_pipeline_bridge():
     assert len(sink.results) == 5
     np.testing.assert_array_equal(sink.results[4].tensors[0],
                                   np.array([4, 5, 6], np.int32))
+
+
+def test_gst_meta_rejects_superset_tag_bytes():
+    """0xFF/0xFE tags share all bits of 0xDE and must still be refused
+    (mask-as-value bug regression)."""
+    import struct
+    for tag in (0xFF001000, 0xFE001000, 0xDF001000):
+        hdr = bytearray(pack_gst_meta((3,), DType.UINT8))
+        struct.pack_into("<I", hdr, 0, tag)
+        with pytest.raises(StreamError, match="version"):
+            parse_gst_meta(bytes(hdr))
