@@ -23,8 +23,7 @@ need no pixel parsing.
 
 from __future__ import annotations
 
-from pathlib import Path
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 

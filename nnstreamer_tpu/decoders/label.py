@@ -8,11 +8,10 @@ Output buffer: meta["label"], meta["label_index"], meta["score"], payload
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 
-from nnstreamer_tpu.core.errors import PipelineError
 from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
 from nnstreamer_tpu.graph.media import TextSpec
 from nnstreamer_tpu.tensor.buffer import TensorBuffer

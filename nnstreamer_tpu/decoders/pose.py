@@ -17,7 +17,6 @@ meta["keypoints"] as (K, 3) [x_px, y_px, score].
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
 
 import numpy as np
 

@@ -151,7 +151,9 @@ class EdgeBroker:
         try:
             ent = json.loads(payload.decode())
             name, host, port = ent["name"], ent["host"], int(ent["port"])
-        except (ValueError, KeyError) as e:
+        except (ValueError, KeyError, TypeError) as e:
+            # TypeError covers non-dict JSON / non-castable port: the
+            # client must get an immediate NAK, not a 10s RPC timeout
             conn.send(T_REGISTER_NAK, f"bad registration: {e}".encode())
             return
         with self._lock:

@@ -289,16 +289,19 @@ class TensorQueryClient(Element):
                     "(host/port address the broker)")
             from nnstreamer_tpu.edge.broker import BrokerClient
 
+            bc = None
             try:
                 bc = BrokerClient(host, port)
                 host, port = bc.lookup(self.props["topic"],
                                        timeout=self.props["timeout"])
-                bc.close()
             except StreamError as e:
                 self.fail_negotiation(
                     f"hybrid discovery of {self.props['topic']!r} via "
                     f"broker {self.props['host']}:{self.props['port']} "
                     f"failed: {e}")
+            finally:
+                if bc is not None:   # no socket/thread leak on failure
+                    bc.close()
         elif self.props["connect_type"] != "tcp":
             self.fail_negotiation(
                 f"connect_type must be tcp|hybrid, got "

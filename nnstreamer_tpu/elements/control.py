@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import operator
 from fractions import Fraction
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -29,7 +29,7 @@ from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.graph.pipeline import (
     DYNAMIC, Element, Emission, PropDef, StreamSpec, prop_bool)
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
-from nnstreamer_tpu.tensor.info import TensorFormat, TensorInfo, TensorsSpec
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
 
 # -- tensor_if ---------------------------------------------------------------
 

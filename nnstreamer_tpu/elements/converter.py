@@ -14,7 +14,6 @@ point that turns a stream into MXU-shaped work.
 
 from __future__ import annotations
 
-from dataclasses import replace
 from typing import List, Optional, Sequence
 
 import numpy as np

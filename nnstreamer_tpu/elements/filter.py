@@ -25,7 +25,7 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import replace
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu.backends.base import FilterBackend, get_backend
 from nnstreamer_tpu.core.config import get_config

@@ -13,11 +13,10 @@ from the first frame when `dims` is omitted (blocking briefly).
 
 from __future__ import annotations
 
-import queue as _queue
 import threading
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, Optional
 
-from nnstreamer_tpu.core.errors import PipelineError, StreamError
+from nnstreamer_tpu.core.errors import PipelineError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
-from typing import Iterator, Optional, Sequence
+from typing import Iterator, Optional
 
 from nnstreamer_tpu.core.errors import PipelineError, StreamError
 from nnstreamer_tpu.core.log import get_logger
@@ -137,8 +137,9 @@ class MqttSrc(SourceElement):
     def _ensure_connected(self) -> None:
         if self._bc is None:
             self._bc = BrokerClient(self.props["host"], self.props["port"])
-            if self.props["sync"] == "broker":
-                self._bc.clock_offset_ns()
+            # no clock exchange here: PTS rebasing reads the *publish*
+            # stamps (already broker time, stamped by mqttsink), so the
+            # subscriber needs no own offset
             self._bc.subscribe(self.props["topic"], self._on_frame)
 
     def output_spec(self) -> StreamSpec:

@@ -17,14 +17,18 @@ from __future__ import annotations
 import queue as _queue
 import threading
 from fractions import Fraction
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator
 
 import numpy as np
 
 from nnstreamer_tpu.core.errors import PipelineError
 from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.graph.pipeline import (
-    Element, Emission, PropDef, SinkElement, SourceElement, StreamSpec)
+    Element,
+    PropDef,
+    SinkElement,
+    SourceElement,
+    StreamSpec)
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.info import TensorsSpec
 

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import List
 
 from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.graph.pipeline import PropDef, SinkElement, prop_bool

@@ -8,7 +8,7 @@ from __future__ import annotations
 import queue as _queue
 import time
 from fractions import Fraction
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List
 
 import numpy as np
 

@@ -15,7 +15,7 @@ from typing import List, Sequence
 import numpy as np
 
 from nnstreamer_tpu.core.registry import register_element
-from nnstreamer_tpu.graph.pipeline import Element, Emission, PropDef, StreamSpec
+from nnstreamer_tpu.graph.pipeline import Element, Emission, StreamSpec
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
 from nnstreamer_tpu.tensor.sparse import sparse_decode, sparse_encode

@@ -24,7 +24,7 @@ Option syntax (reference-compatible):
 from __future__ import annotations
 
 from dataclasses import replace
-from typing import Any, Callable, List, Sequence, Tuple
+from typing import Callable, List, Sequence, Tuple
 
 import numpy as np
 

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import re
 import shlex
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from nnstreamer_tpu.core.errors import PipelineError
 from nnstreamer_tpu.core.registry import PluginKind, registry

@@ -21,9 +21,7 @@ property-table analog (tensor_filter_common.c:899-1017).
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
-from fractions import Fraction
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 from nnstreamer_tpu.core.errors import NegotiationError, PipelineError

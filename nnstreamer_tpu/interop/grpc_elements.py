@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
-from typing import Iterator, List, Optional
+from typing import Iterator, Optional
 
 from google.protobuf import empty_pb2
 
@@ -284,7 +284,11 @@ class TensorSrcGrpc(SourceElement):
                 self._pull_err = e
         finally:
             chan.close()
-            TensorSinkGrpc._signal_eos(self._q)
+            # clean end-of-stream with a live consumer: deliver every
+            # queued frame (stop-aware bounded put); only if the pipeline
+            # already stopped fall back to the frame-dropping variant
+            if not self._enqueue(_EOS):
+                TensorSinkGrpc._signal_eos(self._q)
 
     def _ensure_running(self):
         if self.props["server"]:

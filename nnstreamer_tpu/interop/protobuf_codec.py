@@ -27,7 +27,6 @@ Format semantics (from nnstreamer_protobuf.cc:60-200):
 from __future__ import annotations
 
 import math
-from typing import List, Tuple
 
 import numpy as np
 

@@ -13,7 +13,7 @@ aware, NHWC, bf16 compute / f32 params. Output is 1001 classes
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp

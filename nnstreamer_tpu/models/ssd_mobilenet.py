@@ -16,7 +16,7 @@ TF-SSD anchor grid the reference's decoder expects.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp

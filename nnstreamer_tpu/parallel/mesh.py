@@ -16,8 +16,8 @@ type annotation on `jax.Array` and the runtime moves nothing by hand.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np

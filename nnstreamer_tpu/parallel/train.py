@@ -13,7 +13,6 @@ in-place in HBM (no 2× weight memory).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax

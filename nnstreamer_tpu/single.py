@@ -14,7 +14,7 @@ that just want `invoke()`.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Optional, Tuple
 
 from nnstreamer_tpu.backends.base import get_backend
 from nnstreamer_tpu.core.errors import BackendError, PipelineError

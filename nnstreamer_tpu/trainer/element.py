@@ -15,7 +15,7 @@ Properties:
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 

@@ -19,7 +19,7 @@ from nnstreamer_tpu.elements import AppSrc, TensorCrop, TensorSink
 from nnstreamer_tpu.elements.filter import TensorFilter
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.dtypes import DType
-from nnstreamer_tpu.tensor.info import TensorFormat, TensorInfo, TensorsSpec
+from nnstreamer_tpu.tensor.info import TensorFormat
 
 from test_elements import run_graph, spec_of
 

@@ -6,7 +6,6 @@ buffers through in-process pipelines; fake 'models' are plain callables
 (custom-easy analog) so no XLA is needed for element logic.
 """
 
-import threading
 import time
 
 import numpy as np
@@ -14,10 +13,24 @@ import pytest
 
 import nnstreamer_tpu as nns
 from nnstreamer_tpu.elements import (
-    AppSrc, FakeSink, Join, Tee, TensorAggregator, TensorCrop, TensorDebug,
-    TensorDemux, TensorIf, TensorMerge, TensorMux, TensorRate,
-    TensorRepoSink, TensorRepoSrc, TensorSink, TensorSparseDec,
-    TensorSparseEnc, TensorSplit, register_if_condition)
+    AppSrc,
+    Join,
+    Tee,
+    TensorAggregator,
+    TensorCrop,
+    TensorDebug,
+    TensorDemux,
+    TensorIf,
+    TensorMerge,
+    TensorMux,
+    TensorRate,
+    TensorRepoSink,
+    TensorRepoSrc,
+    TensorSink,
+    TensorSparseDec,
+    TensorSparseEnc,
+    TensorSplit,
+    register_if_condition)
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.dtypes import DType
 from nnstreamer_tpu.tensor.info import TensorFormat, TensorInfo, TensorsSpec

@@ -5,12 +5,11 @@ and caps-negotiation suites)."""
 import numpy as np
 import pytest
 
-from nnstreamer_tpu import TensorsSpec, parse_launch
+from nnstreamer_tpu import parse_launch
 from nnstreamer_tpu.core.errors import NegotiationError, PipelineError
 from nnstreamer_tpu.elements.sources import AppSrc, VideoTestSrc
 from nnstreamer_tpu.elements.sinks import TensorSink
 from nnstreamer_tpu.elements.transform import TensorTransform
-from nnstreamer_tpu.graph.media import VideoSpec
 from nnstreamer_tpu.graph.pipeline import Pipeline
 from nnstreamer_tpu.tensor.dtypes import DType
 

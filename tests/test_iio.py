@@ -10,7 +10,6 @@ import pytest
 import nnstreamer_tpu as nns
 from nnstreamer_tpu.core.errors import PipelineError
 from nnstreamer_tpu.elements.iio import TensorSrcIIO, parse_channel_type
-from nnstreamer_tpu.tensor.info import TensorsSpec
 
 
 def make_device(tmp_path, name="fake_accel", freq="100",

@@ -283,7 +283,6 @@ def test_npz_model_file_runs_zoo_arch(tmp_path):
                 bundle.params)
     shot = SingleShot(p)
     x = np.zeros((1, 32, 32, 3), np.float32)
-    from nnstreamer_tpu.tensor.info import TensorsSpec
     got = shot.invoke(x)
     ref = SingleShot(bundle).invoke(x)
     np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),

@@ -7,7 +7,6 @@ actionable message when the library is absent.
 import os
 import subprocess
 import sys
-import threading
 import time
 
 import numpy as np

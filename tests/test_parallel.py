@@ -8,8 +8,10 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from nnstreamer_tpu.parallel import (
-    MeshSpec, TrainState, make_mesh, make_train_step, shard_params)
-from nnstreamer_tpu.parallel.mesh import param_specs
+    MeshSpec,
+    make_mesh,
+    make_train_step,
+    shard_params)
 from nnstreamer_tpu.parallel.train import init_state, shard_state
 
 

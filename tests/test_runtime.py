@@ -2,7 +2,6 @@
 backend — the XLA-free backbone of element testing (SURVEY.md §4
 takeaway a: custom-easy functions as fake frameworks)."""
 
-import threading
 import time
 
 import numpy as np
@@ -215,7 +214,6 @@ class TestReviewRegressions:
         assert info.dtype.type_name == "float32"
 
     def test_audio_adapter(self):
-        from fractions import Fraction
 
         from nnstreamer_tpu.graph.media import AudioSpec
 

@@ -5,7 +5,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 import nnstreamer_tpu as nns
 from nnstreamer_tpu.elements import AppSrc, TensorSink

@@ -6,8 +6,14 @@ import numpy as np
 
 import nnstreamer_tpu as nns
 from nnstreamer_tpu.elements import (
-    REPO, AppSrc, Tee, TensorDemux, TensorFilter, TensorMux, TensorRepoSink,
-    TensorRepoSrc, TensorSink)
+    REPO,
+    AppSrc,
+    TensorDemux,
+    TensorFilter,
+    TensorMux,
+    TensorRepoSink,
+    TensorRepoSrc,
+    TensorSink)
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.dtypes import DType
 from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Full local CI gate (SURVEY.md §5.2/Lx parity: the reference runs meson
+# builds + ninja test + ssat + static analysis in CI; this is the whole
+# equivalent pipeline in one script).
+#
+# Usage: tools/ci.sh [--fast]   (--fast skips the pytest suite)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native build =="
+make -C native
+
+echo "== static gate (lint + bytecode compile) =="
+python tools/lint.py
+python -m compileall -q nnstreamer_tpu tests tools bench.py __graft_entry__.py
+
+echo "== single-chip compile check (__graft_entry__.entry) =="
+python - <<'EOF'
+import __graft_entry__ as g
+import jax
+fn, args = g.entry()
+jax.eval_shape(fn, *args)   # traces the flagship model without devices
+print("entry() traces clean")
+EOF
+
+echo "== multichip dryrun (virtual 8-device mesh) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+if [[ "${1:-}" != "--fast" ]]; then
+  echo "== test suite =="
+  python -m pytest tests/ -x -q
+fi
+
+echo "CI gate passed"

@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Dependency-free static gate (SURVEY.md §5.2 parity — the reference
+runs Coverity/format gates in CI; this is the in-repo analog, ast-based
+so it needs nothing beyond the stdlib).
+
+Checks per file:
+  - parses (syntax gate)
+  - unused imports (noqa-respecting)
+  - bare `except:` clauses
+  - mutable default arguments (list/dict/set literals)
+  - tabs in indentation
+
+Exit 0 clean, 1 with findings. Usage: python tools/lint.py [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = ["nnstreamer_tpu", "tests", "tools", "bench.py",
+                 "__graft_entry__.py"]
+
+
+class Visitor(ast.NodeVisitor):
+    def __init__(self, src_lines):
+        self.lines = src_lines
+        self.imports = {}      # name → (lineno, stated name)
+        self.used = set()
+        self.findings = []
+
+    def _noqa(self, lineno: int) -> bool:
+        line = self.lines[lineno - 1] if lineno - 1 < len(self.lines) else ""
+        return "noqa" in line
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            if not self._noqa(node.lineno):
+                self.imports[name] = (node.lineno, a.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            name = a.asname or a.name
+            if not self._noqa(node.lineno):
+                self.imports[name] = (node.lineno, a.name)
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        # pkg.mod.attr marks pkg used
+        n = node
+        while isinstance(n, ast.Attribute):
+            n = n.value
+        if isinstance(n, ast.Name):
+            self.used.add(n.id)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None and not self._noqa(node.lineno):
+            self.findings.append((node.lineno, "bare `except:` clause"))
+        self.generic_visit(node)
+
+    def _check_defaults(self, node):
+        for d in node.args.defaults + node.args.kw_defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.findings.append(
+                    (d.lineno, "mutable default argument"))
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+
+def lint_file(path: Path) -> list:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    v = Visitor(lines)
+    v.visit(tree)
+    findings = v.findings
+    # string annotations / docstring references count as usage signals
+    blob = src
+    for name, (lineno, stated) in sorted(v.imports.items()):
+        if name in v.used:
+            continue
+        if f"__all__" in blob and f'"{name}"' in blob:
+            continue
+        # string-typed annotations ("TensorsSpec") or doctest mentions
+        uses = blob.count(name)
+        if uses <= 1:
+            findings.append((lineno, f"unused import: {stated}"))
+    for i, line in enumerate(lines, 1):
+        stripped = line[:len(line) - len(line.lstrip())]
+        if "\t" in stripped:
+            findings.append((i, "tab in indentation"))
+    return sorted(findings)
+
+
+def main(argv) -> int:
+    paths = argv or DEFAULT_PATHS
+    files = []
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            files += sorted(pp.rglob("*.py"))
+        elif pp.suffix == ".py":
+            files.append(pp)
+    bad = 0
+    for f in files:
+        if "_pb2" in f.name:   # generated code plays by its own rules
+            continue
+        for lineno, msg in lint_file(f):
+            print(f"{f}:{lineno}: {msg}")
+            bad += 1
+    if bad:
+        print(f"\n{bad} finding(s)")
+        return 1
+    print(f"lint clean: {len(files)} files")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
