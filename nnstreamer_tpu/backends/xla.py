@@ -103,6 +103,7 @@ class XLABackend(FilterBackend):
         self._bundle: Optional[ModelBundle] = None
         self._pre: Optional[ElementwiseFn] = None
         self._post: Optional[ElementwiseFn] = None
+        self._post_aux = None
         self._jitted = None
         self._device = None
         self._device_params = None
@@ -293,6 +294,16 @@ class XLABackend(FilterBackend):
     def fuse(self, pre: Optional[ElementwiseFn], post: Optional[ElementwiseFn]) -> bool:
         self._pre = pre
         self._post = post
+        # aux constants the post chain needs (e.g. SSD anchors from a
+        # fused device decoder). They ride as a jit ARGUMENT, never as a
+        # closure constant: a large embedded literal degrades the whole
+        # process on tunneled backends (measured 0.8ms → 18ms per frame
+        # for every program compiled after the literal-carrying one)
+        import jax
+
+        aux = getattr(post, "aux_params", None)
+        self._post_aux = None if aux is None else jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._device), aux)
         self._jitted = None  # recompile with the fused graph
         return True
 
@@ -300,7 +311,8 @@ class XLABackend(FilterBackend):
         bundle = self._bundle
         pre, post = self._pre, self._post
 
-        def full(params, *xs):
+        def full(packed, *xs):
+            params, aux = packed
             if count:
                 # trace-time side effect: counts compilations, not invokes
                 self.compile_count += 1
@@ -308,10 +320,14 @@ class XLABackend(FilterBackend):
                 xs = pre(xs)
             out = _to_tuple(bundle.fn(params, *xs))
             if post is not None:
-                out = post(out)
+                out = post(out) if aux is None else post(out, aux)
             return out
 
         return full
+
+    def _packed_params(self):
+        """(model params, post-chain aux) — the jit's first argument."""
+        return (self._current_params(), getattr(self, "_post_aux", None))
 
     def _current_params(self):
         """Device params, following shared-entry swaps (hot reload)."""
@@ -332,7 +348,7 @@ class XLABackend(FilterBackend):
     def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
         import jax
 
-        params = self._current_params()
+        params = self._packed_params()
         if self._jitted is None:
             self._jitted = jax.jit(self._full_fn())
         # explicit async H2D staging before dispatch: on tunneled/remote
@@ -362,7 +378,7 @@ class XLABackend(FilterBackend):
         import jax
         import numpy as np_
 
-        params = self._current_params()
+        params = self._packed_params()
         rs = [np_.asarray(r) if not hasattr(r, "shape") else r
               for r in regions]
         out: List[Any] = [None] * len(rs)
@@ -421,7 +437,8 @@ class XLABackend(FilterBackend):
             try:
                 args = [jax.ShapeDtypeStruct(batched_shape, dt)]
                 jax.eval_shape(lambda p, x: self._full_fn(count=False)(p, x),
-                               self._abstract_params(), *args)
+                               (self._abstract_params(),
+                                getattr(self, "_post_aux", None)), *args)
                 ok = True
             except Exception:
                 ok = False

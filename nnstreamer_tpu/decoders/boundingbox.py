@@ -93,6 +93,13 @@ class BoundingBoxes(DecoderSubplugin):
         self.iou_thresh = float(parts[1]) if len(parts) > 1 else 0.5
         self.out_w, self.out_h = parse_wh(props.get("option4", ""), 640, 480)
         self.in_w, self.in_h = parse_wh(props.get("option5", ""), 300, 300)
+        # option6 = device NMS formulation: greedy (exact host parity,
+        # default) | fast (YOLACT matrix form for huge candidate counts)
+        self._nms_mode = props.get("option6", "") or "greedy"
+        if self._nms_mode not in ("greedy", "fast"):
+            raise PipelineError(
+                f"bounding_boxes option6 (device NMS) must be greedy|fast, "
+                f"got {self._nms_mode!r}")
         self._anchors: Optional[np.ndarray] = None
 
     def negotiate(self, in_spec: TensorsSpec) -> VideoSpec:
@@ -125,6 +132,45 @@ class BoundingBoxes(DecoderSubplugin):
                 raise ValueError(f"{self.scheme} expects one tensor")
         return VideoSpec(width=self.out_w, height=self.out_h, format="RGBA",
                          rate=in_spec.rate)
+
+    # -- device decode (tensor_decoder device=true) ------------------------
+    def device_negotiate(self, in_spec: TensorsSpec) -> TensorsSpec:
+        if self.scheme != "mobilenet-ssd":
+            raise PipelineError(
+                f"bounding_boxes device decode supports scheme "
+                f"mobilenet-ssd (raw loc+logits postprocess); "
+                f"{self.scheme!r} decodes on host")
+        self.negotiate(in_spec)   # validates tensors, builds anchors
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo
+
+        self._top_k = 16
+        return TensorsSpec.of(
+            TensorInfo((self._top_k, 6), DType.FLOAT32, name="boxes"),
+            rate=in_spec.rate)
+
+    def device_aux(self):
+        # anchors ride as a jit argument: ~1917×4 floats embedded as a
+        # program literal degrade tunneled backends (backends/xla.py fuse)
+        return {"anchors": np.asarray(self._anchors, np.float32)}
+
+    def device_decode(self, tensors, aux=None):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.decoders.device import ssd_decode_device
+
+        anchors = (aux or {}).get("anchors")
+        if anchors is None:   # host-side fallback path (backend declined)
+            anchors = jnp.asarray(self._anchors, jnp.float32)
+        loc, logits = tensors[0], tensors[1]
+        det = ssd_decode_device(
+            loc, logits, anchors,
+            score_thresh=self.score_thresh, iou_thresh=self.iou_thresh,
+            top_k=self._top_k, nms=self._nms_mode)
+        # host decoder emits output-pixel coordinates; match it
+        scale = jnp.array([self.out_h, self.out_w, self.out_h, self.out_w,
+                           1.0, 1.0], jnp.float32)
+        return (det * scale,)
 
     # -- per-scheme box extraction → (N, 6) [ymin,xmin,ymax,xmax,score,cls]
     def _extract(self, buf: TensorBuffer) -> np.ndarray:

@@ -1,0 +1,130 @@
+"""Device-side decode programs — postprocessing that stays on the TPU.
+
+TPU-first extension beyond the reference: its decoders run on host after
+a full D2H of the raw model outputs (tensordec-boundingbox.c pulls every
+anchor's loc+conf). On a tunneled/remote TPU host that transfer is the
+entire pipeline bottleneck (measured: SSD at ~1.6 FPS with ~700 KB/frame
+D2H vs thousands of device FPS). These functions run the decode as XLA
+on device — top-K select, greedy NMS, heatmap refinement are all dense
+tensor ops the MXU/VPU eat — so only the tiny result (e.g. 16×6 floats)
+ever needs to cross to the host.
+
+Used by `tensor_decoder device=true` (elements/decoder.py), which swaps
+the media-overlay output for the compact result tensor.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def iou_matrix(boxes: jnp.ndarray) -> jnp.ndarray:
+    """(N,4) [ymin,xmin,ymax,xmax] → (N,N) IoU (device twin of the host
+    decoder's numpy version)."""
+    area = jnp.maximum(0.0, boxes[:, 2] - boxes[:, 0]) * \
+        jnp.maximum(0.0, boxes[:, 3] - boxes[:, 1])
+    yx0 = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    yx1 = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(0.0, yx1 - yx0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def greedy_nms_mask(boxes: jnp.ndarray, iou_thresh: float) -> jnp.ndarray:
+    """Exact greedy class-agnostic NMS over score-DESC-sorted boxes
+    (N,4) → keep mask (N,). Sequential recurrence (fori_loop over IoU
+    rows) — correct but ~N loop steps on device; prefer fast_nms_mask on
+    the hot path."""
+    n = boxes.shape[0]
+    iou = iou_matrix(boxes)
+    idx = jnp.arange(n)
+
+    def body(i, keep):
+        suppress = (iou[i] > iou_thresh) & (idx > i) & keep[i]
+        return keep & ~suppress
+
+    return lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+
+
+def fast_nms_mask(boxes: jnp.ndarray, iou_thresh: float) -> jnp.ndarray:
+    """Fast NMS (YOLACT): keep a box unless ANY higher-scored box
+    overlaps it — one dense matrix op instead of a sequential loop, which
+    is the MXU-friendly formulation (measured ~6 ms → ~0.1 ms for N=100
+    on v5e). Slightly over-suppresses vs greedy when a mid-score box is
+    itself suppressed by a higher one; negligible in practice (YOLACT
+    §4.2) and irrelevant for sparse scenes."""
+    n = boxes.shape[0]
+    iou = iou_matrix(boxes)
+    higher = jnp.arange(n)[:, None] < jnp.arange(n)[None, :]  # j<i pairs
+    suppressed = jnp.any((iou > iou_thresh) & higher.T, axis=1)
+    return ~suppressed
+
+
+@partial(jax.jit, static_argnames=("top_k", "pre_nms", "score_thresh",
+                                   "iou_thresh", "nms"))
+def ssd_decode_device(loc, logits, anchors, *, score_thresh: float = 0.5,
+                      iou_thresh: float = 0.5, top_k: int = 16,
+                      pre_nms: int = 100, nms: str = "greedy"):
+    """SSD postprocess on device: raw loc deltas + class logits →
+    (top_k, 6) [ymin,xmin,ymax,xmax,score,class], zero-padded rows for
+    missing detections. Matches the host mobilenet-ssd scheme: sigmoid
+    scores, background class 0 skipped, class-agnostic NMS.
+
+    nms="greedy" (default) is the exact host-parity recurrence —
+    measured just as fast as "fast" at pre_nms=100 on v5e (~0.9 ms
+    fused); "fast" (YOLACT matrix form) is available for much larger
+    candidate counts where the sequential loop would dominate."""
+    from nnstreamer_tpu.models.ssd_mobilenet import decode_boxes
+
+    loc = loc.reshape(-1, 4).astype(jnp.float32)
+    sc = logits.reshape(loc.shape[0], -1).astype(jnp.float32)
+    sc = jax.nn.sigmoid(sc)
+    cls = jnp.argmax(sc[:, 1:], axis=-1) + 1          # skip background
+    score = jnp.take_along_axis(sc, cls[:, None], axis=1)[:, 0]
+    boxes = decode_boxes(loc, anchors)
+
+    # top-K preselect keeps NMS O(K²), K static
+    k = min(pre_nms, score.shape[0])
+    s_top, i_top = lax.top_k(score, k)
+    b_top = boxes[i_top]
+    c_top = cls[i_top].astype(jnp.float32)
+    s_top = jnp.where(s_top >= score_thresh, s_top, 0.0)
+    nms_fn = fast_nms_mask if nms == "fast" else greedy_nms_mask
+    keep = nms_fn(b_top, iou_thresh)
+    s_kept = jnp.where(keep, s_top, 0.0)
+    out_k = min(top_k, k)
+    s_fin, i_fin = lax.top_k(s_kept, out_k)
+    det = jnp.concatenate(
+        [b_top[i_fin], s_fin[:, None], c_top[i_fin][:, None]], axis=1)
+    return jnp.where(s_fin[:, None] > 0, det, 0.0)    # (top_k, 6)
+
+
+@partial(jax.jit, static_argnames=("in_h", "in_w"))
+def pose_decode_device(heatmaps, offsets=None, *, in_h: int = 0,
+                       in_w: int = 0):
+    """PoseNet postprocess on device: heatmaps (1,h,w,K) [+ offsets
+    (1,h,w,2K)] → (K, 3) [fx, fy, score] in [0,1] image space (caller
+    scales to output pixels). Same math as the host decoder."""
+    hm = heatmaps[0].astype(jnp.float32)              # (h, w, K)
+    h, w, k = hm.shape
+    flat = hm.reshape(-1, k)
+    idx = jnp.argmax(flat, axis=0)                    # (K,)
+    ys, xs = idx // w, idx % w
+    score = jnp.take_along_axis(flat, idx[None, :], axis=0)[0]
+    fy = (ys.astype(jnp.float32) + 0.5) / h
+    fx = (xs.astype(jnp.float32) + 0.5) / w
+    if offsets is not None:
+        off = offsets[0].astype(jnp.float32)          # (h, w, 2K)
+        ih = in_h or h * 16
+        iw = in_w or w * 16
+        kk = jnp.arange(k)
+        oy = off[ys, xs, kk]
+        ox = off[ys, xs, k + kk]
+        fy = fy + oy / ih
+        fx = fx + ox / iw
+    return jnp.stack([fx, fy, score], axis=1)         # (K, 3)

@@ -41,6 +41,22 @@ class ImageLabeling(DecoderSubplugin):
             )
         return TextSpec(rate=in_spec.rate)
 
+    # -- device decode (tensor_decoder device=true) ------------------------
+    def device_negotiate(self, in_spec: TensorsSpec) -> "TensorsSpec":
+        self.negotiate(in_spec)
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+        return TensorsSpec.of(
+            TensorInfo((1,), DType.INT32, name="class_index"),
+            rate=in_spec.rate)
+
+    def device_decode(self, tensors, aux=None):
+        import jax.numpy as jnp
+
+        idx = jnp.argmax(tensors[0].reshape(-1)).astype(jnp.int32)
+        return (idx[None],)
+
     def decode(self, buf: TensorBuffer) -> TensorBuffer:
         scores = np.asarray(buf.tensors[0]).reshape(-1)
         idx = int(scores.argmax())

@@ -64,6 +64,28 @@ class PoseEstimation(DecoderSubplugin):
         return VideoSpec(width=self.out_w, height=self.out_h, format="RGBA",
                          rate=in_spec.rate)
 
+    # -- device decode (tensor_decoder device=true) ------------------------
+    def device_negotiate(self, in_spec: TensorsSpec) -> TensorsSpec:
+        self.negotiate(in_spec)   # validates, sets self._k
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo
+
+        return TensorsSpec.of(
+            TensorInfo((self._k, 3), DType.FLOAT32, name="keypoints"),
+            rate=in_spec.rate)
+
+    def device_decode(self, tensors, aux=None):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.decoders.device import pose_decode_device
+
+        kps = pose_decode_device(
+            tensors[0], tensors[1] if len(tensors) > 1 else None,
+            in_h=self.in_h, in_w=self.in_w)
+        # host decoder emits [x_px, y_px, score]; match it
+        scale = jnp.array([self.out_w, self.out_h, 1.0], jnp.float32)
+        return (kps * scale,)
+
     def _keypoints(self, buf: TensorBuffer) -> np.ndarray:
         hm = np.asarray(buf.tensors[0])[0]          # (h, w, K)
         h, w, k = hm.shape

@@ -12,7 +12,8 @@ from typing import List, Sequence
 
 from nnstreamer_tpu.core.errors import PipelineError
 from nnstreamer_tpu.core.registry import PluginKind, register_element, registry
-from nnstreamer_tpu.graph.pipeline import Element, Emission, PropDef, StreamSpec
+from nnstreamer_tpu.graph.pipeline import (
+    Element, Emission, PropDef, StreamSpec, prop_bool)
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.info import TensorsSpec
 
@@ -33,6 +34,27 @@ class DecoderSubplugin:
     def decode(self, buf: TensorBuffer) -> TensorBuffer:
         raise NotImplementedError
 
+    # -- optional device path (tensor_decoder device=true) -----------------
+    # TPU-first extension: postprocess as XLA on device, emitting a
+    # compact result tensor instead of host-rendered media, so raw model
+    # outputs never cross D2H (decoders/device.py rationale).
+
+    def device_negotiate(self, in_spec: TensorsSpec) -> TensorsSpec:
+        raise PipelineError(
+            f"decoder mode={self.MODE} has no device decode path; drop "
+            f"device=true to use the host decoder")
+
+    def device_decode(self, tensors, aux=None):
+        """jit-traceable: tuple of arrays → tuple of arrays. `aux` is
+        device_aux()'s pytree, passed as a jit ARGUMENT (large decode
+        constants must never embed as literals — see backends/xla.py
+        fuse())."""
+        raise NotImplementedError
+
+    def device_aux(self):
+        """Optional pytree of decode-time constants (e.g. SSD anchors)."""
+        return None
+
 
 def register_decoder(mode: str):
     def deco(cls):
@@ -48,6 +70,10 @@ class TensorDecoder(Element):
     WANTS_HOST = True
     PROPS = {
         "mode": PropDef(str, None, "decoder subplugin name"),
+        # device=true: run the decode as XLA on device and emit the
+        # compact result tensor (boxes/keypoints/label index) instead of
+        # host-rendered media — raw model outputs never cross D2H
+        "device": PropDef(prop_bool, False, "device-side decode"),
         # reference passes up to 9 positional option strings; we accept
         # those plus named passthrough props via option_fields
         **{f"option{i}": PropDef(str, "") for i in range(1, 10)},
@@ -64,11 +90,23 @@ class TensorDecoder(Element):
         cls = registry.get(PluginKind.DECODER, self.props["mode"])
         self.sub: DecoderSubplugin = cls()
         self.sub.init(dict(self.props))
+        self._device_fn = None
+        if self.props["device"]:
+            self.WANTS_HOST = False   # keep payloads on device
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         spec = self.expect_tensors(in_specs[0])
         try:
-            out = self.sub.negotiate(spec)
+            if self.props["device"]:
+                out = self.sub.device_negotiate(spec)
+                import jax
+
+                self._device_aux = self.sub.device_aux()
+                if self._device_aux is not None:
+                    self._device_aux = jax.device_put(self._device_aux)
+                self._device_fn = jax.jit(self.sub.device_decode)
+            else:
+                out = self.sub.negotiate(spec)
         except (ValueError, PipelineError) as e:
             self.fail_negotiation(
                 f"decoder mode={self.props['mode']} rejected input "
@@ -77,4 +115,9 @@ class TensorDecoder(Element):
         return [out]
 
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        if self._device_fn is not None:
+            out = self._device_fn(buf.tensors, self._device_aux)
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            return [(0, buf.with_tensors(tuple(out)))]
         return [(0, self.sub.decode(buf.to_host()))]

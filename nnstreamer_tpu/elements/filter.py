@@ -83,6 +83,7 @@ class TensorFilter(Element):
         self._pre_programs: list = []
         self._post_programs: list = []
         self._fused_in_backend = False
+        self._fused_decoder = None             # device-decode subplugin
         self._in_combination = _parse_combination(self.props["input_combination"])
         self._out_combination = self._parse_out_combination(
             self.props["output_combination"]
@@ -119,6 +120,34 @@ class TensorFilter(Element):
         self._post_programs = post_programs or []
         self._pre = chain_fn(self._pre_programs)
         self._post = chain_fn(self._post_programs)
+
+    def set_decoder_fusion(self, sub) -> None:
+        """Absorb a downstream `tensor_decoder device=true` subplugin:
+        its device_decode traces into the same XLA program as the model
+        (+ any post transforms), so model output, postprocess, and result
+        land in ONE dispatch — raw outputs never leave the chip."""
+        self._fused_decoder = sub
+        base_post = self._post
+
+        def post(tensors, aux=None):
+            if base_post is not None:
+                tensors = base_post(tensors)
+            out = sub.device_decode(tuple(tensors), aux)
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        self._post = post
+
+    def _host_decoder_aux(self):
+        """Device-resident aux for the host-side fused-decoder fallback,
+        uploaded once and cached."""
+        if not hasattr(self, "_host_aux_cache"):
+            aux = getattr(self._post, "aux_params", None)
+            if aux is not None:
+                import jax
+
+                aux = jax.device_put(aux)
+            self._host_aux_cache = aux
+        return self._host_aux_cache
 
     # -- negotiation / backend open ---------------------------------------
     def _framework_name(self) -> str:
@@ -215,6 +244,24 @@ class TensorFilter(Element):
                 self.fail_negotiation(str(e))
         # fused post-chain spec transfer
         model_out = transfer_spec(self._post_programs, model_out)
+        if self._fused_decoder is not None:
+            if self._out_combination is not None:
+                self.fail_negotiation(
+                    "output-combination cannot combine with a fused device "
+                    "decoder (the decoder consumes the whole output set)")
+            try:
+                model_out = self._fused_decoder.device_negotiate(model_out)
+            except (ValueError, PipelineError) as e:
+                self.fail_negotiation(
+                    f"fused device decoder rejected model output "
+                    f"{model_out}: {e}")
+            # decode constants (e.g. anchors) exist only after
+            # device_negotiate; hand them to the backend as jit-argument
+            # aux now (re-fuse: compile happens lazily at first invoke)
+            self._post.aux_params = self._fused_decoder.device_aux()
+            if self._fused_in_backend:
+                self._fused_in_backend = self.backend.fuse(
+                    self._pre, self._post)
         out = model_out.with_rate(spec.rate)
         if self._out_combination is not None:
             infos = []
@@ -270,7 +317,10 @@ class TensorFilter(Element):
                 f"pts={buf.pts}: {e}"
             ) from e
         if self._post is not None and not self._fused_in_backend:
-            outputs = self._post(outputs)
+            # forward decode-aux (device_put once) so a declined-fusion
+            # backend doesn't re-upload constants (anchors) every frame
+            outputs = self._post(outputs) if self._fused_decoder is None \
+                else self._post(outputs, self._host_decoder_aux())
         if self.props["latency_mode"] == "sync":
             outputs = tuple(_block(o) for o in outputs)
         dt = time.perf_counter() - t0

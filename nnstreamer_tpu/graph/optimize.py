@@ -112,17 +112,40 @@ def fuse_transforms(pipe: Pipeline) -> int:
             cur = nxt
         post_programs = [t.program for t in down]
 
-        if not pre_programs and not post_programs:
+        # a device-mode decoder directly after the post chain traces into
+        # the same XLA program: model + postprocess in ONE dispatch
+        dec = None
+        out_links = pipe.links_from(cur)
+        if (len(out_links) == 1 and not f.props.get("invoke_dynamic")
+                and not f.props.get("output_combination")):
+            cand = out_links[0].dst
+            if (_is_device_decoder(cand)
+                    and len(pipe.links_to(cand)) == 1
+                    and len(pipe.links_from(cand)) == 1):
+                dec = cand
+
+        if not pre_programs and not post_programs and dec is None:
             continue
         for t in up + down:
             _remove_linear_element(pipe, t)
             fused += 1
         f.set_fusion(pre_programs, post_programs)
+        if dec is not None:
+            _remove_linear_element(pipe, dec)
+            f.set_decoder_fusion(dec.sub)
+            fused += 1
         log.info(
-            "fused %d pre + %d post transform(s) into %s",
-            len(pre_programs), len(post_programs), f.name,
+            "fused %d pre + %d post transform(s)%s into %s",
+            len(pre_programs), len(post_programs),
+            " + device decoder" if dec is not None else "", f.name,
         )
     return fused
+
+
+def _is_device_decoder(elem) -> bool:
+    from nnstreamer_tpu.elements.decoder import TensorDecoder
+
+    return isinstance(elem, TensorDecoder) and bool(elem.props.get("device"))
 
 
 def _remove_linear_element(pipe: Pipeline, elem) -> None:

@@ -12,6 +12,8 @@ Modules:
 - mesh.py           — mesh construction + pytree sharding rules
 - train.py          — sharded train step (optax) + TrainState
 - ring_attention.py — sequence-parallel attention via shard_map/ppermute
+- pipeline.py       — GPipe-style pipeline parallelism over pp
+- moe.py            — expert-parallel mixture-of-experts over ep
 - dispatch.py       — pod batch dispatcher (mesh-sharded inference)
 """
 
@@ -21,6 +23,8 @@ from nnstreamer_tpu.parallel.mesh import (
     shard_params,
     sharding_for,
 )
+from nnstreamer_tpu.parallel.moe import init_moe_params, moe_apply
+from nnstreamer_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from nnstreamer_tpu.parallel.train import TrainState, make_train_step
 
 __all__ = [
@@ -30,4 +34,8 @@ __all__ = [
     "sharding_for",
     "TrainState",
     "make_train_step",
+    "pipeline_apply",
+    "stack_stage_params",
+    "init_moe_params",
+    "moe_apply",
 ]

@@ -5,7 +5,9 @@ with PartitionSpec, let XLA insert the collectives, profile, iterate.
 Axes:
 
 - ``dp``  — data parallel (batch dim; gradients all-reduced over ICI)
+- ``pp``  — pipeline parallel (model stages; parallel/pipeline.py)
 - ``tp``  — tensor parallel (channel/feature dims of weights)
+- ``ep``  — expert parallel (MoE experts; parallel/moe.py)
 - ``sp``  — sequence/spatial parallel (long-context; ring attention)
 
 The reference's closest analogs are tensor_split/tensor_merge (manual
@@ -28,7 +30,7 @@ from nnstreamer_tpu.core.log import get_logger
 
 log = get_logger("parallel.mesh")
 
-AXES = ("dp", "tp", "sp")
+AXES = ("dp", "pp", "tp", "ep", "sp")
 
 
 @dataclass(frozen=True)
@@ -38,9 +40,12 @@ class MeshSpec:
     dp: int = -1
     tp: int = 1
     sp: int = 1
+    pp: int = 1
+    ep: int = 1
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int]:
-        sizes = {"dp": self.dp, "tp": self.tp, "sp": self.sp}
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
+        sizes = {"dp": self.dp, "pp": self.pp, "tp": self.tp,
+                 "ep": self.ep, "sp": self.sp}
         wild = [a for a, s in sizes.items() if s == -1]
         fixed = math.prod(s for s in sizes.values() if s != -1)
         if n_devices % max(1, fixed) != 0:
@@ -56,20 +61,22 @@ class MeshSpec:
                 f"mesh {sizes} needs {math.prod(sizes.values())} devices but "
                 f"only {n_devices} are visible"
             )
-        return sizes["dp"], sizes["tp"], sizes["sp"]
+        return tuple(sizes[a] for a in AXES)
 
 
 def make_mesh(spec: MeshSpec = MeshSpec(), devices=None) -> Mesh:
-    """Build a ("dp","tp","sp") mesh over the given (or all) devices.
+    """Build a ("dp","pp","tp","ep","sp") mesh over the given (or all)
+    devices.
 
     Device order preserves JAX's default enumeration, which follows the
-    physical torus on real TPU slices — innermost axis (sp) maps to
-    nearest-neighbor ICI links, which is exactly what ring attention's
-    ppermute wants.
+    physical torus on real TPU slices — the innermost axes (sp, then ep)
+    map to nearest-neighbor ICI links, which is what ring attention's
+    ppermute and MoE's all_to_all want; pp sits outer (stage hops are
+    once per microbatch, the least-frequent traffic).
     """
     devices = list(devices if devices is not None else jax.devices())
-    dp, tp, sp = spec.resolve(len(devices))
-    arr = np.array(devices[: dp * tp * sp]).reshape(dp, tp, sp)
+    shape = spec.resolve(len(devices))
+    arr = np.array(devices[: math.prod(shape)]).reshape(shape)
     return Mesh(arr, AXES)
 
 

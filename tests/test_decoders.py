@@ -224,3 +224,158 @@ def test_font_renders_text():
     assert (img[:, :, 0] == 255).sum() > 0
     # clipping never raises
     blit_text(img, "CLIPPED", 25, 8)
+
+
+# -- device-side decode (tensor_decoder device=true) -------------------------
+
+class TestDeviceDecode:
+    def _ssd_io(self, seed=0, objects=6):
+        """Realistic raw SSD outputs: background-dominant logits with a
+        handful of planted confident detections."""
+        from nnstreamer_tpu.models.ssd_mobilenet import generate_anchors
+
+        rng = np.random.default_rng(seed)
+        n = generate_anchors().shape[0]
+        loc = rng.normal(0, 0.3, (1, n, 4)).astype(np.float32)
+        logits = rng.normal(-9, 0.5, (1, n, 91)).astype(np.float32)
+        for i in rng.choice(n, objects, replace=False):
+            logits[0, i, rng.integers(1, 91)] = rng.uniform(2.0, 5.0)
+        return loc, logits
+
+    def test_ssd_device_matches_host_nms(self):
+        """Device decode's surviving boxes equal the host decoder's
+        (same order: score-desc) in output-pixel coordinates."""
+        from nnstreamer_tpu.decoders.boundingbox import BoundingBoxes
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+        loc, logits = self._ssd_io()
+        props = {"option1": "mobilenet-ssd", "option3": "0.5:0.5",
+                 "option4": "300:300"}
+        host = BoundingBoxes()
+        host.init(dict(props))
+        spec = TensorsSpec.of(TensorInfo(loc.shape, DType.FLOAT32),
+                              TensorInfo(logits.shape, DType.FLOAT32))
+        host.negotiate(spec)
+        host_out = host.decode(TensorBuffer.of(loc, logits))
+        host_boxes = host_out.meta["boxes"]          # (N,6) px, score desc
+
+        dev = BoundingBoxes()
+        dev.init(dict(props))
+        dev.device_negotiate(spec)
+        (det,) = dev.device_decode((loc, logits))
+        det = np.asarray(det)
+        kept = det[det[:, 4] > 0]
+        assert len(kept) == len(host_boxes)
+        # host layout [ymin,xmin,ymax,xmax,score,cls] in px — same here
+        np.testing.assert_allclose(kept, host_boxes, rtol=1e-4, atol=1e-2)
+
+    def test_ssd_device_pipeline(self):
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        loc, logits = self._ssd_io(1)
+        pipe = nns.parse_launch(
+            f"appsrc name=src dims=4:{loc.shape[1]}:1,91:{loc.shape[1]}:1 "
+            f"types=float32,float32 ! "
+            f"tensor_decoder mode=bounding_boxes device=true "
+            f"option1=mobilenet-ssd option3=0.3:0.5 option4=300:300 ! "
+            f"tensor_sink name=out")
+        runner = nns.PipelineRunner(pipe).start()
+        src = pipe.get("src")
+        src.push(TensorBuffer.of(loc, logits))
+        src.end()
+        runner.wait(60)
+        runner.stop()
+        res = pipe.get("out").results
+        assert len(res) == 1 and res[0].tensors[0].shape == (16, 6)
+
+    def test_pose_device_matches_host(self):
+        from nnstreamer_tpu.decoders.pose import PoseEstimation
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+        rng = np.random.default_rng(3)
+        hm = rng.uniform(0, 1, (1, 9, 9, 17)).astype(np.float32)
+        off = rng.normal(0, 4, (1, 9, 9, 34)).astype(np.float32)
+        props = {"option1": "257:257", "option2": "257:257",
+                 "option4": "0.0"}
+        host = PoseEstimation()
+        host.init(dict(props))
+        spec = TensorsSpec.of(TensorInfo(hm.shape, DType.FLOAT32),
+                              TensorInfo(off.shape, DType.FLOAT32))
+        host.negotiate(spec)
+        want = host._keypoints(TensorBuffer.of(hm, off))   # (K,3) px
+
+        dev = PoseEstimation()
+        dev.init(dict(props))
+        dev.device_negotiate(spec)
+        (got,) = dev.device_decode((hm, off))
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_label_device_argmax(self):
+        from nnstreamer_tpu.decoders.label import ImageLabeling
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+        scores = np.zeros((1, 10), np.float32)
+        scores[0, 7] = 5.0
+        sub = ImageLabeling()
+        sub.init({})
+        sub.device_negotiate(TensorsSpec.of(
+            TensorInfo((1, 10), DType.FLOAT32)))
+        (idx,) = sub.device_decode((scores,))
+        assert int(np.asarray(idx)[0]) == 7
+
+    def test_device_unsupported_scheme_fails_cleanly(self):
+        import nnstreamer_tpu as nns
+        with pytest.raises(nns.core.errors.NegotiationError,
+                           match="host"):
+            pipe = nns.parse_launch(
+                "appsrc dims=7:10:1 types=float32 ! "
+                "tensor_decoder mode=bounding_boxes device=true "
+                "option1=ov-person-detection ! fakesink")
+            nns.PipelineRunner(pipe).start()
+
+    def test_device_decoder_fuses_into_filter(self):
+        """transform + filter + device decoder collapse into one element;
+        results match the unfused pipeline."""
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.backends.custom import register_custom_easy
+        from nnstreamer_tpu.models.ssd_mobilenet import generate_anchors
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        loc, logits = self._ssd_io(5)
+
+        # fake "model" emitting fixed SSD raw outputs regardless of input
+        register_custom_easy(
+            "fake_ssd", lambda t: (loc, logits),
+        )
+        desc = ("appsrc name=src dims=4 types=float32 ! "
+                "tensor_filter name=f framework=custom model=fake_ssd "
+                "output=4:{n}:1,91:{n}:1 outputtype=float32,float32 ! "
+                "tensor_decoder mode=bounding_boxes device=true "
+                "option1=mobilenet-ssd option3=0.3:0.5 option4=300:300 ! "
+                "tensor_sink name=out").format(n=loc.shape[1])
+
+        def run(optimize):
+            pipe = nns.parse_launch(desc)
+            runner = nns.PipelineRunner(pipe, optimize=optimize).start()
+            src = pipe.get("src")
+            src.push(TensorBuffer.of(np.zeros(4, np.float32)))
+            src.end()
+            runner.wait(60)
+            runner.stop()
+            return pipe
+
+    # fused: decoder element disappears from the graph
+        fused_pipe = run(True)
+        assert not any(e.ELEMENT_NAME == "tensor_decoder"
+                       for e in fused_pipe.elements.values())
+        plain_pipe = run(False)
+        a = np.asarray(fused_pipe.get("out").results[0].tensors[0])
+        b = np.asarray(plain_pipe.get("out").results[0].tensors[0])
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-3)

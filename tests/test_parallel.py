@@ -16,10 +16,12 @@ from nnstreamer_tpu.parallel.train import init_state, shard_state
 
 
 def test_mesh_spec_resolution(eight_cpu_devices):
-    assert MeshSpec(dp=-1, tp=2, sp=1).resolve(8) == (4, 2, 1)
-    assert MeshSpec(dp=2, tp=2, sp=2).resolve(8) == (2, 2, 2)
+    # resolve order follows AXES = (dp, pp, tp, ep, sp)
+    assert MeshSpec(dp=-1, tp=2, sp=1).resolve(8) == (4, 1, 2, 1, 1)
+    assert MeshSpec(dp=2, tp=2, sp=2).resolve(8) == (2, 1, 2, 1, 2)
+    assert MeshSpec(dp=1, pp=4, ep=2).resolve(8) == (1, 4, 1, 2, 1)
     mesh = make_mesh(MeshSpec(dp=2, tp=2, sp=2))
-    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+    assert mesh.shape == {"dp": 2, "pp": 1, "tp": 2, "ep": 1, "sp": 2}
     with pytest.raises(Exception):
         MeshSpec(dp=3, tp=2, sp=1).resolve(8)
 
@@ -114,3 +116,101 @@ def test_mesh_dispatcher_batches(eight_cpu_devices):
         assert d.batches >= 2
     finally:
         d.shutdown()
+
+
+# -- pipeline parallelism (pp) ------------------------------------------------
+
+def test_pipeline_matches_serial(eight_cpu_devices):
+    from nnstreamer_tpu.parallel.pipeline import (
+        pipeline_apply, reference_pipeline, stack_stage_params)
+
+    mesh = make_mesh(MeshSpec(dp=1, pp=4))
+    key = jax.random.PRNGKey(0)
+    d = 16
+    per_stage = []
+    for i in range(4):
+        k1, k2, key = jax.random.split(key, 3)
+        per_stage.append({
+            "w": jax.random.normal(k1, (d, d)) * d ** -0.5,
+            "b": jax.random.normal(k2, (d,)) * 0.1,
+        })
+
+    def stage(p, a):
+        return jnp.tanh(a @ p["w"] + p["b"])
+
+    x = jax.random.normal(key, (6, 3, d))     # 6 microbatches of 3 tokens
+    stacked = stack_stage_params(per_stage)
+    got = jax.jit(
+        lambda s, x: pipeline_apply(stage, s, x, mesh=mesh))(stacked, x)
+    want = reference_pipeline(stage, per_stage, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_single_microbatch(eight_cpu_devices):
+    from nnstreamer_tpu.parallel.pipeline import (
+        pipeline_apply, reference_pipeline, stack_stage_params)
+
+    mesh = make_mesh(MeshSpec(dp=1, pp=8))
+    per_stage = [{"w": jnp.eye(4) * (i + 1)} for i in range(8)]
+    stage = lambda p, a: a @ p["w"]
+    x = jnp.ones((1, 2, 4))
+    got = pipeline_apply(stage, stack_stage_params(per_stage), x, mesh=mesh)
+    want = reference_pipeline(stage, per_stage, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+# -- expert parallelism (ep) --------------------------------------------------
+
+def test_moe_matches_serial_when_capacity_ample(eight_cpu_devices):
+    from nnstreamer_tpu.parallel.moe import (
+        init_moe_params, moe_apply, moe_param_specs, reference_moe)
+    from jax.sharding import NamedSharding
+
+    mesh = make_mesh(MeshSpec(dp=1, ep=8))
+    key = jax.random.PRNGKey(1)
+    d, h, E, T = 8, 16, 8, 64
+    params = init_moe_params(key, d, h, E)
+    x = jax.random.normal(jax.random.PRNGKey(2), (T, d))
+    specs = moe_param_specs()
+    placed = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+              for k, v in params.items()}
+    xs = jax.device_put(x, NamedSharding(mesh, P("ep")))
+    # capacity ≥ local tokens → zero drops → serial equivalence
+    got = jax.jit(lambda p, x: moe_apply(p, x, mesh=mesh,
+                                         capacity_factor=float(E)))(placed, xs)
+    want = reference_moe(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_capacity_drops_are_bounded_not_wrong(eight_cpu_devices):
+    """With a tight capacity, dropped tokens produce zero output (the
+    residual path carries them); surviving tokens still match serial."""
+    from nnstreamer_tpu.parallel.moe import (
+        init_moe_params, moe_apply, moe_param_specs, reference_moe)
+    from jax.sharding import NamedSharding
+
+    mesh = make_mesh(MeshSpec(dp=1, ep=8))
+    d, h, E, T = 8, 16, 8, 64
+    params = init_moe_params(jax.random.PRNGKey(1), d, h, E)
+    x = jax.random.normal(jax.random.PRNGKey(2), (T, d))
+    specs = moe_param_specs()
+    placed = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+              for k, v in params.items()}
+    xs = jax.device_put(x, NamedSharding(mesh, P("ep")))
+    got = np.asarray(moe_apply(placed, xs, mesh=mesh, capacity_factor=1.0))
+    want = np.asarray(reference_moe(params, x))
+    for t in range(T):
+        if np.allclose(got[t], 0.0):
+            continue                     # dropped: zero contribution
+        np.testing.assert_allclose(got[t], want[t], rtol=1e-4, atol=1e-4)
+
+
+def test_moe_rejects_undivisible_experts(eight_cpu_devices):
+    from nnstreamer_tpu.parallel.moe import init_moe_params, moe_apply
+
+    mesh = make_mesh(MeshSpec(dp=1, ep=8))
+    params = init_moe_params(jax.random.PRNGKey(0), 4, 8, 6)  # 6 % 8 != 0
+    with pytest.raises(ValueError, match="experts"):
+        moe_apply(params, jnp.ones((16, 4)), mesh=mesh)
