@@ -214,3 +214,26 @@ def test_moe_rejects_undivisible_experts(eight_cpu_devices):
     params = init_moe_params(jax.random.PRNGKey(0), 4, 8, 6)  # 6 % 8 != 0
     with pytest.raises(ValueError, match="experts"):
         moe_apply(params, jnp.ones((16, 4)), mesh=mesh)
+
+
+# -- multi-host entry points (single-process degenerate case) -----------------
+
+def test_multihost_single_process_fallback(eight_cpu_devices):
+    from nnstreamer_tpu.parallel import multihost
+
+    # no coordinator configured → clean single-process fallback
+    assert multihost.initialize() is False
+    mesh = multihost.global_mesh(MeshSpec(dp=4, tp=2))
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+
+
+def test_multihost_batch_and_fetch(eight_cpu_devices):
+    from nnstreamer_tpu.parallel import multihost
+
+    mesh = multihost.global_mesh(MeshSpec(dp=8))
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    gx = multihost.host_local_batch(mesh, x)
+    assert gx.shape == (8, 2)
+    y = jax.jit(lambda a: a * 2)(gx)
+    out = multihost.fetch_replicated(y)
+    np.testing.assert_array_equal(np.asarray(out), x * 2)
