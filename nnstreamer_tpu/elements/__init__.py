@@ -24,13 +24,14 @@ from nnstreamer_tpu.elements import (  # noqa: F401
 )
 from nnstreamer_tpu.trainer import element as _trainer_element  # noqa: F401
 # schema'd interop codecs register decoder/converter subplugins
-# "protobuf" and "flexbuf" (SURVEY.md §2.4 codec pairs); grpc_elements
+# "protobuf", "flexbuf" and "flatbuf" (SURVEY.md §2.4 codec pairs); grpc_elements
 # registers tensor_src_grpc / tensor_sink_grpc (§2.5). Soft dependency:
 # a stripped install without protobuf/flatbuffers/grpcio still gets the
 # full non-interop element set (the reference gates the same subplugins
 # behind meson feature flags).
 try:
     from nnstreamer_tpu.interop import (  # noqa: F401
+        flatbuf_codec,
         flexbuf_codec,
         grpc_elements,
         protobuf_codec,

@@ -5,11 +5,14 @@ links; this package covers the reference's schema'd interop surface
 (SURVEY.md §2.4 flatbuf/flexbuf/protobuf codec pairs, §2.5 gRPC):
 
 - protobuf_codec — nnstreamer.protobuf.Tensors frames (tensors.proto)
+- flatbuf_codec  — nnstreamer.fbs flatbuffers frames (raw Builder/Table,
+                   no flatc needed)
 - flexbuf_codec  — schema-less flexbuffers map frames
 - gst_meta       — GstTensorMetaInfo v1 header for flexible payloads
 - grpc_elements  — tensor_src_grpc / tensor_sink_grpc over real gRPC
 
-Importing the codec modules registers decoder modes "protobuf"/"flexbuf"
+Importing the codec modules registers decoder modes "protobuf"/
+"flexbuf"/"flatbuf"
 and converter subplugins of the same names.
 """
 

@@ -1,0 +1,193 @@
+"""FlatBuffers tensor-stream codec — the schema'd binary interop format.
+
+Reference parity: tensordec-flatbuf.cc + tensor_converter_flatbuf.cc over
+the nnstreamer.fbs schema (ext/nnstreamer/include/nnstreamer.fbs):
+
+    table Tensor  { name:string; type:int=NNS_END; dimension:[uint];
+                    data:[ubyte]; }
+    struct frame_rate { rate_n:int; rate_d:int; }
+    table Tensors { num_tensor:int; fr:frame_rate; tensor:[Tensor];
+                    format:int=0; }  // root_type Tensors
+
+No flatc on the build host, so tables are built/read with the raw
+flatbuffers Builder/Table API; the vtable slot layout (slot i → voffset
+4+2i) *is* the schema contract, matching what flatc would generate, so
+frames interop with any consumer compiled from nnstreamer.fbs. Same
+dim/payload conventions as the protobuf codec (innermost-first rank-4
+1-padded dims; GstTensorMetaInfo-prefixed FLEXIBLE payloads).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flatbuffers
+import numpy as np
+from flatbuffers import number_types as NT
+from flatbuffers.table import Table
+
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.elements.converter import ConverterSubplugin, register_converter
+from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
+from nnstreamer_tpu.graph.media import MediaSpec, OctetSpec
+from nnstreamer_tpu.interop.gst_meta import (
+    HEADER_SIZE,
+    check_wire_dtype,
+    pack_gst_meta,
+    parse_gst_meta,
+    shape_from_wire,
+    wire_dims,
+)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+
+_NNS_END = 10   # schema default for Tensor.type
+
+
+def encode_flatbuf(buf: TensorBuffer, rate=None) -> bytes:
+    """TensorBuffer → flatbuffers frame (nnstreamer.fbs layout)."""
+    b = flatbuffers.Builder(1024)
+    non_static = buf.format != TensorFormat.STATIC
+    frac = rate if isinstance(rate, tuple) else (rate or 0, 1)
+
+    tensor_offs = []
+    for i, t in enumerate(buf.tensors):
+        arr = np.ascontiguousarray(np.asarray(t))
+        dt = DType.from_np(arr.dtype)
+        check_wire_dtype(dt)
+        raw = arr.tobytes()
+        if non_static:
+            raw = pack_gst_meta(arr.shape, dt, buf.format) + raw
+        name_off = b.CreateString(
+            str(buf.meta.get("tensor_names", {}).get(i, "")))
+        data_off = b.CreateByteVector(raw)
+        dims = wire_dims(arr.shape)
+        b.StartVector(4, len(dims), 4)
+        for d in reversed(dims):
+            b.PrependUint32(d)
+        dim_off = b.EndVector()
+        # table Tensor: slots name=0, type=1, dimension=2, data=3
+        b.StartObject(4)
+        b.PrependUOffsetTRelativeSlot(0, name_off, 0)
+        b.PrependInt32Slot(1, int(dt), _NNS_END)
+        b.PrependUOffsetTRelativeSlot(2, dim_off, 0)
+        b.PrependUOffsetTRelativeSlot(3, data_off, 0)
+        tensor_offs.append(b.EndObject())
+
+    b.StartVector(4, len(tensor_offs), 4)
+    for off in reversed(tensor_offs):
+        b.PrependUOffsetTRelative(off)
+    vec_off = b.EndVector()
+
+    # table Tensors: num_tensor=0, fr=1 (inline struct), tensor=2, format=3
+    b.StartObject(4)
+    b.PrependInt32Slot(0, buf.num_tensors, 0)
+    b.Prep(4, 8)                      # struct frame_rate {int;int}
+    b.PrependInt32(int(frac[1]))      # rate_d (last field first)
+    b.PrependInt32(int(frac[0]))      # rate_n
+    b.PrependStructSlot(1, b.Offset(), 0)
+    b.PrependUOffsetTRelativeSlot(2, vec_off, 0)
+    b.PrependInt32Slot(3, int(buf.format), 0)
+    b.Finish(b.EndObject())
+    return bytes(b.Output())
+
+
+def _slot(tab: Table, slot: int) -> int:
+    return tab.Offset(4 + 2 * slot)
+
+
+def decode_flatbuf(frame: bytes) -> TensorBuffer:
+    """flatbuffers frame → TensorBuffer (host numpy)."""
+    buf = bytearray(frame)
+    try:
+        root_pos = flatbuffers.encode.Get(flatbuffers.packer.uoffset, buf, 0)
+        tab = Table(buf, root_pos)
+        o = _slot(tab, 0)
+        num = tab.Get(NT.Int32Flags, o + tab.Pos) if o else 0
+        o = _slot(tab, 3)
+        fmt = TensorFormat(tab.Get(NT.Int32Flags, o + tab.Pos) if o else 0)
+        vo = _slot(tab, 2)
+        n_vec = tab.VectorLen(vo) if vo else 0
+    except (Exception,) as e:
+        raise StreamError(f"corrupt flatbuf tensor frame: {e}") from None
+    if num != n_vec:
+        raise StreamError(
+            f"flatbuf frame: num_tensor={num} but tensor vector has "
+            f"{n_vec} entries")
+    arrays, names = [], {}
+    for j in range(n_vec):
+        try:
+            x = tab.Vector(vo) + j * 4
+            ttab = Table(buf, tab.Indirect(x))
+            so = _slot(ttab, 0)
+            name = (ttab.String(so + ttab.Pos).decode()
+                    if so else "")
+            to = _slot(ttab, 1)
+            dt = DType(ttab.Get(NT.Int32Flags, to + ttab.Pos)
+                       if to else _NNS_END)
+            do = _slot(ttab, 2)
+            dims = []
+            if do:
+                for k in range(ttab.VectorLen(do)):
+                    dims.append(ttab.Get(
+                        NT.Uint32Flags, ttab.Vector(do) + k * 4))
+            bo = _slot(ttab, 3)
+            if not bo:
+                raise StreamError("tensor entry without data")
+            dstart = ttab.Vector(bo)
+            raw = bytes(buf[dstart:dstart + ttab.VectorLen(bo)])
+        except StreamError:
+            raise
+        except Exception as e:
+            raise StreamError(
+                f"corrupt flatbuf tensor frame at tensor {j}: {e}"
+            ) from None
+        if fmt != TensorFormat.STATIC and len(raw) >= HEADER_SIZE:
+            shape, hdt, _, _, _, off = parse_gst_meta(raw)
+            arr = np.frombuffer(raw, hdt.np_dtype, offset=off,
+                                count=math.prod(shape)).reshape(shape).copy()
+        else:
+            shape = shape_from_wire(dims)
+            n_el = math.prod(shape) if shape else 1
+            if n_el * dt.itemsize != len(raw):
+                raise StreamError(
+                    f"flatbuf tensor {j}: {len(raw)} payload bytes != "
+                    f"{n_el} elements of {dt.type_name} from dims {dims}")
+            arr = np.frombuffer(raw, dt.np_dtype).reshape(shape).copy()
+        arrays.append(arr)
+        if name:
+            names[j] = name
+    meta = {"tensor_names": names} if names else {}
+    return TensorBuffer(tensors=tuple(arrays), format=fmt, meta=meta)
+
+
+@register_decoder("flatbuf")
+class FlatbufEncode(DecoderSubplugin):
+    """tensors → flatbuffers bytes (tensordec-flatbuf analog)."""
+
+    def negotiate(self, in_spec: TensorsSpec) -> OctetSpec:
+        for ti in in_spec.tensors:
+            check_wire_dtype(ti.dtype)
+        self._rate = in_spec.rate
+        return OctetSpec(rate=in_spec.rate)
+
+    def decode(self, buf: TensorBuffer) -> TensorBuffer:
+        frame = encode_flatbuf(buf, rate=getattr(self, "_rate", None))
+        return buf.with_tensors((np.frombuffer(frame, np.uint8).copy(),))
+
+
+@register_converter("flatbuf")
+class FlatbufDecode(ConverterSubplugin):
+    """flatbuffers bytes → tensors (tensor_converter_flatbuf analog)."""
+
+    def negotiate(self, in_spec: MediaSpec) -> TensorsSpec:
+        return TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
+                           rate=in_spec.rate)
+
+    def convert(self, buf: TensorBuffer) -> TensorBuffer:
+        data = np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes()
+        out = decode_flatbuf(data)
+        if buf.pts is not None:
+            out = out.with_tensors(out.tensors, pts=buf.pts)
+        return out

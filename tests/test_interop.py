@@ -20,6 +20,7 @@ import pytest
 
 import nnstreamer_tpu as nns
 from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.interop.flatbuf_codec import decode_flatbuf, encode_flatbuf
 from nnstreamer_tpu.interop.flexbuf_codec import decode_flexbuf, encode_flexbuf
 from nnstreamer_tpu.interop.gst_meta import (
     pack_gst_meta, parse_gst_meta, shape_from_wire, wire_dims)
@@ -69,7 +70,8 @@ def test_wire_dims_convention():
 # -- codec roundtrips ---------------------------------------------------------
 
 CODECS = [(encode_protobuf, decode_protobuf, "protobuf"),
-          (encode_flexbuf, decode_flexbuf, "flexbuf")]
+          (encode_flexbuf, decode_flexbuf, "flexbuf"),
+          (encode_flatbuf, decode_flatbuf, "flatbuf")]
 
 
 @pytest.mark.parametrize("enc,dec,name", CODECS)
@@ -121,7 +123,8 @@ def test_bfloat16_rejected_with_typecast_hint(enc, dec, name):
         enc(buf)
 
 
-@pytest.mark.parametrize("dec", [decode_protobuf, decode_flexbuf])
+@pytest.mark.parametrize("dec", [decode_protobuf, decode_flexbuf,
+                                 decode_flatbuf])
 def test_corrupt_frames_rejected(dec):
     with pytest.raises(StreamError, match="corrupt|payload bytes"):
         dec(b"\xff" * 64)
@@ -234,7 +237,7 @@ def test_our_converter_parses_external_flexbuf_frames():
 
 # -- pipeline integration -----------------------------------------------------
 
-@pytest.mark.parametrize("codec", ["protobuf", "flexbuf"])
+@pytest.mark.parametrize("codec", ["protobuf", "flexbuf", "flatbuf"])
 def test_pipeline_decoder_converter_roundtrip(codec):
     pipe = nns.parse_launch(
         f"appsrc name=in dims=3:4 types=float32 ! "
@@ -372,3 +375,39 @@ def test_gst_meta_rejects_superset_tag_bytes():
         struct.pack_into("<I", hdr, 0, tag)
         with pytest.raises(StreamError, match="version"):
             parse_gst_meta(bytes(hdr))
+
+
+def test_external_process_parses_our_flatbuf_frames():
+    """An independent reader using only the stock flatbuffers Table API
+    and the published nnstreamer.fbs slot layout parses our frames."""
+    buf = TensorBuffer.of(np.arange(10, dtype=np.int16).reshape(5, 2))
+    frame = encode_flatbuf(buf, rate=(24, 1))
+    out = _run_external("""
+        import sys
+        import flatbuffers
+        from flatbuffers import number_types as NT
+        from flatbuffers.table import Table
+        raw = bytearray(sys.stdin.buffer.read())
+        root = flatbuffers.encode.Get(flatbuffers.packer.uoffset, raw, 0)
+        tab = Table(raw, root)
+        def slot(t, i): return t.Offset(4 + 2 * i)
+        o = slot(tab, 0)
+        assert tab.Get(NT.Int32Flags, o + tab.Pos) == 1       # num_tensor
+        fo = slot(tab, 1)                                     # fr struct
+        assert tab.Get(NT.Int32Flags, fo + tab.Pos) == 24     # rate_n
+        assert tab.Get(NT.Int32Flags, fo + tab.Pos + 4) == 1  # rate_d
+        vo = slot(tab, 2)
+        x = tab.Vector(vo)
+        ttab = Table(raw, tab.Indirect(x))
+        to = slot(ttab, 1)
+        assert ttab.Get(NT.Int32Flags, to + ttab.Pos) == 2    # NNS_INT16
+        do = slot(ttab, 2)
+        dims = [ttab.Get(NT.Uint32Flags, ttab.Vector(do) + k*4)
+                for k in range(ttab.VectorLen(do))]
+        assert dims == [2, 5, 1, 1]
+        bo = slot(ttab, 3)
+        s = ttab.Vector(bo)
+        sys.stdout.buffer.write(bytes(raw[s:s + ttab.VectorLen(bo)]))
+    """, stdin=frame)
+    np.testing.assert_array_equal(
+        np.frombuffer(out, np.int16).reshape(5, 2), buf.tensors[0])
