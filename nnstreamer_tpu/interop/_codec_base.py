@@ -1,0 +1,64 @@
+"""Shared decoder/converter subplugin wrapper for the interop codecs.
+
+All three schema'd codecs (protobuf/flatbuf/flexbuf) expose the same
+pipeline surface: `tensor_decoder mode=<name>` serializes tensors to
+frame bytes, `tensor_converter mode=custom:<name>` parses them back as a
+FLEXIBLE stream. One factory instead of three verbatim class pairs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.interop.gst_meta import check_wire_dtype
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+
+
+def register_codec_pair(name: str, encode_fn, decode_fn):
+    """Register tensors→bytes decoder + bytes→tensors converter under
+    `name`. encode_fn(buf, rate=...) -> bytes; decode_fn(bytes) ->
+    TensorBuffer. Returns the two classes.
+
+    The element imports live here, not at module top: a codec module may
+    be the FIRST thing imported in a process, and elements/__init__
+    re-imports the codecs — a top-level import of elements.converter
+    from this module would make that cycle unresolvable."""
+    from nnstreamer_tpu.elements.converter import (
+        ConverterSubplugin, register_converter)
+    from nnstreamer_tpu.elements.decoder import (
+        DecoderSubplugin, register_decoder)
+    from nnstreamer_tpu.graph.media import MediaSpec, OctetSpec
+
+    class Encode(DecoderSubplugin):
+        def negotiate(self, in_spec: TensorsSpec) -> OctetSpec:
+            for ti in in_spec.tensors:
+                check_wire_dtype(ti.dtype)
+            self._rate = in_spec.rate
+            return OctetSpec(rate=in_spec.rate)
+
+        def decode(self, buf: TensorBuffer) -> TensorBuffer:
+            frame = encode_fn(buf, rate=getattr(self, "_rate", None))
+            return buf.with_tensors(
+                (np.frombuffer(frame, np.uint8).copy(),))
+
+    class Decode(ConverterSubplugin):
+        def negotiate(self, in_spec: MediaSpec) -> TensorsSpec:
+            # FLEXIBLE: every frame is self-describing; shapes may vary
+            return TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
+                               rate=in_spec.rate)
+
+        def convert(self, buf: TensorBuffer) -> TensorBuffer:
+            data = np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes()
+            out = decode_fn(data)
+            if buf.pts is not None:
+                out = out.with_tensors(out.tensors, pts=buf.pts)
+            return out
+
+    Encode.__name__ = f"{name.capitalize()}Encode"
+    Decode.__name__ = f"{name.capitalize()}Decode"
+    Encode.__doc__ = f"tensors → {name} frame bytes."
+    Decode.__doc__ = f"{name} frame bytes → tensors (FLEXIBLE stream)."
+    register_decoder(name)(Encode)
+    register_converter(name)(Decode)
+    return Encode, Decode

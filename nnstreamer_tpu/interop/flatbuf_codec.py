@@ -19,7 +19,6 @@ dim/payload conventions as the protobuf codec (innermost-first rank-4
 
 from __future__ import annotations
 
-import math
 
 import flatbuffers
 import numpy as np
@@ -27,20 +26,16 @@ from flatbuffers import number_types as NT
 from flatbuffers.table import Table
 
 from nnstreamer_tpu.core.errors import StreamError
-from nnstreamer_tpu.elements.converter import ConverterSubplugin, register_converter
-from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
-from nnstreamer_tpu.graph.media import MediaSpec, OctetSpec
+from nnstreamer_tpu.interop._codec_base import register_codec_pair
 from nnstreamer_tpu.interop.gst_meta import (
-    HEADER_SIZE,
     check_wire_dtype,
     pack_gst_meta,
-    parse_gst_meta,
-    shape_from_wire,
+    payload_to_array,
     wire_dims,
 )
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.dtypes import DType
-from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+from nnstreamer_tpu.tensor.info import TensorFormat
 
 _NNS_END = 10   # schema default for Tensor.type
 
@@ -143,18 +138,8 @@ def decode_flatbuf(frame: bytes) -> TensorBuffer:
             raise StreamError(
                 f"corrupt flatbuf tensor frame at tensor {j}: {e}"
             ) from None
-        if fmt != TensorFormat.STATIC and len(raw) >= HEADER_SIZE:
-            shape, hdt, _, _, _, off = parse_gst_meta(raw)
-            arr = np.frombuffer(raw, hdt.np_dtype, offset=off,
-                                count=math.prod(shape)).reshape(shape).copy()
-        else:
-            shape = shape_from_wire(dims)
-            n_el = math.prod(shape) if shape else 1
-            if n_el * dt.itemsize != len(raw):
-                raise StreamError(
-                    f"flatbuf tensor {j}: {len(raw)} payload bytes != "
-                    f"{n_el} elements of {dt.type_name} from dims {dims}")
-            arr = np.frombuffer(raw, dt.np_dtype).reshape(shape).copy()
+        arr = payload_to_array(raw, dims, dt, fmt,
+                               f"flatbuf tensor {j}")
         arrays.append(arr)
         if name:
             names[j] = name
@@ -162,32 +147,5 @@ def decode_flatbuf(frame: bytes) -> TensorBuffer:
     return TensorBuffer(tensors=tuple(arrays), format=fmt, meta=meta)
 
 
-@register_decoder("flatbuf")
-class FlatbufEncode(DecoderSubplugin):
-    """tensors → flatbuffers bytes (tensordec-flatbuf analog)."""
-
-    def negotiate(self, in_spec: TensorsSpec) -> OctetSpec:
-        for ti in in_spec.tensors:
-            check_wire_dtype(ti.dtype)
-        self._rate = in_spec.rate
-        return OctetSpec(rate=in_spec.rate)
-
-    def decode(self, buf: TensorBuffer) -> TensorBuffer:
-        frame = encode_flatbuf(buf, rate=getattr(self, "_rate", None))
-        return buf.with_tensors((np.frombuffer(frame, np.uint8).copy(),))
-
-
-@register_converter("flatbuf")
-class FlatbufDecode(ConverterSubplugin):
-    """flatbuffers bytes → tensors (tensor_converter_flatbuf analog)."""
-
-    def negotiate(self, in_spec: MediaSpec) -> TensorsSpec:
-        return TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
-                           rate=in_spec.rate)
-
-    def convert(self, buf: TensorBuffer) -> TensorBuffer:
-        data = np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes()
-        out = decode_flatbuf(data)
-        if buf.pts is not None:
-            out = out.with_tensors(out.tensors, pts=buf.pts)
-        return out
+FlatbufEncode, FlatbufDecode = register_codec_pair(
+    "flatbuf", encode_flatbuf, decode_flatbuf)

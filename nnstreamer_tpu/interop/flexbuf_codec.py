@@ -20,26 +20,21 @@ exactly like the reference (is_flexible branch, tensordec-flexbuf.cc:147).
 
 from __future__ import annotations
 
-import math
 
 import numpy as np
 from flatbuffers import flexbuffers
 
 from nnstreamer_tpu.core.errors import StreamError
-from nnstreamer_tpu.elements.converter import ConverterSubplugin, register_converter
-from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
-from nnstreamer_tpu.graph.media import MediaSpec, OctetSpec
+from nnstreamer_tpu.interop._codec_base import register_codec_pair
 from nnstreamer_tpu.interop.gst_meta import (
-    HEADER_SIZE,
     check_wire_dtype,
     pack_gst_meta,
-    parse_gst_meta,
-    shape_from_wire,
+    payload_to_array,
     wire_dims,
 )
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.dtypes import DType
-from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+from nnstreamer_tpu.tensor.info import TensorFormat
 
 
 def encode_flexbuf(buf: TensorBuffer, rate=None) -> bytes:
@@ -95,19 +90,8 @@ def decode_flexbuf(frame: bytes) -> TensorBuffer:
             raise StreamError(
                 f"corrupt flexbuf tensor frame at tensor_{i}: {e}"
             ) from None
-        if fmt != TensorFormat.STATIC and len(raw) >= HEADER_SIZE:
-            shape, hdt, _, _, _, off = parse_gst_meta(raw)
-            arr = np.frombuffer(raw, hdt.np_dtype, offset=off,
-                                count=math.prod(shape)).reshape(shape).copy()
-        else:
-            shape = shape_from_wire(dims)
-            n = math.prod(shape) if shape else 1
-            if n * dt.itemsize != len(raw):
-                raise StreamError(
-                    f"flexbuf tensor_{i}: {len(raw)} payload bytes != {n} "
-                    f"elements of {dt.type_name} from dims {dims}"
-                )
-            arr = np.frombuffer(raw, dt.np_dtype).reshape(shape).copy()
+        arr = payload_to_array(raw, dims, dt, fmt,
+                               f"flexbuf tensor_{i}")
         arrays.append(arr)
         if name:
             names[i] = name
@@ -115,32 +99,5 @@ def decode_flexbuf(frame: bytes) -> TensorBuffer:
     return TensorBuffer(tensors=tuple(arrays), format=fmt, meta=meta)
 
 
-@register_decoder("flexbuf")
-class FlexbufEncode(DecoderSubplugin):
-    """tensors → flexbuffers bytes (tensordec-flexbuf analog)."""
-
-    def negotiate(self, in_spec: TensorsSpec) -> OctetSpec:
-        for ti in in_spec.tensors:
-            check_wire_dtype(ti.dtype)
-        self._rate = in_spec.rate
-        return OctetSpec(rate=in_spec.rate)
-
-    def decode(self, buf: TensorBuffer) -> TensorBuffer:
-        frame = encode_flexbuf(buf, rate=getattr(self, "_rate", None))
-        return buf.with_tensors((np.frombuffer(frame, np.uint8).copy(),))
-
-
-@register_converter("flexbuf")
-class FlexbufDecode(ConverterSubplugin):
-    """flexbuffers bytes → tensors (tensor_converter_flexbuf analog)."""
-
-    def negotiate(self, in_spec: MediaSpec) -> TensorsSpec:
-        return TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
-                           rate=in_spec.rate)
-
-    def convert(self, buf: TensorBuffer) -> TensorBuffer:
-        data = np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes()
-        out = decode_flexbuf(data)
-        if buf.pts is not None:
-            out = out.with_tensors(out.tensors, pts=buf.pts)
-        return out
+FlexbufEncode, FlexbufDecode = register_codec_pair(
+    "flexbuf", encode_flexbuf, decode_flexbuf)

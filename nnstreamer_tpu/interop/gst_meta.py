@@ -65,6 +65,34 @@ def shape_from_wire(dims) -> tuple:
     return tuple(reversed(ds))
 
 
+def payload_to_array(raw: bytes, dims, dtype: DType, fmt: TensorFormat,
+                     label: str):
+    """Decode one interop tensor payload → numpy array. Shared by all
+    three codecs; every corruption mode (bad header, size mismatch,
+    truncated buffer) surfaces as StreamError — the codec contract."""
+    import math
+
+    import numpy as np
+
+    try:
+        if fmt != TensorFormat.STATIC and len(raw) >= HEADER_SIZE:
+            shape, hdt, _, _, _, off = parse_gst_meta(raw)
+            return np.frombuffer(raw, hdt.np_dtype, offset=off,
+                                 count=math.prod(shape)
+                                 ).reshape(shape).copy()
+        shape = shape_from_wire(dims)
+        n = math.prod(shape) if shape else 1
+        if n * dtype.itemsize != len(raw):
+            raise StreamError(
+                f"{label}: {len(raw)} payload bytes != {n} elements of "
+                f"{dtype.type_name} from dims {list(dims)}")
+        return np.frombuffer(raw, dtype.np_dtype).reshape(shape).copy()
+    except StreamError:
+        raise
+    except (ValueError, TypeError) as e:   # truncated/corrupt buffers
+        raise StreamError(f"{label}: corrupt tensor payload: {e}") from None
+
+
 def pack_gst_meta(shape: Tuple[int, ...], dtype: DType,
                   fmt: TensorFormat = TensorFormat.FLEXIBLE,
                   media: int = 0, nnz: int = 0) -> bytes:

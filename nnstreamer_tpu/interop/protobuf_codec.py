@@ -26,26 +26,21 @@ Format semantics (from nnstreamer_protobuf.cc:60-200):
 
 from __future__ import annotations
 
-import math
 
 import numpy as np
 
 from nnstreamer_tpu.core.errors import StreamError
-from nnstreamer_tpu.elements.converter import ConverterSubplugin, register_converter
-from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
-from nnstreamer_tpu.graph.media import MediaSpec, OctetSpec
 from nnstreamer_tpu.interop import tensors_pb2 as pb
+from nnstreamer_tpu.interop._codec_base import register_codec_pair
 from nnstreamer_tpu.interop.gst_meta import (
-    HEADER_SIZE,
     check_wire_dtype,
     pack_gst_meta,
-    parse_gst_meta,
-    shape_from_wire,
+    payload_to_array,
     wire_dims,
 )
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.dtypes import DType
-from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+from nnstreamer_tpu.tensor.info import TensorFormat
 
 def buffer_to_msg(buf: TensorBuffer, rate=None) -> "pb.Tensors":
     """TensorBuffer → nnstreamer.protobuf.Tensors message."""
@@ -96,21 +91,8 @@ def msg_to_buffer(msg: "pb.Tensors") -> TensorBuffer:
     for i, entry in enumerate(msg.tensor):
         dt = DType(entry.type)
         raw = entry.data
-        if fmt != TensorFormat.STATIC and len(raw) >= HEADER_SIZE:
-            shape, hdt, _, _, _, off = parse_gst_meta(raw)
-            arr = np.frombuffer(raw, hdt.np_dtype, offset=off,
-                                count=math.prod(shape))
-            arr = arr.reshape(shape).copy()
-        else:
-            shape = shape_from_wire(entry.dimension)
-            n = math.prod(shape) if shape else 1
-            if n * dt.itemsize != len(raw):
-                raise StreamError(
-                    f"protobuf tensor #{i}: {len(raw)} payload bytes != "
-                    f"{n} elements of {dt.type_name} "
-                    f"({n * dt.itemsize} bytes) from dims {list(entry.dimension)}"
-                )
-            arr = np.frombuffer(raw, dt.np_dtype).reshape(shape).copy()
+        arr = payload_to_array(raw, entry.dimension, dt, fmt,
+                               f"protobuf tensor #{i}")
         arrays.append(arr)
         if entry.name:
             names[i] = entry.name
@@ -118,35 +100,5 @@ def msg_to_buffer(msg: "pb.Tensors") -> TensorBuffer:
     return TensorBuffer(tensors=tuple(arrays), format=fmt, meta=meta)
 
 
-@register_decoder("protobuf")
-class ProtobufEncode(DecoderSubplugin):
-    """tensors → protobuf frame bytes (tensordec-protobuf analog)."""
-
-    def negotiate(self, in_spec: TensorsSpec) -> OctetSpec:
-        for ti in in_spec.tensors:
-            check_wire_dtype(ti.dtype)
-        self._rate = in_spec.rate
-        return OctetSpec(rate=in_spec.rate)
-
-    def decode(self, buf: TensorBuffer) -> TensorBuffer:
-        frame = encode_protobuf(buf, rate=getattr(self, "_rate", None))
-        return buf.with_tensors((np.frombuffer(frame, np.uint8).copy(),))
-
-
-@register_converter("protobuf")
-class ProtobufDecode(ConverterSubplugin):
-    """protobuf frame bytes → tensors (tensor_converter_protobuf analog).
-
-    Output is FLEXIBLE: every frame is self-describing and shapes may
-    vary per buffer, exactly like the wire/flexbuf converters."""
-
-    def negotiate(self, in_spec: MediaSpec) -> TensorsSpec:
-        return TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
-                           rate=in_spec.rate)
-
-    def convert(self, buf: TensorBuffer) -> TensorBuffer:
-        data = np.ascontiguousarray(np.asarray(buf.tensors[0])).tobytes()
-        out = decode_protobuf(data)
-        if buf.pts is not None:
-            out = out.with_tensors(out.tensors, pts=buf.pts)
-        return out
+ProtobufEncode, ProtobufDecode = register_codec_pair(
+    "protobuf", encode_protobuf, decode_protobuf)

@@ -1,0 +1,205 @@
+"""Decoder-only transformer (zoo://transformer) — the long-context family.
+
+No reference counterpart (the reference is CNN-era inference plumbing;
+SURVEY.md §5.7 maps its closest analogs). This is the model family that
+exercises the framework's long-context machinery end-to-end:
+
+- **Streaming decode**: the KV cache is explicit state tensors, so
+  autoregressive generation runs as a *pipeline loop* — cache loops
+  through tensor_repo exactly like the LSTM's (h, c), one token per
+  frame (tests/test_streaming_models.py pattern).
+- **Sequence parallelism**: full-sequence forward (prefill/training)
+  attends via parallel/ring_attention.py when a mesh is given — the
+  sequence dim shards over `sp` and K/V blocks rotate over ICI.
+
+Architecture: pre-RMSNorm, rotary position embeddings, multi-head
+causal attention, SwiGLU MLP — the standard modern decoder block, all
+MXU-shaped matmuls in the caller's dtype (bf16 on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import layers as L
+from nnstreamer_tpu.models.zoo import register_model
+
+
+def rmsnorm(x, w, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w
+
+
+def rope(x, pos):
+    """Rotary embedding. x: (B, S, H, D); pos: (S,) absolute positions."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # (S, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def init_params(key=None, *, d_model=64, n_heads=4, n_layers=2, d_ff=None,
+                vocab=256, seed=0) -> Dict[str, Any]:
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    d_ff = d_ff or 4 * d_model
+    keys = jax.random.split(key, n_layers * 4 + 2)
+    blocks = []
+    for i in range(n_layers):
+        k0, k1, k2, k3 = keys[4 * i:4 * i + 4]
+        blocks.append({
+            "ln1": jnp.ones((d_model,), jnp.float32),
+            "wqkv": L.xavier_init(k0, (d_model, 3 * d_model)),
+            "wo": L.xavier_init(k1, (d_model, d_model)),
+            "ln2": jnp.ones((d_model,), jnp.float32),
+            "wi": L.xavier_init(k2, (d_model, 2 * d_ff)),   # SwiGLU gate+up
+            "wd": L.xavier_init(k3, (d_ff, d_model)),
+        })
+    return {
+        "embed": L.xavier_init(keys[-2], (vocab, d_model)),
+        "blocks": blocks,
+        "ln_f": jnp.ones((d_model,), jnp.float32),
+        "head": L.xavier_init(keys[-1], (d_model, vocab)),
+    }
+
+
+def _mlp(blk, x, dtype):
+    gate_up = x @ blk["wi"].astype(dtype)
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    return (jax.nn.silu(gate) * up) @ blk["wd"].astype(dtype)
+
+
+def _qkv(blk, x, n_heads, dtype):
+    b, s, d = x.shape
+    hd = d // n_heads
+    qkv = x @ blk["wqkv"].astype(dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shp = (b, s, n_heads, hd)
+    return q.reshape(shp), k.reshape(shp), v.reshape(shp)
+
+
+def apply_seq(params, ids, *, n_heads=4, dtype=jnp.float32,
+              mesh=None, sp_axis: str = "sp"):
+    """Full-sequence forward: (B, S) int32 → (B, S, vocab) logits.
+
+    With a mesh, attention runs ring-parallel over `sp_axis` (sequence
+    sharded, K/V rotating over ICI); without, a plain causal softmax.
+    """
+    from nnstreamer_tpu.parallel.ring_attention import (
+        reference_attention, ring_attention)
+
+    b, s = ids.shape
+    x = params["embed"][ids].astype(dtype)
+    pos = jnp.arange(s)
+    for blk in params["blocks"]:
+        h = rmsnorm(x, blk["ln1"].astype(dtype))
+        q, k, v = _qkv(blk, h, n_heads, dtype)
+        q, k = rope(q, pos), rope(k, pos)
+        if mesh is not None:
+            attn = ring_attention(q, k, v, mesh=mesh, axis=sp_axis,
+                                  causal=True)
+        else:
+            attn = reference_attention(q, k, v, causal=True)
+        attn = attn.reshape(b, s, -1)
+        x = x + attn @ blk["wo"].astype(dtype)
+        h = rmsnorm(x, blk["ln2"].astype(dtype))
+        x = x + _mlp(blk, h, dtype)
+    x = rmsnorm(x, params["ln_f"].astype(dtype))
+    return (x @ params["head"].astype(dtype)).astype(jnp.float32)
+
+
+def init_cache(*, batch=1, max_len=128, d_model=64, n_heads=4, n_layers=2):
+    """KV cache as TWO stacked tensors (pipeline-friendly state):
+    k/v: (L, B, max_len, H, D). Position rides a (1,) int32 tensor."""
+    hd = d_model // n_heads
+    shape = (n_layers, batch, max_len, n_heads, hd)
+    return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32),
+            jnp.zeros((1,), jnp.int32))
+
+
+def apply_step(params, ids, k_cache, v_cache, pos, *, n_heads=4,
+               dtype=jnp.float32):
+    """One streaming decode step: ids (B, 1) int32 + cache → logits
+    (B, vocab) + updated cache. Static shapes throughout: the cache is a
+    TRUE ring — writes land at pos % max_len, so past max_len tokens the
+    window slides (sliding-window attention over the last max_len
+    tokens; RoPE keys carry absolute positions, so relative geometry
+    stays correct across the wrap)."""
+    b = ids.shape[0]
+    max_len = k_cache.shape[2]
+    p = pos.astype(jnp.int32)[0]
+    slot = p % max_len
+    x = params["embed"][ids[:, 0]][:, None, :].astype(dtype)   # (B,1,D)
+    pvec = p[None]
+    new_k, new_v = [], []
+    for li, blk in enumerate(params["blocks"]):
+        h = rmsnorm(x, blk["ln1"].astype(dtype))
+        q, k, v = _qkv(blk, h, n_heads, dtype)
+        q, k = rope(q, pvec), rope(k, pvec)
+        kc = jax.lax.dynamic_update_slice(
+            k_cache[li], k.astype(jnp.float32), (0, slot, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            v_cache[li], v.astype(jnp.float32), (0, slot, 0, 0))
+        new_k.append(kc)
+        new_v.append(vc)
+        # attend over the populated window (all slots once wrapped)
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       kc) * scale                  # (B,H,1,max_len)
+        mask = (jnp.arange(max_len) <=
+                jnp.minimum(p, max_len - 1))[None, None, None, :]
+        s = jnp.where(mask, s, -1e30)
+        pattn = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", pattn, vc).astype(dtype)
+        x = x + attn.reshape(b, 1, -1) @ blk["wo"].astype(dtype)
+        h = rmsnorm(x, blk["ln2"].astype(dtype))
+        x = x + _mlp(blk, h, dtype)
+    x = rmsnorm(x, params["ln_f"].astype(dtype))
+    logits = (x[:, 0] @ params["head"].astype(dtype)).astype(jnp.float32)
+    return (logits, jnp.stack(new_k), jnp.stack(new_v),
+            (p + 1)[None].astype(jnp.int32))
+
+
+@register_model("transformer")
+def build(d_model: int = 64, n_heads: int = 4, n_layers: int = 2,
+          vocab: int = 256, max_len: int = 128, batch: int = 1,
+          dtype: str = "float32", seed: int = 0):
+    """Streaming-decode bundle: (ids, k_cache, v_cache, pos) →
+    (logits, k_cache, v_cache, pos) — state loops through tensor_repo."""
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    cdtype = jnp.dtype(dtype)
+    params = init_params(d_model=d_model, n_heads=n_heads,
+                         n_layers=n_layers, vocab=vocab, seed=seed)
+    hd = d_model // n_heads
+    cshape = (n_layers, batch, max_len, n_heads, hd)
+
+    def fn(params, ids, k_cache, v_cache, pos):
+        return apply_step(params, ids, k_cache, v_cache, pos,
+                          n_heads=n_heads, dtype=cdtype)
+
+    in_spec = TensorsSpec.of(
+        TensorInfo((batch, 1), DType.INT32, name="ids"),
+        TensorInfo(cshape, DType.FLOAT32, name="k_cache"),
+        TensorInfo(cshape, DType.FLOAT32, name="v_cache"),
+        TensorInfo((1,), DType.INT32, name="pos"),
+    )
+    out_spec = TensorsSpec.of(
+        TensorInfo((batch, vocab), DType.FLOAT32, name="logits"),
+        TensorInfo(cshape, DType.FLOAT32, name="k_cache"),
+        TensorInfo(cshape, DType.FLOAT32, name="v_cache"),
+        TensorInfo((1,), DType.INT32, name="pos"),
+    )
+    return ModelBundle(fn=fn, params=params, in_spec=in_spec,
+                       out_spec=out_spec, name="transformer")

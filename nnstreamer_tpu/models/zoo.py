@@ -75,7 +75,8 @@ def _load_builtins() -> None:
     # non-fatal so one broken model doesn't take down the zoo
     import importlib
 
-    for mod in ("mobilenet_v2", "ssd_mobilenet", "posenet", "lstm"):
+    for mod in ("mobilenet_v2", "ssd_mobilenet", "posenet", "lstm",
+                "transformer"):
         try:
             importlib.import_module(f"nnstreamer_tpu.models.{mod}")
         except ImportError:

@@ -411,3 +411,18 @@ def test_external_process_parses_our_flatbuf_frames():
     """, stdin=frame)
     np.testing.assert_array_equal(
         np.frombuffer(out, np.int16).reshape(5, 2), buf.tensors[0])
+
+
+@pytest.mark.parametrize("codec_name,enc,dec", [
+    ("protobuf", encode_protobuf, decode_protobuf),
+    ("flexbuf", encode_flexbuf, decode_flexbuf),
+    ("flatbuf", encode_flatbuf, decode_flatbuf)])
+def test_truncated_payload_raises_stream_error(codec_name, enc, dec):
+    """A frame whose data vector claims more bytes than present must
+    fail as StreamError (codec contract), not a raw numpy ValueError."""
+    buf = TensorBuffer.of(np.ones((1, 8, 8, 3), np.uint8),
+                          format=TensorFormat.FLEXIBLE)
+    frame = bytearray(enc(buf))
+    # chop the tail: header parses, payload short
+    with pytest.raises(StreamError):
+        dec(bytes(frame[:len(frame) // 2]))
