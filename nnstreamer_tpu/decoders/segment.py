@@ -89,3 +89,34 @@ class ImageSegment(DecoderSubplugin):
         clipped = np.clip(class_map, 0, len(self._lut) - 1)
         img = self._lut[clipped]
         return buf.with_tensors((img,)).with_meta(class_map=class_map)
+
+    # -- device decode (tensor_decoder device=true) ------------------------
+    def device_negotiate(self, in_spec: TensorsSpec) -> TensorsSpec:
+        if self.variant != "tflite-deeplab":
+            raise PipelineError(
+                f"image_segment device decode supports tflite-deeplab "
+                f"(scores→argmax); {self.variant!r} is already an index "
+                f"map, decode it on host")
+        self.negotiate(in_spec)
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo
+
+        t = in_spec.tensors[0]
+        if t.shape[-1] > 256:
+            raise PipelineError(
+                f"device decode emits a uint8 class map but the model has "
+                f"{t.shape[-1]} classes; use the host decoder (int32 map)")
+        h, w = (t.shape[1:3] if len(t.shape) == 4 else t.shape[:2])
+        return TensorsSpec.of(
+            TensorInfo((h, w), DType.UINT8, name="class_map"),
+            rate=in_spec.rate)
+
+    def device_decode(self, tensors, aux=None):
+        import jax.numpy as jnp
+
+        t = tensors[0]
+        if t.ndim == 4:
+            t = t[0]
+        # class count ≤ 255 by VOC-style palettes; uint8 map = 4× less
+        # D2H than the int32 host map, and the overlay LUT stays host-side
+        return (jnp.argmax(t, axis=-1).astype(jnp.uint8),)

@@ -379,3 +379,24 @@ class TestDeviceDecode:
         a = np.asarray(fused_pipe.get("out").results[0].tensors[0])
         b = np.asarray(plain_pipe.get("out").results[0].tensors[0])
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-3)
+
+    def test_segment_device_argmax_map(self):
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        scores = np.zeros((1, 4, 4, 3), np.float32)
+        scores[0, :2, :, 1] = 1.0
+        scores[0, 2:, :, 2] = 1.0
+        pipe = nns.parse_launch(
+            "appsrc name=in dims=3:4:4:1 types=float32 ! "
+            "tensor_decoder mode=image_segment device=true "
+            "option1=tflite-deeplab ! tensor_sink name=out")
+        runner = nns.PipelineRunner(pipe).start()
+        src = pipe.get("in")
+        src.push(TensorBuffer.of(scores))
+        src.end()
+        runner.wait(30)
+        runner.stop()
+        cm = np.asarray(pipe.get("out").results[0].tensors[0])
+        assert cm.shape == (4, 4) and cm.dtype == np.uint8
+        assert (cm[:2] == 1).all() and (cm[2:] == 2).all()
