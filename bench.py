@@ -166,7 +166,13 @@ def _build_label_device():
     src = AppSrc(spec=TensorsSpec.of(
         TensorInfo((1, 224, 224, 3), DType.UINT8)), name="src")
     if os.path.exists(MOBILENET_TFLITE):
-        stages = [src, TensorFilter(name="f", model=MOBILENET_TFLITE)]
+        from nnstreamer_tpu.elements.decoder import TensorDecoder
+
+        # full config-1 pipeline incl. the label decode — device=true
+        # argmax fuses into the filter program, so it stays D2H-free
+        stages = [src, TensorFilter(name="f", model=MOBILENET_TFLITE),
+                  TensorDecoder(name="d", mode="image_labeling",
+                                device=True)]
     else:
         norm = (TensorFilter(name="n", framework="pallas",
                              model="normalize_u8") if _on_tpu() else

@@ -66,6 +66,8 @@ class TensorTrainer(Element):
         "mesh": PropDef(str, "", "e.g. 'dp=4,tp=2'; empty = single device"),
         "checkpoint_dir": PropDef(str, ""),
         "checkpoint_every": PropDef(int, 100),
+        "resume_from": PropDef(str, "", "checkpoint path to restore at "
+                                        "start (full train state)"),
     }
 
     def __init__(self, name=None, **props):
@@ -128,6 +130,7 @@ class TensorTrainer(Element):
         from nnstreamer_tpu.parallel.train import make_train_step, shard_state
 
         state = init_state(params, opt)
+        self._mesh = mesh
         if mesh is not None:
             from jax.sharding import PartitionSpec as P
 
@@ -137,6 +140,8 @@ class TensorTrainer(Element):
         else:
             self._step_fn = make_train_step(self._loss_fn, opt)
         self._state = state
+        if self.props["resume_from"]:
+            self.restore_checkpoint(self.props["resume_from"])
         return [TensorsSpec.of(TensorInfo((1,), DType.FLOAT32),
                                rate=spec.rate)]
 
@@ -161,25 +166,64 @@ class TensorTrainer(Element):
 
     # -- checkpoint / resume (SURVEY.md §5.4 — exceeds reference parity) ---
     def save_checkpoint(self) -> None:
+        """FULL train state (params + optimizer moments + step), so a
+        resumed run continues the optimizer trajectory instead of
+        restarting Adam/momentum statistics from zero."""
+        import jax
         import orbax.checkpoint as ocp
 
         path = f"{self.props['checkpoint_dir']}/step_{self.steps}"
+        tree = {
+            "params": self._state.params,
+            "opt_state": self._state.opt_state,
+            "step": np.asarray(self._state.step),
+        }
         with ocp.StandardCheckpointer() as ckptr:
-            import jax
-
-            ckptr.save(path, jax.tree_util.tree_map(np.asarray,
-                                                    self._state.params))
+            ckptr.save(path, jax.tree_util.tree_map(np.asarray, tree))
         log.info("trainer %s: checkpoint at step %d → %s",
                  self.name, self.steps, path)
 
     def restore_checkpoint(self, path: str) -> None:
+        import jax
+        import jax.numpy as jnp
         import orbax.checkpoint as ocp
 
-        with ocp.StandardCheckpointer() as ckptr:
-            restored = ckptr.restore(path)
         from dataclasses import replace
 
-        self._state = replace(self._state, params=restored)
+        abstract = {
+            "params": self._state.params,
+            "opt_state": self._state.opt_state,
+            "step": np.asarray(self._state.step),
+        }
+        with ocp.StandardCheckpointer() as ckptr:
+            try:
+                restored = ckptr.restore(
+                    path, jax.tree_util.tree_map(np.asarray, abstract))
+            except Exception:
+                # legacy layout: params-only tree (pre-full-state saves).
+                # Optimizer moments restart from zero in that case.
+                restored = {
+                    "params": ckptr.restore(
+                        path, jax.tree_util.tree_map(np.asarray,
+                                                     self._state.params)),
+                    "opt_state": self._state.opt_state,
+                    "step": np.asarray(self.steps, np.int32),
+                }
+                log.warning(
+                    "trainer %s: %s is a legacy params-only checkpoint; "
+                    "optimizer state restarts fresh", self.name, path)
+        self._state = replace(self._state, params=restored["params"],
+                              opt_state=restored["opt_state"],
+                              step=jnp.asarray(restored["step"], jnp.int32))
+        if getattr(self, "_mesh", None) is not None:
+            # restore yields host numpy: re-place on the mesh or the
+            # sharded train step silently falls back to full replication
+            from nnstreamer_tpu.parallel.train import shard_state
+
+            self._state = shard_state(self._state, self._mesh)
+        self.steps = int(np.asarray(restored["step"]))
+        log.info("trainer %s: resumed from %s at step %d",
+                 self.name, path, self.steps)
 
     @property
     def params(self):

@@ -163,3 +163,93 @@ def test_cli_inspect_and_pipeline(tmp_path):
     assert out3.returncode == 0, out3.stderr
     stats = json.loads(out3.stdout)
     assert any(v["buffers"] == 2 for v in stats.values())
+
+
+def test_trainer_checkpoint_resume_full_state(tmp_path):
+    """Resume restores params AND optimizer moments AND step: continuing
+    from a checkpoint matches an uninterrupted run exactly."""
+    from nnstreamer_tpu.elements import AppSrc, TensorSink
+    from nnstreamer_tpu.trainer.element import TensorTrainer
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    rng = np.random.default_rng(0)
+    frames = [(rng.normal(size=(4, 16, 16, 3)).astype(np.float32),
+               (np.arange(4) % 8).astype(np.int32)) for _ in range(6)]
+
+    def run(trainer, batch):
+        src = AppSrc(spec=TensorsSpec.of(
+            TensorInfo((4, 16, 16, 3), DType.FLOAT32),
+            TensorInfo((4,), DType.INT32)), name="src")
+        sink = TensorSink(name="s")
+        pipe = nns.Pipeline()
+        for e in (src, trainer, sink):
+            pipe.add(e)
+        pipe.link(src, trainer)
+        pipe.link(trainer, sink)
+        runner = nns.PipelineRunner(pipe).start()
+        for x, y in batch:
+            src.push(TensorBuffer.of(x, y))
+        src.end()
+        runner.wait(120)
+        return [float(r.tensors[0][0]) for r in sink.results]
+
+    model = "zoo://mobilenet_v2?width=0.35&num_classes=8"
+    opt = "adam:0.01"   # adam: moments matter for exactness
+    # uninterrupted 6-step run
+    losses_full = run(TensorTrainer(name="t0", model=model, optimizer=opt),
+                      frames)
+    # 3 steps + checkpoint
+    t1 = TensorTrainer(name="t1", model=model, optimizer=opt,
+                       checkpoint_dir=str(tmp_path), checkpoint_every=3)
+    losses_a = run(t1, frames[:3])
+    # resume and finish
+    t2 = TensorTrainer(name="t2", model=model, optimizer=opt,
+                       resume_from=str(tmp_path / "step_3"))
+    losses_b = run(t2, frames[3:])
+    assert t2.steps == 6
+    np.testing.assert_allclose(losses_a + losses_b, losses_full,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_resume_on_mesh_keeps_sharding(eight_cpu_devices, tmp_path):
+    """Resume under mesh= re-places the restored state: params must come
+    back tp-sharded, not silently replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from nnstreamer_tpu.elements import AppSrc, TensorSink
+    from nnstreamer_tpu.trainer.element import TensorTrainer
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    def run(trainer, n):
+        src = AppSrc(spec=TensorsSpec.of(
+            TensorInfo((8, 16, 16, 3), DType.FLOAT32),
+            TensorInfo((8,), DType.INT32)), name="src")
+        sink = TensorSink(name="s")
+        pipe = nns.Pipeline()
+        for e in (src, trainer, sink):
+            pipe.add(e)
+        pipe.link(src, trainer)
+        pipe.link(trainer, sink)
+        runner = nns.PipelineRunner(pipe).start()
+        rng = np.random.default_rng(7)
+        for _ in range(n):
+            src.push(TensorBuffer.of(
+                rng.normal(size=(8, 16, 16, 3)).astype(np.float32),
+                (np.arange(8) % 8).astype(np.int32)))
+        src.end()
+        runner.wait(180)
+
+    model = "zoo://mobilenet_v2?width=0.35&num_classes=8"
+    t1 = TensorTrainer(name="t1", model=model, mesh="dp=4,tp=2",
+                       checkpoint_dir=str(tmp_path), checkpoint_every=1)
+    run(t1, 1)
+    t2 = TensorTrainer(name="t2", model=model, mesh="dp=4,tp=2",
+                       resume_from=str(tmp_path / "step_1"))
+    run(t2, 1)
+    assert t2.steps == 2
+    w = t2.params["stem"]["conv"]["w"]
+    assert w.sharding.spec == P(None, None, None, "tp")
