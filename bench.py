@@ -345,6 +345,105 @@ def _build_composite():
     return pipe, src, sink, (x, x.copy())
 
 
+def offload_bench(n_frames=None, n_lat=None):
+    """BASELINE row 5: edge offload. A client pipeline ships frames to a
+    loopback query server running MobileNet, results route back per
+    client id. Open-loop FPS + closed-loop p50/p99 like the other
+    configs; the per-frame wire encode/decode makes this an honest
+    host-path measurement (the reference's tensor_query shape)."""
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.edge import QueryServer
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    on_tpu = _on_tpu()
+    if n_frames is None:
+        n_frames = 48 if on_tpu else 6
+    if n_lat is None:
+        n_lat = 24 if on_tpu else 3
+    QueryServer.reset_all()
+    server = nns.parse_launch(
+        "tensor_query_serversrc name=ssrc id=9 dims=3:224:224:1 "
+        "types=uint8 port=0 ! "
+        "tensor_transform mode=arithmetic option=" + NORMALIZE_OPT + " ! "
+        "tensor_filter model=zoo://mobilenet_v2 ! "
+        "tensor_query_serversink id=9")
+    srunner = nns.PipelineRunner(server).start()
+    port = server.get("ssrc").port
+    frame = np.random.default_rng(0).integers(0, 256, (1, 224, 224, 3),
+                                              np.uint8)
+
+    def wait(runner, sink, target, timeout=600.0):
+        t0 = time.perf_counter()
+        while len(sink.results) < target:
+            for rn in (runner, srunner):
+                if rn._error is not None:
+                    raise RuntimeError(
+                        f"offload pipeline failed: {rn._error}"
+                    ) from rn._error
+            if time.perf_counter() - t0 > timeout:
+                raise RuntimeError(
+                    f"offload stalled at {len(sink.results)}/{target}")
+            time.sleep(0.002)
+
+    r1 = r2 = None
+    try:
+        # open-loop throughput with a PIPELINED client (max_in_flight=8:
+        # network+server latency overlaps across frames — the batched-
+        # dispatch upgrade over the reference's per-frame sync). Replies
+        # drain on later process() calls and at EOS flush, so all frames
+        # are pushed up front and the post-warmup segment is timed.
+        warm = 4
+        c1 = nns.parse_launch(
+            f"appsrc name=src dims=3:224:224:1 types=uint8 ! "
+            f"tensor_query_client port={port} timeout=120 "
+            f"max_in_flight=8 ! tensor_sink name=sink")
+        r1 = nns.PipelineRunner(c1).start()
+        src, sink = c1.get("src"), c1.get("sink")
+        for i in range(warm + n_frames):
+            src.push(TensorBuffer.of(frame, pts=i))
+        src.end()
+        wait(r1, sink, warm)             # compile + ramp complete
+        t0 = time.perf_counter()
+        wait(r1, sink, warm + n_frames)
+        fps = n_frames / (time.perf_counter() - t0)
+        r1.wait(60)
+        r1.stop()
+
+        # closed-loop latency with the reference-semantics client
+        # (max_in_flight=1: push -> block for the reply)
+        c2 = nns.parse_launch(
+            f"appsrc name=src dims=3:224:224:1 types=uint8 ! "
+            f"tensor_query_client port={port} timeout=120 ! "
+            f"tensor_sink name=sink")
+        r2 = nns.PipelineRunner(c2).start()
+        src2, sink2 = c2.get("src"), c2.get("sink")
+        lats = []
+        for i in range(n_lat):
+            t = time.perf_counter()
+            src2.push(TensorBuffer.of(frame, pts=i))
+            wait(r2, sink2, i + 1)
+            lats.append((time.perf_counter() - t) * 1e3)
+        lats.sort()
+        src2.end()
+        r2.wait(60)
+        r2.stop()
+        return {"fps": round(fps, 2),
+                "p50_ms": round(_percentile(lats, 50), 3),
+                "p99_ms": round(_percentile(lats, 99), 3)}
+    finally:
+        for rn in (r1, r2):      # dead clients must not keep threads
+            if rn is not None:   # blocked on 120s reply timeouts
+                try:
+                    rn.stop()
+                except Exception:
+                    pass
+        server.get("ssrc").interrupt()
+        srunner.stop()
+        QueryServer.reset_all()
+
+
 # -- batch sweep + MFU -------------------------------------------------------
 
 def batch_sweep(batches=None, n=None):
@@ -481,6 +580,11 @@ def main() -> int:
             results[name] = _Bench(build).run(**kw)
         except Exception as e:
             errors[name] = f"{type(e).__name__}: {e}"
+    # BASELINE row 5: edge offload over the loopback query server
+    try:
+        results["offload"] = offload_bench()
+    except Exception as e:
+        errors["offload"] = f"{type(e).__name__}: {e}"
 
     headline = results.get("label_device", {}).get("fps", 0.0)
     out = {

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import queue as _queue
 import threading
+from collections import deque
 
 from typing import Dict, Iterator, List, Optional, Sequence
 
@@ -269,13 +270,23 @@ class TensorQueryClient(Element):
         # data pattern, tensor_query_common.c:39)
         "connect_type": PropDef(str, "tcp", "tcp | hybrid"),
         "topic": PropDef(str, "", "service name (hybrid)"),
+        # >1 pipelines the offload: up to N frames in flight before
+        # blocking, overlapping network+server latency across frames
+        # (the reference blocks per frame, tensor_query_client.c:699 —
+        # exactly the per-frame sync the TPU design avoids). Ordering is
+        # preserved: one TCP connection, FIFO server pipeline.
+        "max_in_flight": PropDef(int, 1, "1 = reference per-frame sync"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
+        if self.props["max_in_flight"] < 1:
+            raise PipelineError(
+                f"{self.name}: max_in_flight must be >= 1")
         self._client: Optional[P.MsgClient] = None
         self._replies: _queue.Queue = _queue.Queue()
         self._hello: _queue.Queue = _queue.Queue()
+        self._pending: "deque" = deque()   # pts of sent-but-unanswered
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         spec = self.expect_tensors(in_specs[0])
@@ -338,18 +349,50 @@ class TensorQueryClient(Element):
         elif mtype == P.T_RESULT:
             self._replies.put(payload)
 
-    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
-        self._client.send(P.T_DATA, encode_buffer(buf))
+    def _take_reply(self) -> Emission:
+        """Pop the oldest in-flight frame's reply (blocking, timeout)."""
         try:
             payload = self._replies.get(timeout=self.props["timeout"])
         except _queue.Empty:
             raise StreamError(
                 f"tensor_query_client {self.name}: no reply for frame "
-                f"pts={buf.pts} within {self.props['timeout']}s "
+                f"pts={self._pending[0]} within {self.props['timeout']}s "
                 f"(server overloaded or connection lost)") from None
+        pts = self._pending.popleft()
         out, _ = decode_buffer(payload)
         out.meta.pop("client_id", None)
-        return [(0, out.with_tensors(out.tensors, pts=buf.pts))]
+        # integrity check for the pipelined window: the reply echoes the
+        # request's pts on the wire, so a server-side frame drop cannot
+        # silently shift every later reply onto the wrong frame
+        if out.pts is not None and pts is not None and out.pts != pts:
+            raise StreamError(
+                f"tensor_query_client {self.name}: reply stream out of "
+                f"sync — expected pts={pts}, server answered pts="
+                f"{out.pts}. A frame was dropped server-side; lower "
+                f"max_in_flight or fix the server pipeline")
+        return (0, out.with_tensors(out.tensors, pts=pts))
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        self._client.send(P.T_DATA, encode_buffer(buf))
+        self._pending.append(buf.pts)
+        emissions: List[Emission] = []
+        # opportunistically drain replies that already arrived, then
+        # block only when the in-flight window is full
+        while self._pending:
+            if not self._replies.empty():
+                emissions.append(self._take_reply())
+            elif len(self._pending) >= self.props["max_in_flight"]:
+                emissions.append(self._take_reply())
+            else:
+                break
+        return emissions
+
+    def flush(self) -> List[Emission]:
+        """EOS: drain every in-flight frame so nothing is dropped."""
+        emissions: List[Emission] = []
+        while self._pending:
+            emissions.append(self._take_reply())
+        return emissions
 
     def stop(self) -> None:
         if self._client is not None:

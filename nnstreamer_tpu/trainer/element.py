@@ -190,22 +190,35 @@ class TensorTrainer(Element):
 
         from dataclasses import replace
 
-        abstract = {
+        import os
+
+        if not os.path.isdir(path):
+            raise PipelineError(
+                f"trainer {self.name}: resume checkpoint {path!r} does "
+                f"not exist")
+
+        def abstract(tree):
+            # shapes/dtypes only — never a D2H copy of the live state
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    getattr(a, "shape", ()),
+                    getattr(a, "dtype", np.dtype(np.int32))), tree)
+
+        full = {
             "params": self._state.params,
             "opt_state": self._state.opt_state,
             "step": np.asarray(self._state.step),
         }
         with ocp.StandardCheckpointer() as ckptr:
             try:
-                restored = ckptr.restore(
-                    path, jax.tree_util.tree_map(np.asarray, abstract))
-            except Exception:
-                # legacy layout: params-only tree (pre-full-state saves).
-                # Optimizer moments restart from zero in that case.
+                restored = ckptr.restore(path, abstract(full))
+            except (ValueError, KeyError):
+                # structure mismatch ⇒ legacy params-only layout
+                # (pre-full-state saves); moments restart from zero.
+                # Real I/O errors propagate above untouched.
                 restored = {
                     "params": ckptr.restore(
-                        path, jax.tree_util.tree_map(np.asarray,
-                                                     self._state.params)),
+                        path, abstract(self._state.params)),
                     "opt_state": self._state.opt_state,
                     "step": np.asarray(self.steps, np.int32),
                 }

@@ -191,3 +191,35 @@ def test_edge_pubsub_stream_bridging():
     srunner.wait(30)  # …which is the subscriber's EOS
     vals = [float(r.tensors[0][0, 0]) for r in sink.results]
     assert vals == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_query_client_pipelined_in_flight_preserves_order():
+    """max_in_flight>1 overlaps requests; results keep frame order and
+    EOS flush drains every in-flight frame."""
+    register_custom_easy("pipelined_inc", lambda t: (t[0] + 1,))
+    server = nns.parse_launch(
+        "tensor_query_serversrc name=ssrc id=31 dims=4 types=float32 "
+        "port=0 ! tensor_filter framework=custom model=pipelined_inc ! "
+        "tensor_query_serversink id=31")
+    srunner = nns.PipelineRunner(server).start()
+    port = server.get("ssrc").port
+    client = nns.parse_launch(
+        f"appsrc name=src dims=4 types=float32 ! "
+        f"tensor_query_client port={port} max_in_flight=4 ! "
+        f"tensor_sink name=sink")
+    crunner = nns.PipelineRunner(client).start()
+    src = client.get("src")
+    n = 11   # not a multiple of the window: tail drains via flush
+    for i in range(n):
+        src.push(TensorBuffer.of(np.full((4,), i, np.float32), pts=i * 10))
+    src.end()
+    crunner.wait(60)
+    crunner.stop()
+    server.get("ssrc").interrupt()
+    srunner.stop()
+    res = client.get("sink").results
+    assert len(res) == n
+    for i, r in enumerate(res):
+        assert r.pts == i * 10                       # order preserved
+        np.testing.assert_array_equal(r.tensors[0],
+                                      np.full((4,), i + 1, np.float32))
