@@ -1,14 +1,21 @@
-"""Benchmark — all four BASELINE.md configs on the real chip.
+"""Benchmark — all five BASELINE.md configs on the real chip.
 
 Configs (reference pipeline shapes, BASELINE.md table):
   1. label     — MobileNetV2 224² image labeling. Real quantized weights
                  (reference's own .tflite via modelio) when available;
                  ingest normalize runs as a **compiled Pallas kernel** on
                  TPU (Orc-SIMD analog, gsttensor_transform.c:463-493).
-  2. ssd       — SSD-MobileNet 300² + bounding_boxes decoder (NMS).
-  3. posenet   — PoseNet 257² + pose_estimation decoder.
+                 `label_device` = same pipeline with device=true decode
+                 fused into the filter program (D2H-free headline).
+  2. ssd       — SSD-MobileNet 300² + bounding_boxes decoder (NMS);
+                 `ssd_device` decodes on-chip (fused top-K + greedy NMS).
+  3. posenet   — PoseNet 257² + pose_estimation decoder; `posenet_device`
+                 decodes heatmaps on-chip.
   4. composite — 2-tensor demux → 2× tensor_filter (shared device model)
                  → mux, aggregate FPS.
+  5. offload   — loopback tensor_query client/server; open-loop FPS with
+                 a pipelined client (max_in_flight=8), closed-loop
+                 p50/p99 with the reference per-frame-sync client.
 
 Per config: steady-state FPS/chip (open-loop, pipelined) and p50/p99
 end-to-end latency (closed-loop, per-frame push→sink). Config 1 adds a
@@ -374,7 +381,7 @@ def offload_bench(n_frames=None, n_lat=None):
     frame = np.random.default_rng(0).integers(0, 256, (1, 224, 224, 3),
                                               np.uint8)
 
-    def wait(runner, sink, target, timeout=600.0):
+    def wait(runner, sink, target, timeout=600.0, poll=0.002):
         t0 = time.perf_counter()
         while len(sink.results) < target:
             for rn in (runner, srunner):
@@ -385,7 +392,7 @@ def offload_bench(n_frames=None, n_lat=None):
             if time.perf_counter() - t0 > timeout:
                 raise RuntimeError(
                     f"offload stalled at {len(sink.results)}/{target}")
-            time.sleep(0.002)
+            time.sleep(poll)
 
     r1 = r2 = None
     try:
@@ -423,7 +430,7 @@ def offload_bench(n_frames=None, n_lat=None):
         for i in range(n_lat):
             t = time.perf_counter()
             src2.push(TensorBuffer.of(frame, pts=i))
-            wait(r2, sink2, i + 1)
+            wait(r2, sink2, i + 1, poll=0.0005)  # latency-grade poll
             lats.append((time.perf_counter() - t) * 1e3)
         lats.sort()
         src2.end()

@@ -34,6 +34,8 @@ from nnstreamer_tpu.tensor.info import TensorsSpec
 
 log = get_logger("edge.query")
 
+_STOPPED = object()   # sentinel unblocking _take_reply at teardown
+
 
 class QueryServer:
     """Shared state of one query server id: the transport + the in/out
@@ -358,6 +360,10 @@ class TensorQueryClient(Element):
                 f"tensor_query_client {self.name}: no reply for frame "
                 f"pts={self._pending[0]} within {self.props['timeout']}s "
                 f"(server overloaded or connection lost)") from None
+        if payload is _STOPPED:
+            raise StreamError(
+                f"tensor_query_client {self.name}: stopped with "
+                f"{len(self._pending)} frame(s) still in flight")
         pts = self._pending.popleft()
         out, _ = decode_buffer(payload)
         out.meta.pop("client_id", None)
@@ -397,3 +403,6 @@ class TensorQueryClient(Element):
     def stop(self) -> None:
         if self._client is not None:
             self._client.close()
+        # unblock a thread parked in _take_reply: teardown must not wait
+        # out the full reply timeout for frames that will never answer
+        self._replies.put(_STOPPED)

@@ -253,3 +253,43 @@ def test_trainer_resume_on_mesh_keeps_sharding(eight_cpu_devices, tmp_path):
     assert t2.steps == 2
     w = t2.params["stem"]["conv"]["w"]
     assert w.sharding.spec == P(None, None, None, "tp")
+
+
+def test_new_plugin_scaffolds_are_runnable(tmp_path):
+    """tools/new_plugin.py output registers and runs in a pipeline."""
+    import subprocess
+    import sys
+
+    for kind, name in (("decoder", "gen_dec"), ("converter", "gen_conv"),
+                       ("filter", "gen_fil"), ("element", "gen_elem")):
+        out = subprocess.run(
+            [sys.executable, "tools/new_plugin.py", kind, name,
+             str(tmp_path)], capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import gen_conv_converter  # noqa: F401 (registers converter)
+        import gen_dec_decoder   # noqa: F401  (registers decoder)
+        import gen_elem_element  # noqa: F401 (registers element)
+        import gen_fil_filter   # noqa: F401  (registers custom model)
+
+        from nnstreamer_tpu.core.registry import PluginKind, registry
+
+        assert "gen_dec" in registry.names(PluginKind.DECODER)
+        assert "gen_conv" in registry.names(PluginKind.CONVERTER)
+
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        pipe = nns.parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter framework=custom model=gen_fil ! "
+            "gen_elem ! tensor_sink name=s")
+        runner = nns.PipelineRunner(pipe).start()
+        src = pipe.get("src")
+        src.push(TensorBuffer.of(np.arange(4, dtype=np.float32)))
+        src.end()
+        runner.wait(30)
+        runner.stop()
+        assert len(pipe.get("s").results) == 1
+    finally:
+        sys.path.remove(str(tmp_path))

@@ -1,0 +1,110 @@
+"""Soak + fault-injection: stream dynamics the SSAT suites catch
+(SURVEY.md §4 negative tests, §5.3 failure detection)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.backends.custom import register_custom_easy
+from nnstreamer_tpu.edge import QueryServer
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+
+@pytest.fixture(autouse=True)
+def _clean_servers():
+    yield
+    QueryServer.reset_all()
+
+
+def test_soak_thousand_frames_mux_filter_demux():
+    """1000 frames through a mux → filter → demux graph: no stall, no
+    drop, order preserved, bounded queues hold."""
+    register_custom_easy("soak_add", lambda t: (t[0] + t[1],))
+    pipe = nns.parse_launch(
+        "appsrc name=a dims=8 types=float32 ! mux.sink_0 "
+        "appsrc name=b dims=8 types=float32 ! mux.sink_1 "
+        "tensor_mux name=mux sync-mode=nosync ! "
+        "tensor_filter framework=custom model=soak_add ! "
+        "tensor_sink name=s")
+    runner = nns.PipelineRunner(pipe, queue_capacity=4).start()
+    n = 1000
+    a, b = pipe.get("a"), pipe.get("b")
+
+    def feed(src, base):
+        for i in range(n):
+            src.push(TensorBuffer.of(
+                np.full((8,), base + i, np.float32), pts=i))
+        src.end()
+
+    ta = threading.Thread(target=feed, args=(a, 0.0), daemon=True)
+    tb = threading.Thread(target=feed, args=(b, 1000.0), daemon=True)
+    ta.start()
+    tb.start()
+    runner.wait(300)
+    runner.stop()
+    res = pipe.get("s").results
+    assert len(res) == n
+    for i in (0, n // 2, n - 1):    # spot-check order + values
+        assert res[i].pts == i
+        np.testing.assert_array_equal(
+            res[i].tensors[0], np.full((8,), 1000.0 + 2 * i, np.float32))
+
+
+def test_filter_invoke_failure_stops_pipeline_with_cause():
+    """A model that raises mid-stream fails the pipeline loudly (fail-
+    loud scheduler, §5.3) with the original cause in the error."""
+    calls = {"n": 0}
+
+    def flaky(t):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("injected fault at frame 3")
+        return (t[0],)
+
+    register_custom_easy("soak_flaky", flaky)
+    pipe = nns.parse_launch(
+        "appsrc name=src dims=4 types=float32 ! "
+        "tensor_filter framework=custom model=soak_flaky ! "
+        "tensor_sink name=s")
+    runner = nns.PipelineRunner(pipe).start()
+    src = pipe.get("src")
+    for i in range(5):
+        src.push(TensorBuffer.of(np.zeros((4,), np.float32), pts=i))
+    src.end()
+    with pytest.raises(Exception, match="injected fault"):
+        runner.wait(60)
+    runner.stop()
+
+
+def test_query_server_death_fails_client_cleanly():
+    """Killing the server mid-stream surfaces a StreamError at the
+    client instead of hanging (edge failure detection)."""
+    register_custom_easy("soak_echo", lambda t: (t[0],))
+    server = nns.parse_launch(
+        "tensor_query_serversrc name=ssrc id=41 dims=4 types=float32 "
+        "port=0 ! tensor_filter framework=custom model=soak_echo ! "
+        "tensor_query_serversink id=41")
+    srunner = nns.PipelineRunner(server).start()
+    port = server.get("ssrc").port
+    client = nns.parse_launch(
+        f"appsrc name=src dims=4 types=float32 ! "
+        f"tensor_query_client port={port} timeout=3 ! "
+        f"tensor_sink name=s")
+    crunner = nns.PipelineRunner(client).start()
+    src = client.get("src")
+    src.push(TensorBuffer.of(np.ones((4,), np.float32), pts=0))
+    deadline = time.time() + 30
+    while not client.get("s").results and time.time() < deadline:
+        time.sleep(0.02)
+    assert client.get("s").results, "first frame should round-trip"
+    # kill the server, then push: the client must fail within timeout
+    server.get("ssrc").interrupt()
+    srunner.stop()
+    src.push(TensorBuffer.of(np.ones((4,), np.float32), pts=1))
+    src.end()
+    with pytest.raises(Exception, match="no reply|closed|failed"):
+        crunner.wait(60)
+    crunner.stop()

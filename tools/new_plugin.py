@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Plugin scaffolding generator — dev-tooling parity with the reference's
+tools/development/nnstreamerCodeGenCustomFilter.py, re-aimed at this
+framework's in-process registration model.
+
+Usage:
+    python tools/new_plugin.py decoder my_mode [outdir]
+    python tools/new_plugin.py converter my_format [outdir]
+    python tools/new_plugin.py filter my_model [outdir]
+    python tools/new_plugin.py element my_element [outdir]
+
+Emits a runnable skeleton that registers itself on import; drop the file
+on the pipeline's python path (or a `plugin_paths` dir from the config)
+and reference it from a launch line.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+DECODER = '''"""tensor_decoder mode={name} — generated skeleton."""
+
+from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
+from nnstreamer_tpu.graph.media import OctetSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorsSpec
+
+
+@register_decoder("{name}")
+class {cls}(DecoderSubplugin):
+    def init(self, props: dict) -> None:
+        self.option1 = props.get("option1", "")
+
+    def negotiate(self, in_spec: TensorsSpec):
+        # validate the tensor input; declare the output stream type
+        return OctetSpec(rate=in_spec.rate)
+
+    def decode(self, buf: TensorBuffer) -> TensorBuffer:
+        # tensors → media payload
+        return buf
+'''
+
+CONVERTER = '''"""tensor_converter mode=custom:{name} — generated skeleton."""
+
+from nnstreamer_tpu.elements.converter import ConverterSubplugin, register_converter
+from nnstreamer_tpu.graph.media import MediaSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+
+
+@register_converter("{name}")
+class {cls}(ConverterSubplugin):
+    def negotiate(self, in_spec: MediaSpec) -> TensorsSpec:
+        # declare the tensor stream produced from the media input
+        return TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
+                           rate=in_spec.rate)
+
+    def convert(self, buf: TensorBuffer) -> TensorBuffer:
+        # media payload → tensors
+        return buf
+'''
+
+FILTER = '''"""tensor_filter framework=custom model={name} — generated skeleton."""
+
+from nnstreamer_tpu.backends.custom import register_custom_easy
+
+
+def {name}(tensors):
+    """tuple of arrays in → tuple of arrays out (jnp ops run on TPU)."""
+    return tensors
+
+
+register_custom_easy("{name}", {name})
+'''
+
+ELEMENT = '''"""{name} pipeline element — generated skeleton."""
+
+from typing import List, Sequence
+
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.pipeline import (
+    Element, Emission, PropDef, StreamSpec)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+
+@register_element("{name}")
+class {cls}(Element):
+    ELEMENT_NAME = "{name}"
+    PROPS = {{
+        "option": PropDef(str, "", "example property"),
+    }}
+
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        # validate input specs; declare one output spec per src pad
+        return [in_specs[0]]
+
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        # transform/route the buffer; return (src_pad, buffer) emissions
+        return [(0, buf)]
+'''
+
+KINDS = {"decoder": DECODER, "converter": CONVERTER, "filter": FILTER,
+         "element": ELEMENT}
+
+
+def main(argv) -> int:
+    if len(argv) < 2 or argv[0] not in KINDS:
+        print(__doc__)
+        return 2
+    kind, name = argv[0], argv[1]
+    import keyword
+
+    if not name.isidentifier() or keyword.iskeyword(name):
+        print(f"plugin name {name!r} must be a valid non-keyword "
+              f"identifier")
+        return 2
+    outdir = Path(argv[2]) if len(argv) > 2 else Path(".")
+    cls = "".join(w.capitalize() for w in name.split("_"))
+    path = outdir / f"{name}_{kind}.py"
+    if path.exists():
+        print(f"{path} already exists; not overwriting")
+        return 1
+    path.write_text(KINDS[kind].format(name=name, cls=cls))
+    print(f"wrote {path} — import it (or add its dir to plugin_paths) "
+          f"to register {kind} {name!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
