@@ -86,3 +86,94 @@ def sparse_to_dense(values, flat_indices, shape: Tuple[int, ...]):
     dense = jnp.zeros((n,), values.dtype)
     dense = dense.at[flat_indices].set(values)
     return dense.reshape(shape)
+
+
+# -- flash attention ---------------------------------------------------------
+
+def _flash_kernel(scale: float, causal: bool, bq: int, bk: int,
+                  q_ref, k_ref, v_ref, o_ref):
+    """One (batch·head, q-block) program: online-softmax over K/V blocks.
+
+    K/V for this head live fully in VMEM (BlockSpec maps the whole
+    sequence); the inner fori_loop streams them block-by-block through
+    the MXU with flash-attention running max/normalizer accumulators, so
+    the (S × S) score matrix never materializes.
+    """
+    q = q_ref[0]                              # (bq, D), input dtype
+
+    s_total = k_ref.shape[1]
+    qi = pl.program_id(1)
+    n_kb = s_total // bk
+
+    def body(j, carry):
+        m, l, acc = carry
+        # inputs stay in their (bf16) dtype into the MXU; accumulation
+        # is f32 via preferred_element_type — the standard flash recipe
+        k_blk = k_ref[0, pl.ds(j * bk, bk), :]
+        v_blk = v_ref[0, pl.ds(j * bk, bk), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (bq, bk) f32
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, -1e30)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= -1e29, 0.0, p)     # fully-masked rows stay 0
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    d = q.shape[-1]
+    m0 = jnp.full((bq,), -1e30, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    # causal: K blocks entirely above the diagonal are fully masked —
+    # skip them instead of burning MXU cycles on zeroed scores (halves
+    # the causal FLOPs, the case the transformer always runs)
+    upper = pl.cdiv((qi + 1) * bq, bk) if causal else n_kb
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, a0))
+    l = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused attention for (B, S, H, D) tensors — the transformer hot op
+    as a Pallas kernel (flash-attention online softmax; S×S scores never
+    leave VMEM). Requires S % block sizes == 0 (pad upstream); falls back
+    to interpret mode off-TPU like every kernel here."""
+    b, s, h, d = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    if s % bq or s % bk:
+        raise ValueError(
+            f"flash_attention needs seq len {s} divisible by block sizes "
+            f"({bq}, {bk}); pad the sequence upstream")
+    scale = d ** -0.5
+
+    def bhsd(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qf, kf, vf = bhsd(q), bhsd(k), bhsd(v)
+    kern = functools.partial(_flash_kernel, scale, causal, bq, bk)
+    out = pl.pallas_call(
+        kern,
+        grid=(b * h, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

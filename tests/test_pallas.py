@@ -1,5 +1,6 @@
 """Pallas kernel + backend tests (interpret mode on the CPU mesh)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -96,3 +97,43 @@ def test_pallas_backend_unknown_kernel_actionable_error():
     pipe.link(f, sink)
     with pytest.raises(Exception, match="register_pallas_filter"):
         pipe.negotiate()
+
+
+class TestFlashAttention:
+    def _qkv(self, B=2, S=64, H=2, D=16, seed=0):
+        import jax
+
+        key = jax.random.PRNGKey(seed)
+        return tuple(jax.random.normal(kk, (B, S, H, D), jnp.float32)
+                     for kk in jax.random.split(key, 3))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        from nnstreamer_tpu.backends.pallas_ops import flash_attention
+        from nnstreamer_tpu.parallel.ring_attention import reference_attention
+
+        q, k, v = self._qkv()
+        got = flash_attention(q, k, v, causal=causal,
+                              block_q=32, block_k=32)
+        want = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_uneven_blocks_rejected(self):
+        from nnstreamer_tpu.backends.pallas_ops import flash_attention
+
+        q, k, v = self._qkv(S=48)
+        with pytest.raises(ValueError, match="divisible"):
+            flash_attention(q, k, v, block_q=32, block_k=32)
+
+    def test_transformer_pallas_attn_matches_xla(self):
+        import jax
+
+        from nnstreamer_tpu.models import transformer as T
+
+        params = T.init_params(d_model=32, n_heads=2, n_layers=2, vocab=64)
+        ids = jax.numpy.asarray(
+            np.random.default_rng(0).integers(0, 64, (1, 128)), jnp.int32)
+        want = np.asarray(T.apply_seq(params, ids, n_heads=2, attn="xla"))
+        got = np.asarray(T.apply_seq(params, ids, n_heads=2, attn="pallas"))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

@@ -258,13 +258,16 @@ def test_trainer_resume_on_mesh_keeps_sharding(eight_cpu_devices, tmp_path):
 def test_new_plugin_scaffolds_are_runnable(tmp_path):
     """tools/new_plugin.py output registers and runs in a pipeline."""
     import subprocess
+    from pathlib import Path
     import sys
 
     for kind, name in (("decoder", "gen_dec"), ("converter", "gen_conv"),
                        ("filter", "gen_fil"), ("element", "gen_elem")):
+        tool = str(Path(__file__).resolve().parents[1] / "tools"
+                   / "new_plugin.py")
         out = subprocess.run(
-            [sys.executable, "tools/new_plugin.py", kind, name,
-             str(tmp_path)], capture_output=True, text=True, timeout=60)
+            [sys.executable, tool, kind, name, str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
         assert out.returncode == 0, out.stderr
     sys.path.insert(0, str(tmp_path))
     try:

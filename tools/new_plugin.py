@@ -116,6 +116,7 @@ def main(argv) -> int:
               f"identifier")
         return 2
     outdir = Path(argv[2]) if len(argv) > 2 else Path(".")
+    outdir.mkdir(parents=True, exist_ok=True)
     cls = "".join(w.capitalize() for w in name.split("_"))
     path = outdir / f"{name}_{kind}.py"
     if path.exists():
