@@ -300,3 +300,29 @@ def test_serversrc_refuses_wildcard_advertise(broker):
     with pytest.raises(nns.core.errors.PipelineError,
                        match="advertise_host"):
         nns.PipelineRunner(pipe).start()
+
+
+def test_broker_cli_daemon_cross_process():
+    """`python -m nnstreamer_tpu --broker` serves discovery to other
+    processes (the deployment story for HYBRID/mqtt)."""
+    import re
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nnstreamer_tpu", "--broker", "0",
+         "--bind", "127.0.0.1"],
+        stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stderr.readline()
+        port = int(re.search(r":(\d+)", line).group(1))
+        a = BrokerClient("127.0.0.1", port)
+        a.register("cli/svc", "127.0.0.1", 42)
+        b = BrokerClient("127.0.0.1", port)
+        assert b.lookup("cli/svc") == ("127.0.0.1", 42)
+        assert abs(b.clock_offset_ns()) < 2_000_000_000
+        a.close()
+        b.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
