@@ -22,6 +22,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def _interpret() -> bool:
@@ -90,6 +91,26 @@ def sparse_to_dense(values, flat_indices, shape: Tuple[int, ...]):
 
 # -- flash attention ---------------------------------------------------------
 
+def _online_softmax_update(q, k_blk, v_blk, m, l, acc, scale, mask):
+    """One flash block update shared by both kernels: scaled QK^T on the
+    MXU, optional mask, running max/normalizer, PV accumulation (f32)."""
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new[:, None])
+    p = jnp.where(s <= -1e29, 0.0, p)         # fully-masked rows stay 0
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
 def _flash_kernel(scale: float, causal: bool, bq: int, bk: int,
                   q_ref, k_ref, v_ref, o_ref):
     """One (batch·head, q-block) program: online-softmax over K/V blocks.
@@ -106,30 +127,19 @@ def _flash_kernel(scale: float, causal: bool, bq: int, bk: int,
     n_kb = s_total // bk
 
     def body(j, carry):
-        m, l, acc = carry
         # inputs stay in their (bf16) dtype into the MXU; accumulation
         # is f32 via preferred_element_type — the standard flash recipe
         k_blk = k_ref[0, pl.ds(j * bk, bk), :]
         v_blk = v_ref[0, pl.ds(j * bk, bk), :]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # (bq, bk) f32
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, -1e30)
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m, m_cur)
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s <= -1e29, 0.0, p)     # fully-masked rows stay 0
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+            mask = rows >= cols
+        else:
+            mask = None
+        return _online_softmax_update(q, k_blk, v_blk, *carry, scale, mask)
 
     d = q.shape[-1]
     m0 = jnp.full((bq,), -1e30, jnp.float32)
@@ -177,3 +187,104 @@ def flash_attention(q, k, v, *, causal: bool = False,
         interpret=_interpret(),
     )(qf, kf, vf)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_block_kernel(scale: float, bk: int, causal: bool,
+                        qoff_ref, koff_ref,
+                        q_ref, k_ref, v_ref, m_ref, l_ref, a_ref,
+                        mo_ref, lo_ref, ao_ref):
+    """Ring-attention block update: continue online softmax over ONE
+    incoming K/V block, carrying (m, l, acc) in/out. Global query/key
+    offsets arrive in SMEM so the causal mask works on rotated blocks;
+    causal is trace-time static (no mask work on the non-causal path).
+    m/l carry a (8, bq) sublane-replicated layout — Mosaic requires
+    (8, 128)-tileable blocks, so the per-row scalar rides all 8 sublanes."""
+    q = q_ref[0]                                  # (bq, D)
+    m = m_ref[0, 0]                               # (bq,) from sublane 0
+    l = l_ref[0, 0]
+    acc = a_ref[0]                                # (bq, D)
+    qi = pl.program_id(1)
+    bq = q.shape[0]
+    s_k = k_ref.shape[1]
+    qoff = qoff_ref[0] + qi * bq
+    koff = koff_ref[0]
+
+    def body(j, carry):
+        k_blk = k_ref[0, pl.ds(j * bk, bk), :]
+        v_blk = v_ref[0, pl.ds(j * bk, bk), :]
+        if causal:
+            rows = qoff + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = koff + j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            mask = rows >= cols
+        else:
+            mask = None
+        return _online_softmax_update(q, k_blk, v_blk, *carry, scale, mask)
+
+    n_kb = s_k // bk
+    if causal:
+        # sub-blocks whose first key index exceeds this program's last
+        # query index are fully masked: bound the loop instead of zeroing
+        # their scores after full MXU work (_flash_kernel's same skip)
+        row_max = qoff + bq - 1
+        upper = jnp.clip(
+            jax.lax.div(row_max - koff, jnp.int32(bk)) + 1, 0, n_kb)
+    else:
+        upper = n_kb
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+    mo_ref[0] = jnp.broadcast_to(m[None, :], (8, m.shape[0]))
+    lo_ref[0] = jnp.broadcast_to(l[None, :], (8, l.shape[0]))
+    ao_ref[0] = acc
+
+
+def flash_block_update(q, k_blk, v_blk, m, l, acc, *, q_offset, k_offset,
+                       causal: bool, block_q: int = 128,
+                       block_k: int = 128):
+    """One ring-attention step as a Pallas kernel: q (BH, Sq, D) attends
+    an incoming K/V block (BH, Sk, D), updating the flash carry
+    m/l (BH, Sq) f32 and acc (BH, Sq, D) f32. Offsets are the global
+    sequence positions of this device's queries / the rotated block's
+    keys (traced scalars — they change every ring step)."""
+    bh, sq, d = q.shape
+    sk = k_blk.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if sq % bq or sk % bk:
+        raise ValueError(
+            f"flash_block_update needs Sq={sq}, Sk={sk} divisible by "
+            f"({bq}, {bk})")
+    scale = d ** -0.5
+    kern = functools.partial(_flash_block_kernel, scale, bk, bool(causal))
+    grid = (bh, sq // bq)
+    scalars = [jnp.asarray([v], jnp.int32) for v in (q_offset, k_offset)]
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    blk_q = pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))
+    blk_kv = pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0))
+    blk_m = pl.BlockSpec((1, 8, bq), lambda i, j: (i, 0, j))
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[smem, smem,
+                  blk_q, blk_kv, blk_kv, blk_m, blk_m, blk_q],
+        out_specs=[blk_m, blk_m, blk_q],
+        out_shape=[jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, sq, d), jnp.float32)],
+        # donate the carry: each ring step updates (m, l, acc) in place
+        # instead of allocating three fresh HBM buffers per rotation
+        input_output_aliases={5: 0, 6: 1, 7: 2},
+        interpret=_interpret(),
+    )(*scalars, q, k_blk, v_blk, m, l, acc)
+
+
+def flash_carry_init(bh: int, sq: int, d: int):
+    """Fresh (m, l, acc) carry for flash_block_update — m/l in the
+    (BH, 8, Sq) sublane-replicated layout the kernel requires."""
+    return (jnp.full((bh, 8, sq), -1e30, jnp.float32),
+            jnp.zeros((bh, 8, sq), jnp.float32),
+            jnp.zeros((bh, sq, d), jnp.float32))
+
+
+def flash_carry_finalize(l, acc):
+    """acc / l → attention output (BH, Sq, D)."""
+    return acc / jnp.maximum(l[:, 0, :], 1e-20)[..., None]

@@ -55,11 +55,24 @@ def _block_attn(q, k, v, m_prev, l_prev, o_prev, mask=None):
 
 
 def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
-                   causal: bool = False):
+                   causal: bool = False, block_impl: str = "auto"):
     """Sequence-parallel attention. q/k/v: (B, S, H, D) with S sharded
-    over `axis`; returns (B, S, H, D) with the same sharding."""
+    over `axis`; returns (B, S, H, D) with the same sharding.
+
+    block_impl picks the per-rotation block math: "pallas" runs each
+    incoming K/V block through the flash_block_update kernel (MXU
+    dot_generals, VMEM-resident online softmax), "xla" is the jnp
+    einsum path, "auto" = pallas on TPU when the local block divides
+    128 (CPU tests keep xla — interpret-mode grids are slow)."""
 
     n = mesh.shape[axis]
+    s_local = q.shape[1] // n
+    use_pallas = block_impl == "pallas" or (
+        block_impl == "auto" and jax.default_backend() == "tpu"
+        and s_local % 128 == 0)
+    if use_pallas:
+        return _ring_attention_pallas(q, k, v, mesh=mesh, axis=axis,
+                                      causal=causal, n=n)
 
     def local(q, k, v):
         # q/k/v here: the per-device shard (B, S/n, H, D)
@@ -99,6 +112,53 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
         l = jnp.maximum(l, 1e-20)
         out = o / l.transpose(0, 2, 1)[..., None]
         return out.astype(q.dtype)
+
+    spec = P(None, axis, None, None)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _ring_attention_pallas(q, k, v, *, mesh, axis, causal, n):
+    """Ring rotation with the Pallas flash block kernel doing each
+    device's attend step (backends/pallas_ops.flash_block_update)."""
+    from nnstreamer_tpu.backends.pallas_ops import (
+        flash_block_update, flash_carry_finalize, flash_carry_init)
+
+    def local(q, k, v):
+        b, sq, h, d = q.shape
+        my = lax.axis_index(axis)
+        qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+        q_off = (my * sq).astype(jnp.int32)
+
+        def flat(t):
+            return t.transpose(0, 2, 1, 3).reshape(b * h, -1, d)
+
+        m, l, acc = flash_carry_init(b * h, sq, d)
+
+        def attend(i, m, l, acc, k_blk, v_blk):
+            src = (my - i) % n
+            k_off = (src * k_blk.shape[1]).astype(jnp.int32)
+            return flash_block_update(
+                qf, flat(k_blk), flat(v_blk), m, l, acc,
+                q_offset=q_off, k_offset=k_off, causal=causal)
+
+        def body(i, carry):
+            m, l, acc, k_blk, v_blk = carry
+            m, l, acc = attend(i, m, l, acc, k_blk, v_blk)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            return m, l, acc, k_blk, v_blk
+
+        m, l, acc, k_last, v_last = lax.fori_loop(
+            0, n - 1, body, (m, l, acc, k, v))
+        m, l, acc = attend(n - 1, m, l, acc, k_last, v_last)
+        out = flash_carry_finalize(l, acc)
+        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
     spec = P(None, axis, None, None)
     return shard_map(

@@ -254,3 +254,22 @@ def test_transformer_seq_ring_attention_matches_serial(eight_cpu_devices):
     want = np.asarray(T.apply_seq(params, ids, n_heads=H))
     got = np.asarray(T.apply_seq(params, ids, n_heads=H, mesh=mesh))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_pallas_block_matches_xla(eight_cpu_devices):
+    """The Pallas flash block kernel inside the ring (interpret mode on
+    the CPU mesh) equals the jnp block path."""
+    from nnstreamer_tpu.parallel.ring_attention import (
+        reference_attention, ring_attention)
+
+    mesh = make_mesh(MeshSpec(dp=1, tp=1, sp=4))
+    key = jax.random.PRNGKey(3)
+    B, S, H, D = 1, 64, 2, 16    # s_local=16: kernel blocks of 16
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    for causal in (False, True):
+        ref = reference_attention(q, k, v, causal=causal)
+        out = ring_attention(q, k, v, mesh=mesh, causal=causal,
+                             block_impl="pallas")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
