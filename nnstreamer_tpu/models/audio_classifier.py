@@ -1,0 +1,109 @@
+"""1-D conv audio classifier (zoo://audio_classifier) — the audio family.
+
+Reference analog: the audio ingest path (tensor_converter audio branch,
+gsttensor_converter.c:1110) feeding a keyword-spotting-style model; the
+reference ships no audio model, so this closes the loop the same way the
+vision zoo does for video. Architecture: log-energy frontend → stacked
+strided conv1d blocks → global pool → linear head, all MXU matmul-shaped
+(conv1d lowers to dot_general) and trainable (loss_fn for
+tensor_trainer).
+
+Pipeline shape (tests/test_streaming_models.py):
+    audiotestsrc ! tensor_converter frames-per-tensor=<window> !
+    tensor_transform mode=typecast option=float32 !
+    tensor_filter model=zoo://audio_classifier?window=<window> !
+    tensor_sink
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import layers as L
+from nnstreamer_tpu.models.zoo import register_model
+
+
+def init_params(key=None, *, channels: int = 32, n_blocks: int = 3,
+                num_classes: int = 12, seed: int = 0,
+                **_) -> Dict[str, Any]:
+    """`**_` absorbs pass-through kwargs (e.g. tensor_trainer's width);
+    the conv stack is window-agnostic — window only shapes the stream."""
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, n_blocks + 2)
+    blocks = []
+    cin = 1
+    for i in range(n_blocks):
+        blocks.append({
+            "w": L.xavier_init(keys[i], (8, cin, channels)),   # (K, Cin, Cout)
+            "b": jnp.zeros((channels,), jnp.float32),
+        })
+        cin = channels
+    return {
+        "blocks": blocks,
+        "head_w": L.xavier_init(keys[-2], (channels, num_classes)),
+        "head_b": jnp.zeros((num_classes,), jnp.float32),
+    }
+
+
+def apply(params, x, *, dtype=jnp.float32):
+    """x: (B, T) or (B, T, 1) waveform → (B, num_classes) logits."""
+    if x.ndim == 2:
+        x = x[..., None]
+    h = x.astype(dtype)
+    # frontend: per-window mean/scale normalize (robust to gain)
+    mu = jnp.mean(h, axis=1, keepdims=True)
+    sd = jnp.std(h, axis=1, keepdims=True) + 1e-5
+    h = (h - mu) / sd
+    for blk in params["blocks"]:
+        h = jax.lax.conv_general_dilated(
+            h, blk["w"].astype(dtype), window_strides=(4,),
+            padding="SAME", dimension_numbers=("NWC", "WIO", "NWC"))
+        h = jax.nn.relu(h + blk["b"].astype(dtype))
+    pooled = jnp.mean(h, axis=1)                          # (B, C)
+    return (pooled @ params["head_w"].astype(dtype)
+            + params["head_b"].astype(dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, x, y, *, dtype=jnp.float32, **_):
+    logits = apply(params, x, dtype=dtype)
+    onehot = jax.nn.one_hot(y, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+
+@register_model("audio_classifier")
+def build(window: int = 1024, channels: int = 32, n_blocks: int = 3,
+          num_classes: int = 12, batch: int = 1, dtype: str = "float32",
+          seed: int = 0):
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    cdtype = jnp.dtype(dtype)
+    params = init_params(channels=channels, n_blocks=n_blocks,
+                         num_classes=num_classes, seed=seed)
+
+    # the stream unit is ONE converter window (window, 1) — the shape
+    # `tensor_converter frames-per-tensor=<window>` emits; batch>1 takes
+    # stacked windows (batch, window, 1)
+    if batch == 1:
+        def fn(params, x):
+            return apply(params, x[None], dtype=cdtype)[0]
+
+        in_spec = TensorsSpec.of(
+            TensorInfo((window, 1), DType.FLOAT32, name="wave"))
+        out_spec = TensorsSpec.of(
+            TensorInfo((num_classes,), DType.FLOAT32, name="logits"))
+    else:
+        def fn(params, x):
+            return apply(params, x, dtype=cdtype)
+
+        in_spec = TensorsSpec.of(
+            TensorInfo((batch, window, 1), DType.FLOAT32, name="wave"))
+        out_spec = TensorsSpec.of(
+            TensorInfo((batch, num_classes), DType.FLOAT32, name="logits"))
+    return ModelBundle(fn=fn, params=params, in_spec=in_spec,
+                       out_spec=out_spec, name="audio_classifier")

@@ -76,7 +76,7 @@ def _load_builtins() -> None:
     import importlib
 
     for mod in ("mobilenet_v2", "ssd_mobilenet", "posenet", "lstm",
-                "transformer"):
+                "transformer", "audio_classifier"):
         try:
             importlib.import_module(f"nnstreamer_tpu.models.{mod}")
         except ImportError:
