@@ -100,6 +100,12 @@ class BoundingBoxes(DecoderSubplugin):
             raise PipelineError(
                 f"bounding_boxes option6 (device NMS) must be greedy|fast, "
                 f"got {self._nms_mode!r}")
+        # option7 = device=compact candidate count (top-K rows shipped)
+        self._compact_k = int(props.get("option7", "") or 100)
+        if self._compact_k < 1:
+            raise PipelineError(
+                f"bounding_boxes option7 (compact top-K) must be >= 1, "
+                f"got {self._compact_k}")
         self._anchors: Optional[np.ndarray] = None
 
     def negotiate(self, in_spec: TensorsSpec) -> VideoSpec:
@@ -172,8 +178,35 @@ class BoundingBoxes(DecoderSubplugin):
                            1.0, 1.0], jnp.float32)
         return (det * scale,)
 
+    # -- device compaction (tensor_decoder device=compact) ------------------
+    def device_compact(self, tensors, aux=None):
+        """Raw (loc, logits) → (K,6) candidate rows on device; the host
+        decode() keeps its exact threshold/NMS/overlay semantics. K=100
+        (option7 overrides) covers every plausible above-threshold
+        detection, so results match the full host path."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.decoders.device import ssd_compact_device
+
+        if self.scheme != "mobilenet-ssd":
+            raise PipelineError(
+                f"bounding_boxes device=compact supports scheme "
+                f"mobilenet-ssd; {self.scheme!r} decodes on host")
+        anchors = (aux or {}).get("anchors")
+        if anchors is None:
+            anchors = jnp.asarray(self._anchors, jnp.float32)
+        return (ssd_compact_device(tensors[0], tensors[1], anchors,
+                                   top_k=self._compact_k),)
+
     # -- per-scheme box extraction → (N, 6) [ymin,xmin,ymax,xmax,score,cls]
     def _extract(self, buf: TensorBuffer) -> np.ndarray:
+        if getattr(self, "consume_compact", False):
+            det = np.asarray(buf.tensors[0], np.float32)
+            if det.ndim != 2 or det.shape[1] != 6:
+                raise PipelineError(
+                    f"compact bounding-box tensor must be (K,6), got "
+                    f"{det.shape}")
+            return det
         s = self.scheme
         if s == "mobilenet-ssd":
             from nnstreamer_tpu.models.ssd_mobilenet import decode_boxes

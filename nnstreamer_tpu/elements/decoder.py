@@ -55,6 +55,30 @@ class DecoderSubplugin:
         """Optional pytree of decode-time constants (e.g. SSD anchors)."""
         return None
 
+    # -- optional compaction path (tensor_decoder device=compact) ----------
+    # Middle ground: the heavy raw model outputs are reduced on device to
+    # a small candidate tensor (e.g. top-K boxes), but the decoder's host
+    # semantics — thresholding, NMS, media overlay — still run on host
+    # exactly as in the plain mode. D2H shrinks from the raw grids to the
+    # compact tensor; results are identical whenever the compact tensor
+    # covers everything above threshold.
+
+    def device_compact(self, tensors, aux=None):
+        """jit-traceable reduction: raw output arrays → compact arrays
+        that decode() can consume (flagged via `consume_compact`)."""
+        raise PipelineError(
+            f"decoder mode={self.MODE} has no device compaction; use "
+            f"device=true (full device decode) or the host decoder")
+
+
+def _prop_device(v) -> object:
+    """false | true | compact (bool-compatible parse)."""
+    if isinstance(v, str) and v.strip().lower() == "compact":
+        return "compact"
+    from nnstreamer_tpu.graph.pipeline import prop_bool
+
+    return prop_bool(v)
+
 
 def register_decoder(mode: str):
     def deco(cls):
@@ -72,8 +96,18 @@ class TensorDecoder(Element):
         "mode": PropDef(str, None, "decoder subplugin name"),
         # device=true: run the decode as XLA on device and emit the
         # compact result tensor (boxes/keypoints/label index) instead of
-        # host-rendered media — raw model outputs never cross D2H
-        "device": PropDef(prop_bool, False, "device-side decode"),
+        # host-rendered media — raw model outputs never cross D2H.
+        # device=compact: reduce on device (e.g. top-K candidates) but
+        # keep the host decode semantics (threshold/NMS/overlay) — only
+        # the compact candidate tensor crosses D2H.
+        "device": PropDef(_prop_device, False,
+                          "device-side decode (false|true|compact)"),
+        # compact mode: frames whose D2H readback may be in flight at
+        # once. >1 pipelines the host copies (copy_to_host_async) so the
+        # transfer latency overlaps across frames — decode emission lags
+        # by up to max_in_flight-1 frames mid-stream (flush drains at
+        # EOS). 1 (default) = strict per-frame synchronous behavior.
+        "max_in_flight": PropDef(int, 1, "compact D2H pipelining depth"),
         # reference passes up to 9 positional option strings; we accept
         # those plus named passthrough props via option_fields
         **{f"option{i}": PropDef(str, "") for i in range(1, 10)},
@@ -91,16 +125,31 @@ class TensorDecoder(Element):
         self.sub: DecoderSubplugin = cls()
         self.sub.init(dict(self.props))
         self._device_fn = None
+        self._compact_fn = None
+        self._inflight: List = []     # compact mode: frames awaiting D2H
         if self.props["device"]:
             self.WANTS_HOST = False   # keep payloads on device
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         spec = self.expect_tensors(in_specs[0])
+        dev = self.props["device"]
         try:
-            if self.props["device"]:
-                out = self.sub.device_negotiate(spec)
+            if dev == "compact":
                 import jax
 
+                # host media semantics on the compacted candidates:
+                # negotiate() validates the RAW input + declares the
+                # media output; the device step only shrinks the D2H
+                out = self.sub.negotiate(spec)
+                self._device_aux = self.sub.device_aux()
+                if self._device_aux is not None:
+                    self._device_aux = jax.device_put(self._device_aux)
+                self._compact_fn = jax.jit(self.sub.device_compact)
+                self.sub.consume_compact = True
+            elif dev:
+                import jax
+
+                out = self.sub.device_negotiate(spec)
                 self._device_aux = self.sub.device_aux()
                 if self._device_aux is not None:
                     self._device_aux = jax.device_put(self._device_aux)
@@ -115,9 +164,34 @@ class TensorDecoder(Element):
         return [out]
 
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        if self._compact_fn is not None:
+            out = self._compact_fn(buf.tensors, self._device_aux)
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            for t in out:
+                start = getattr(t, "copy_to_host_async", None)
+                if start is not None:
+                    start()               # overlap D2H across frames
+            self._inflight.append((buf, tuple(out)))
+            ems: List[Emission] = []
+            depth = max(1, int(self.props["max_in_flight"]))
+            while len(self._inflight) >= depth:
+                ems.append((0, self._emit_compact()))
+            return ems
         if self._device_fn is not None:
             out = self._device_fn(buf.tensors, self._device_aux)
             if not isinstance(out, (tuple, list)):
                 out = (out,)
             return [(0, buf.with_tensors(tuple(out)))]
         return [(0, self.sub.decode(buf.to_host()))]
+
+    def _emit_compact(self) -> TensorBuffer:
+        src_buf, dev_out = self._inflight.pop(0)
+        compact = src_buf.with_tensors(dev_out).to_host()
+        return self.sub.decode(compact)
+
+    def flush(self) -> List[Emission]:
+        ems: List[Emission] = []
+        while self._inflight:
+            ems.append((0, self._emit_compact()))
+        return ems

@@ -145,7 +145,10 @@ def fuse_transforms(pipe: Pipeline) -> int:
 def _is_device_decoder(elem) -> bool:
     from nnstreamer_tpu.elements.decoder import TensorDecoder
 
-    return isinstance(elem, TensorDecoder) and bool(elem.props.get("device"))
+    # device=compact keeps its host decode stage, so the element must
+    # stay in the graph (only full device decodes fold into the filter)
+    return (isinstance(elem, TensorDecoder)
+            and elem.props.get("device") is True)
 
 
 def _remove_linear_element(pipe: Pipeline, elem) -> None:

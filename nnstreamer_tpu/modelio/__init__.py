@@ -41,9 +41,24 @@ def load_model_file(path: str, batch: Optional[int] = None,
     ext = path.rsplit(".", 1)[-1].lower() if "." in path else ""
 
     if ext == "tflite":
-        lowered = lower_tflite(parse_tflite(path), batch=batch,
-                               compute_dtype=compute_dtype,
-                               quantize_output=quantize_output)
+        graph = parse_tflite(path)
+        if compute_dtype in ("int8", "native", "auto"):
+            from nnstreamer_tpu.modelio.tflite_quant import (
+                lower_tflite_quant, quantized_graph_supported)
+            if quantized_graph_supported(graph):
+                lowered = lower_tflite_quant(graph, batch=batch)
+            elif compute_dtype == "auto":
+                lowered = lower_tflite(graph, batch=batch,
+                                       quantize_output=quantize_output)
+            else:
+                raise BackendError(
+                    f"{path!r} is not a fully-quantized graph; int8-native "
+                    f"execution needs per-tensor uint8/int8 quantization "
+                    f"throughout (use dtype=bfloat16)")
+        else:
+            lowered = lower_tflite(graph, batch=batch,
+                                   compute_dtype=compute_dtype,
+                                   quantize_output=quantize_output)
         mk = lambda shapes, dtypes: TensorsSpec(tensors=tuple(
             TensorInfo(shape=tuple(s), dtype=DType.from_np(d))
             for s, d in zip(shapes, dtypes)))

@@ -2,5 +2,8 @@
 reference (threads, queues, backpressure, EOS/error propagation)."""
 
 from nnstreamer_tpu.runtime.scheduler import EOS, PipelineRunner, run_pipeline
+from nnstreamer_tpu.runtime.input_pipeline import (
+    DeviceFeeder, prefetch_to_device)
 
-__all__ = ["PipelineRunner", "run_pipeline", "EOS"]
+__all__ = ["PipelineRunner", "run_pipeline", "EOS",
+           "DeviceFeeder", "prefetch_to_device"]

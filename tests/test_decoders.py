@@ -400,3 +400,110 @@ class TestDeviceDecode:
         cm = np.asarray(pipe.get("out").results[0].tensors[0])
         assert cm.shape == (4, 4) and cm.dtype == np.uint8
         assert (cm[:2] == 1).all() and (cm[2:] == 2).all()
+
+
+class TestCompactDecode:
+    """tensor_decoder device=compact: on-chip top-K candidate reduction
+    + unchanged host threshold/NMS/overlay semantics."""
+
+    def _ssd_io(self, seed=0, objects=6):
+        from nnstreamer_tpu.models.ssd_mobilenet import generate_anchors
+
+        rng = np.random.default_rng(seed)
+        n = generate_anchors().shape[0]
+        loc = rng.normal(0, 0.3, (1, n, 4)).astype(np.float32)
+        logits = rng.normal(-9, 0.5, (1, n, 91)).astype(np.float32)
+        for i in rng.choice(n, objects, replace=False):
+            logits[0, i, rng.integers(1, 91)] = rng.uniform(2.0, 5.0)
+        return loc, logits
+
+    def test_compact_matches_full_host_decode(self):
+        """Final boxes through the compact path equal the plain host
+        path exactly (top-100 covers everything above threshold)."""
+        from nnstreamer_tpu.decoders.boundingbox import BoundingBoxes
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+        loc, logits = self._ssd_io()
+        props = {"option1": "mobilenet-ssd", "option3": "0.5:0.5",
+                 "option4": "300:300"}
+        spec = TensorsSpec.of(TensorInfo(loc.shape, DType.FLOAT32),
+                              TensorInfo(logits.shape, DType.FLOAT32))
+        host = BoundingBoxes()
+        host.init(dict(props))
+        host.negotiate(spec)
+        host_out = host.decode(TensorBuffer.of(loc, logits))
+
+        comp = BoundingBoxes()
+        comp.init(dict(props))
+        comp.negotiate(spec)
+        (det,) = comp.device_compact(
+            (loc, logits), {"anchors": comp._anchors})
+        comp.consume_compact = True
+        comp_out = comp.decode(TensorBuffer.of(np.asarray(det)))
+        np.testing.assert_allclose(
+            comp_out.meta["boxes"], host_out.meta["boxes"],
+            rtol=1e-4, atol=1e-2)
+        # overlay pixels identical too (same boxes, same draw path)
+        np.testing.assert_array_equal(
+            np.asarray(comp_out.tensors[0]), np.asarray(host_out.tensors[0]))
+
+    def test_compact_pipeline_end_to_end(self):
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        loc, logits = self._ssd_io(1)
+        pipe = nns.parse_launch(
+            f"appsrc name=src dims=4:{loc.shape[1]}:1,91:{loc.shape[1]}:1 "
+            f"types=float32,float32 ! "
+            f"tensor_decoder mode=bounding_boxes device=compact "
+            f"option1=mobilenet-ssd option3=0.3:0.5 option4=300:300 ! "
+            f"tensor_sink name=out")
+        runner = nns.PipelineRunner(pipe).start()
+        src = pipe.get("src")
+        src.push(TensorBuffer.of(loc, logits))
+        src.end()
+        runner.wait(60)
+        runner.stop()
+        res = pipe.get("out").results
+        assert len(res) == 1
+        img = np.asarray(res[0].tensors[0])
+        assert img.shape == (300, 300, 4) and img.dtype == np.uint8
+        assert len(res[0].meta["boxes"]) >= 1    # planted objects found
+
+    def test_compact_decoder_not_fused_away(self):
+        """The optimizer must keep a device=compact decoder in the graph
+        (its host decode stage still has work to do)."""
+        import nnstreamer_tpu as nns
+
+        pipe = nns.parse_launch(
+            "appsrc name=src dims=3:300:300:1 types=uint8 ! "
+            "tensor_transform mode=arithmetic "
+            "option=typecast:float32,add:-127.5,div:127.5 ! "
+            "tensor_filter model=zoo://ssd_mobilenet ! "
+            "tensor_decoder name=dec mode=bounding_boxes device=compact "
+            "option1=mobilenet-ssd option3=0.5:0.5 option4=300:300 ! "
+            "fakesink")
+        pipe.negotiate()
+        assert pipe.get("dec") is not None
+
+    def test_compact_k_option_and_validation(self):
+        from nnstreamer_tpu.core.errors import PipelineError
+        from nnstreamer_tpu.decoders.boundingbox import BoundingBoxes
+
+        b = BoundingBoxes()
+        b.init({"option1": "mobilenet-ssd", "option7": "25"})
+        assert b._compact_k == 25
+        b2 = BoundingBoxes()
+        with pytest.raises(PipelineError, match="option7"):
+            b2.init({"option1": "mobilenet-ssd", "option7": "0"})
+
+    def test_compact_unsupported_scheme_fails_cleanly(self):
+        from nnstreamer_tpu.core.errors import PipelineError
+        from nnstreamer_tpu.decoders.boundingbox import BoundingBoxes
+
+        b = BoundingBoxes()
+        b.init({"option1": "yolov5"})
+        with pytest.raises(PipelineError, match="compact"):
+            b.device_compact((np.zeros((1, 5, 85), np.float32),))

@@ -171,6 +171,70 @@ def test_mobilenet_batch_override(mobilenet_lowered):
         assert np.abs(row.astype(int) - out1[0].astype(int)).max() <= 1
 
 
+# -- int8-native execution (tflite_quant.py) --------------------------------
+
+@needs_models
+def test_mobilenet_int8_native_top1_golden():
+    """The int8-native lowering (integer convs on the MXU path, ones-
+    channel zero-point augmentation, int16-folded depthwise) must agree
+    with the TFLite interpreter at least as well as the float path."""
+    import jax
+
+    from nnstreamer_tpu.modelio.tflite_quant import (
+        lower_tflite_quant, quantized_graph_supported)
+
+    g = parse_tflite(MOBILENET)
+    assert quantized_graph_supported(g)
+    m = lower_tflite_quant(g)
+    assert m.in_dtypes == [np.dtype(np.uint8)]
+    assert m.out_dtypes == [np.dtype(np.uint8)]
+    interp = _tflite_interpreter(MOBILENET)
+    ind = interp.get_input_details()[0]["index"]
+    outd = interp.get_output_details()[0]["index"]
+    fn = jax.jit(m.fn)
+    agree = 0
+    worst = 0
+    for x in _synthetic_images(10):
+        interp.set_tensor(ind, x)
+        interp.invoke()
+        ref = interp.get_tensor(outd)[0]
+        ours = np.asarray(fn(m.params, x)[0])[0]
+        assert ours.dtype == np.uint8 and ours.shape == (1001,)
+        agree += int(ref.argmax()) == int(ours.argmax())
+        worst = max(worst, np.abs(ref.astype(int)
+                                  - ours.astype(int)).max())
+    assert agree >= 9, f"int8-native top-1 agreement {agree}/10"
+    # integer pipeline tracks the interpreter to a few quantized units
+    # (ties in the last bit differ: f32 multiplier vs fixed-point)
+    assert worst <= 4, f"worst quantized-output diff {worst}"
+
+
+@needs_models
+def test_int8_native_via_load_model_file_and_batch():
+    import jax
+
+    m = load_model_file(MOBILENET, batch=3, compute_dtype="int8")
+    assert m.in_spec.tensors[0].shape == (3, 224, 224, 3)
+    x1 = next(iter(_synthetic_images(1)))
+    x3 = np.concatenate([x1] * 3, axis=0)
+    out3 = np.asarray(jax.jit(m.fn)(m.params, x3)[0])
+    assert out3.shape == (3, 1001) and out3.dtype == np.uint8
+    # batch slots are independent in a feedforward net
+    assert np.array_equal(out3[0], out3[1])
+
+
+@needs_models
+def test_int8_native_rejects_float_graph():
+    deeplab = os.path.join(MODELS, "deeplabv3_257_mv_gpu.tflite")
+    if not os.path.exists(deeplab):
+        pytest.skip("deeplab model absent")
+    with pytest.raises(BackendError, match="int8-native|fully-quantized"):
+        load_model_file(deeplab, compute_dtype="int8")
+    # auto mode falls back to the float lowering instead
+    m = load_model_file(deeplab, compute_dtype="auto")
+    assert m.fn is not None
+
+
 # -- deeplab: float model with resize/concat ---------------------------------
 
 @needs_models

@@ -246,3 +246,79 @@ class TestReviewRegressions:
 
         with pytest.raises(PipelineError, match="pad reference"):
             parse_launch("appsrc dims=2 ! m. foo=1 tensor_sink name=m")
+
+
+class TestInputPipeline:
+    """Double-buffered H2D staging (runtime/input_pipeline.py)."""
+
+    def test_prefetch_yields_all_in_order(self):
+        import jax
+
+        from nnstreamer_tpu.runtime import prefetch_to_device
+
+        batches = [np.full((4,), i, np.float32) for i in range(7)]
+        out = list(prefetch_to_device(iter(batches), depth=2))
+        assert len(out) == 7
+        for i, y in enumerate(out):
+            assert isinstance(y, jax.Array)
+            np.testing.assert_array_equal(np.asarray(y), batches[i])
+
+    def test_prefetch_overlaps_staging(self):
+        """The producer runs ahead of the consumer (double buffering):
+        with depth=2 the 2nd batch is staged while the 1st is consumed."""
+        import threading
+        import time
+
+        from nnstreamer_tpu.runtime import prefetch_to_device
+
+        staged = []
+        gate = threading.Event()
+
+        def slow_source():
+            for i in range(4):
+                staged.append(i)
+                yield np.full((2,), i, np.float32)
+            gate.set()
+
+        it = prefetch_to_device(slow_source(), depth=2)
+        first = next(it)
+        time.sleep(0.05)            # let the worker run ahead
+        assert len(staged) >= 2     # staged beyond what was consumed
+        rest = list(it)
+        assert len(rest) == 3 and gate.is_set()
+        np.testing.assert_array_equal(np.asarray(first), [0, 0])
+
+    def test_prefetch_propagates_source_error(self):
+        from nnstreamer_tpu.runtime import prefetch_to_device
+
+        def bad():
+            yield np.zeros(2, np.float32)
+            raise ValueError("sensor unplugged")
+
+        it = prefetch_to_device(bad(), depth=1)
+        next(it)
+        with pytest.raises(ValueError, match="sensor unplugged"):
+            for _ in it:
+                pass
+
+    def test_feeder_push_pull_and_close(self):
+        from nnstreamer_tpu.runtime import DeviceFeeder
+
+        f = DeviceFeeder(depth=2)
+        f.put(np.arange(3, dtype=np.float32))
+        f.put(np.arange(3, dtype=np.float32) * 2)
+        f.close()
+        a = f.get()
+        b = f.get()
+        np.testing.assert_array_equal(np.asarray(b), [0.0, 2.0, 4.0])
+        assert f.get() is None
+        with pytest.raises(RuntimeError, match="closed"):
+            f.put(np.zeros(1, np.float32))
+
+    def test_feeder_rejects_bad_depth(self):
+        from nnstreamer_tpu.runtime import DeviceFeeder, prefetch_to_device
+
+        with pytest.raises(ValueError, match="depth"):
+            DeviceFeeder(depth=0)
+        with pytest.raises(ValueError, match="depth"):
+            list(prefetch_to_device(iter([]), depth=0))
