@@ -97,6 +97,22 @@ class _Bench:
                     f"bench stalled: sink at {self.sink.count}/{target}")
             time.sleep(poll)
 
+    def _closed_loop(self, n_lat, base=0):
+        """Per-frame push→emission latencies; base = emissions already
+        counted on this pipeline (0 on a fresh one: the first frame
+        warms/compiles and is excluded)."""
+        lats = []
+        if base == 0:
+            self._push()
+            self._wait(1)
+            base = 1
+        for i in range(n_lat):
+            t = time.perf_counter()
+            self._push()
+            self._wait(base + i + 1, poll=0.0005)
+            lats.append((time.perf_counter() - t) * 1e3)
+        return lats
+
     def run(self, n_frames=None, warmup=12, n_lat=None):
         if n_frames is None:
             n_frames = 128 if _on_tpu() else 8
@@ -114,9 +130,9 @@ class _Bench:
             raise
 
     def _run(self, n_frames, warmup, n_lat):
-        import nnstreamer_tpu as nns
-        from nnstreamer_tpu.tensor.buffer import TensorBuffer
-
+        # a lagging stage withholds its last `lag` emissions until EOS:
+        # the warmup must push past the lag or the warmup wait stalls
+        warmup = max(warmup, self.lag + 4)
         for _ in range(warmup):
             self._push()
         self._wait(max(warmup - self.lag, 1))
@@ -129,50 +145,20 @@ class _Bench:
         self._wait(max(warmup - self.lag, 1) + n_frames)
         dt = time.perf_counter() - t0
         fps = n_frames * self.frames_per_push / dt
-        # closed-loop latency: one frame in flight (strict variant
-        # pipeline when the throughput pipeline lags emissions)
-        lats = []
+        # closed-loop latency: one frame in flight (on a fresh strict-
+        # variant pipeline when the throughput pipeline lags emissions)
         if self.build_lat is not None:
             self.src.end()
             self.runner.wait(60)
-            pipe2, src2, sink2, frame2 = self.build_lat()
-            runner2 = nns.PipelineRunner(pipe2, queue_capacity=4).start()
+            lat_bench = _Bench(self.build_lat)
             try:
-                src2.push(TensorBuffer.of(
-                    *(frame2 if isinstance(frame2, tuple) else (frame2,)),
-                    pts=0))
-                t0 = time.perf_counter()
-                while sink2.count < 1:           # warm/compile
-                    if runner2._error is not None:
-                        raise RuntimeError(
-                            f"lat pipeline failed: {runner2._error}")
-                    if time.perf_counter() - t0 > 300:
-                        raise RuntimeError("lat pipeline stalled")
-                    time.sleep(0.002)
-                for i in range(n_lat):
-                    t = time.perf_counter()
-                    src2.push(TensorBuffer.of(
-                        *(frame2 if isinstance(frame2, tuple)
-                          else (frame2,)), pts=i + 1))
-                    while sink2.count < i + 2:
-                        if runner2._error is not None:
-                            raise RuntimeError(
-                                f"lat pipeline failed: {runner2._error}")
-                        if time.perf_counter() - t > 300:
-                            raise RuntimeError("lat pipeline stalled")
-                        time.sleep(0.0005)
-                    lats.append((time.perf_counter() - t) * 1e3)
-                src2.end()
-                runner2.wait(60)
+                lats = lat_bench._closed_loop(n_lat)
+                lat_bench.src.end()
+                lat_bench.runner.wait(60)
             finally:
-                runner2.stop()
+                lat_bench.runner.stop()
         else:
-            base = warmup + n_frames
-            for i in range(n_lat):
-                t = time.perf_counter()
-                self._push()
-                self._wait(base + i + 1, poll=0.0005)
-                lats.append((time.perf_counter() - t) * 1e3)
+            lats = self._closed_loop(n_lat, base=warmup + n_frames)
             self.src.end()
             self.runner.wait(60)
         lats.sort()
@@ -306,8 +292,9 @@ def _u8_frame(shape, seed):
 
 
 #: compact-decoder D2H pipelining depth for the SSD throughput config;
-#: the bench's emission-lag accounting derives from it
-SSD_MAX_IN_FLIGHT = 8
+#: the bench's emission-lag accounting derives from it (16 absorbs the
+#: tunnel's D2H jitter: measured 62 FPS vs 33 at depth 8)
+SSD_MAX_IN_FLIGHT = 16
 
 
 def _build_ssd(max_in_flight=SSD_MAX_IN_FLIGHT):
@@ -515,27 +502,31 @@ def offload_bench(n_frames=None, n_lat=None):
 
 # -- batch sweep + MFU -------------------------------------------------------
 
-def _step_ms(f, *args, n1=20, n2=100):
-    """Per-step ms via differencing two loop lengths, each closed by a
-    4-byte readback barrier. On the tunneled chip `block_until_ready`
-    returns before execution finishes (the relay acks the dispatch, not
-    the compute), so single-loop timing measures enqueue rate; the
-    readback is a true barrier and differencing cancels its fixed cost
-    and the ramp."""
+def _sync(y) -> float:
+    """True execution barrier: 4-byte readback of a value dependent on
+    `y` (block_until_ready is not a real barrier on relayed backends —
+    the relay acks the dispatch, not the compute)."""
     import jax
     import jax.numpy as jnp
 
-    def sync(y):
-        leaf = jax.tree_util.tree_leaves(y)[0]
-        return float(jnp.sum(leaf.astype(jnp.float32).ravel()[:8]))
+    leaf = jax.tree_util.tree_leaves(y)[0]
+    return float(jnp.sum(leaf.astype(jnp.float32).ravel()[:8]))
 
-    sync(f(*args))          # warmup: compile fn + the sync path
+
+def _step_ms(f, *args, n1=20, n2=100):
+    """Per-step ms via differencing two loop lengths, each closed by the
+    readback barrier; differencing cancels the barrier's fixed cost and
+    the ramp. Off-TPU the loops shrink — the method's purpose is the
+    tunneled chip."""
+    if not _on_tpu():
+        n1, n2 = max(2, n1 // 10), max(4, n2 // 10)
+    _sync(f(*args))          # warmup: compile fn + the sync path
 
     def run(n):
         t0 = time.perf_counter()
         for _ in range(n):
             y = f(*args)
-        sync(y)
+        _sync(y)
         return time.perf_counter() - t0
 
     run(n1)                 # second warm pass (cache/queue steady state)
@@ -588,7 +579,9 @@ def batch_sweep(batches=None):
         ms = _step_ms(fn, params, xd)
         fps = b / ms * 1e3
         tflops = flops / (ms / 1e3) / 1e12 if flops else 0.0
-        # pipelined host→device staging (double-buffered feeder)
+        # pipelined host→device staging (double-buffered feeder); the
+        # timed loop closes with the readback barrier because
+        # block_until_ready is not a true barrier on relayed backends
         n_staged = 24 if on_tpu else 4
         it = prefetch_to_device(iter([x] * n_staged), depth=2)
         first = next(it)
@@ -598,7 +591,7 @@ def batch_sweep(batches=None):
         for xd_s in it:
             y = fn(params, xd_s)
             got += 1
-        jax.block_until_ready(y)
+        _sync(y)
         piped_fps = (got - 1) * b / max(time.perf_counter() - t0, 1e-9)
         out[str(b)] = {
             "ms": round(ms, 3),
@@ -608,8 +601,10 @@ def batch_sweep(batches=None):
             "mfu_pct": round(100 * tflops / PEAK_BF16_TFLOPS, 2)
             if on_tpu and tflops else 0.0,
         }
+    # knee = best-MFU batch on TPU; off-TPU (mfu is 0) best raw FPS
+    key = "mfu_pct" if on_tpu else "fps"
     out["knee_batch"] = max(
-        (int(k) for k in out), key=lambda b: out[str(b)]["mfu_pct"])
+        (int(k) for k in out), key=lambda b: out[str(b)][key])
     return out
 
 
@@ -671,7 +666,9 @@ def pallas_check():
     }
     if compiled:
         # flash attention: the transformer hot op as a Pallas kernel,
-        # timed against XLA's fused softmax attention at S=2048
+        # timed against XLA's fused softmax attention at S=2048 with the
+        # differencing+readback method (_step_ms — block_until_ready is
+        # not a true barrier on the relayed backend)
         import jax.numpy as jnp
 
         from nnstreamer_tpu.parallel.ring_attention import reference_attention
@@ -681,29 +678,21 @@ def pallas_check():
         q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
                    for kk in jax.random.split(key, 3))
         ff = jax.jit(lambda q, k, v: pallas_ops.flash_attention(
-            q, k, v, causal=True, block_q=256))
+            q, k, v, causal=True))
         fr = jax.jit(lambda q, k, v: reference_attention(q, k, v,
                                                          causal=True))
-        jax.block_until_ready(ff(q, k, v))
-        jax.block_until_ready(fr(q, k, v))
-
-        def ms(fn, n=10):
-            # best-of-3 batches: the tunnel adds multi-ms jitter that
-            # would otherwise dominate a single batch
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                rs = [fn(q, k, v) for _ in range(n)]
-                jax.block_until_ready(rs)
-                best = min(best, (time.perf_counter() - t0) / n * 1e3)
-            return best
-
         err = float(jnp.max(jnp.abs(
             ff(q, k, v).astype(jnp.float32)
             - fr(q, k, v).astype(jnp.float32))))
+        ours = _step_ms(ff, q, k, v, n1=20, n2=80)
+        xla = _step_ms(fr, q, k, v, n1=20, n2=80)
+        flops = 4 * B * H * S * S * D / 2          # causal
         out["flash_attention"] = {
-            "s2048_ms": round(ms(ff), 2),
-            "xla_attn_ms": round(ms(fr), 2),
+            "s2048_ms": round(ours, 3),
+            "xla_attn_ms": round(xla, 3),
+            "speedup_vs_xla": round(xla / ours, 2),
+            "mfu_pct": round(
+                100 * flops / (ours / 1e3) / 1e12 / PEAK_BF16_TFLOPS, 1),
             "max_abs_err": round(err, 4),
         }
     return out

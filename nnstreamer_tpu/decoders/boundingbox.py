@@ -179,6 +179,12 @@ class BoundingBoxes(DecoderSubplugin):
         return (det * scale,)
 
     # -- device compaction (tensor_decoder device=compact) ------------------
+    def device_compact_check(self) -> None:
+        if self.scheme != "mobilenet-ssd":
+            raise PipelineError(
+                f"bounding_boxes device=compact supports scheme "
+                f"mobilenet-ssd; {self.scheme!r} decodes on host")
+
     def device_compact(self, tensors, aux=None):
         """Raw (loc, logits) → (K,6) candidate rows on device; the host
         decode() keeps its exact threshold/NMS/overlay semantics. K=100
@@ -188,10 +194,7 @@ class BoundingBoxes(DecoderSubplugin):
 
         from nnstreamer_tpu.decoders.device import ssd_compact_device
 
-        if self.scheme != "mobilenet-ssd":
-            raise PipelineError(
-                f"bounding_boxes device=compact supports scheme "
-                f"mobilenet-ssd; {self.scheme!r} decodes on host")
+        self.device_compact_check()
         anchors = (aux or {}).get("anchors")
         if anchors is None:
             anchors = jnp.asarray(self._anchors, jnp.float32)

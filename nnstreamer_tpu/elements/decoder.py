@@ -70,6 +70,14 @@ class DecoderSubplugin:
             f"decoder mode={self.MODE} has no device compaction; use "
             f"device=true (full device decode) or the host decoder")
 
+    def device_compact_check(self) -> None:
+        """Raise PipelineError at negotiation time when this subplugin
+        (or its configured scheme) cannot compact — fail-fast parity
+        with device_negotiate's validation."""
+        raise PipelineError(
+            f"decoder mode={self.MODE} has no device compaction; use "
+            f"device=true (full device decode) or the host decoder")
+
 
 def _prop_device(v) -> object:
     """false | true | compact (bool-compatible parse)."""
@@ -140,6 +148,7 @@ class TensorDecoder(Element):
                 # host media semantics on the compacted candidates:
                 # negotiate() validates the RAW input + declares the
                 # media output; the device step only shrinks the D2H
+                self.sub.device_compact_check()   # fail fast pre-stream
                 out = self.sub.negotiate(spec)
                 self._device_aux = self.sub.device_aux()
                 if self._device_aux is not None:
@@ -168,11 +177,11 @@ class TensorDecoder(Element):
             out = self._compact_fn(buf.tensors, self._device_aux)
             if not isinstance(out, (tuple, list)):
                 out = (out,)
-            for t in out:
-                start = getattr(t, "copy_to_host_async", None)
-                if start is not None:
-                    start()               # overlap D2H across frames
-            self._inflight.append((buf, tuple(out)))
+            # best-effort async D2H start: overlaps the copy across
+            # in-flight frames (buffer.prefetch_host guards backends
+            # whose copy_to_host_async raises)
+            self._inflight.append(
+                buf.with_tensors(tuple(out)).prefetch_host())
             ems: List[Emission] = []
             depth = max(1, int(self.props["max_in_flight"]))
             while len(self._inflight) >= depth:
@@ -186,9 +195,7 @@ class TensorDecoder(Element):
         return [(0, self.sub.decode(buf.to_host()))]
 
     def _emit_compact(self) -> TensorBuffer:
-        src_buf, dev_out = self._inflight.pop(0)
-        compact = src_buf.with_tensors(dev_out).to_host()
-        return self.sub.decode(compact)
+        return self.sub.decode(self._inflight.pop(0).to_host())
 
     def flush(self) -> List[Emission]:
         ems: List[Emission] = []

@@ -46,7 +46,16 @@ def load_model_file(path: str, batch: Optional[int] = None,
             from nnstreamer_tpu.modelio.tflite_quant import (
                 lower_tflite_quant, quantized_graph_supported)
             if quantized_graph_supported(graph):
-                lowered = lower_tflite_quant(graph, batch=batch)
+                try:
+                    lowered = lower_tflite_quant(graph, batch=batch)
+                except BackendError:
+                    # support pre-check is necessarily approximate
+                    # (e.g. per-channel weight zero points surface only
+                    # during lowering); auto falls back to float
+                    if compute_dtype != "auto":
+                        raise
+                    lowered = lower_tflite(graph, batch=batch,
+                                           quantize_output=quantize_output)
             elif compute_dtype == "auto":
                 lowered = lower_tflite(graph, batch=batch,
                                        quantize_output=quantize_output)

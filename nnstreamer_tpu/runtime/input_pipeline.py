@@ -74,10 +74,15 @@ def prefetch_to_device(it: Iterable[Any], depth: int = 2,
                         continue
                 if cancelled.is_set():
                     return
-            q.put(_STOP)
+            item = _STOP
         except BaseException as e:      # surface at the consumer side
-            if not cancelled.is_set():
-                q.put(e)
+            item = e
+        while not cancelled.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                continue
 
     t = threading.Thread(target=worker, name="device-prefetch",
                          daemon=True)
@@ -119,24 +124,30 @@ class DeviceFeeder:
         self._device = device
         self._put = put or _default_put
         self._closed = False
+        # serializes the closed-check with the enqueue so a concurrent
+        # close() cannot slip its sentinel between them (which would
+        # silently drop the racing batch behind EOS)
+        self._lock = threading.Lock()
 
     def put(self, host_batch: Any, timeout: Optional[float] = None) -> None:
-        if self._closed:
-            raise RuntimeError("DeviceFeeder is closed")
         if not self._slots.acquire(timeout=timeout):
             raise queue.Full("DeviceFeeder staging buffer is full")
         try:
             staged = self._put(host_batch, self._device)
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("DeviceFeeder is closed")
+                self._q.put(staged)
         except BaseException:
             self._slots.release()
             raise
-        self._q.put(staged)
 
     def close(self) -> None:
         """Signal end of stream; get() returns None after draining."""
-        if not self._closed:
-            self._closed = True
-            self._q.put(_STOP)
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._q.put(_STOP)
 
     def get(self, timeout: Optional[float] = None) -> Optional[Any]:
         item = self._q.get(timeout=timeout)
