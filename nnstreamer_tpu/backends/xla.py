@@ -55,6 +55,11 @@ class ModelBundle:
     in_spec: Optional[TensorsSpec] = None
     out_spec: Optional[TensorsSpec] = None
     name: str = ""
+    #: optional host-side input stage applied before H2D staging — for
+    #: inputs that are bytes-parsing, not tensor math (e.g. the GraphDef
+    #: DecodeWav entry: RIFF header decode happens here, PCM samples
+    #: enter the XLA program)
+    host_pre: Optional[Callable[[tuple], tuple]] = None
 
 
 @dataclass
@@ -134,6 +139,12 @@ class XLABackend(FilterBackend):
 
         opts = parse_loader_opts(props.get("custom") or "")
         self._dynamic_spatial = bool(opts.pop("dynamic_spatial", False))
+        # reference-style dedicated props override the custom= string
+        for prop, key in (("inputname", "input_names"),
+                          ("outputname", "output_names")):
+            v = props.get(prop) or ""
+            if v:
+                opts[key] = [s for s in v.split(",") if s]
         self._loader_opts = opts
         accel = props.get("accelerator") or ""
         self._device = self._pick_device(accel)
@@ -348,6 +359,8 @@ class XLABackend(FilterBackend):
     def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
         import jax
 
+        if self._bundle.host_pre is not None:
+            tensors = tuple(self._bundle.host_pre(tuple(tensors)))
         params = self._packed_params()
         if self._jitted is None:
             self._jitted = jax.jit(self._full_fn())

@@ -63,6 +63,11 @@ class TensorFilter(Element):
         "inputtype": PropDef(str, "", "override input types"),
         "output": PropDef(str, "", "override output dims"),
         "outputtype": PropDef(str, "", "override output types"),
+        # graph tensor binding for multi-node model files (reference
+        # tensorflow filter props, tensor_filter_tensorflow.cc): which
+        # graph nodes are the I/O ("," separates multiple names)
+        "inputname": PropDef(str, "", "model input node name(s)"),
+        "outputname": PropDef(str, "", "model output node name(s)"),
         "input_combination": PropDef(str, "", "sink-tensor subset, e.g. 0,2"),
         "output_combination": PropDef(str, "",
                                       "i<n>=input passthrough / o<n>=output picks"),

@@ -23,12 +23,14 @@ from nnstreamer_tpu.modelio.params_io import load_params, save_params
 from nnstreamer_tpu.modelio.tflite import lower_tflite, parse_tflite
 
 #: extensions this package can ingest → default backend
-MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla"}
+MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla", "pb": "xla"}
 
 
 def load_model_file(path: str, batch: Optional[int] = None,
                     compute_dtype: str = "bfloat16",
-                    quantize_output: bool = True):
+                    quantize_output: bool = True,
+                    input_names=None, output_names=None,
+                    sample_rate: int = 16000):
     """Load a model file into a ModelBundle (extension-dispatched)."""
     from nnstreamer_tpu.backends.xla import ModelBundle
     from nnstreamer_tpu.tensor.dtypes import DType
@@ -39,6 +41,17 @@ def load_model_file(path: str, batch: Optional[int] = None,
             f"model file {path!r} does not exist; supported formats: "
             f"{sorted(MODEL_EXTENSIONS)}")
     ext = path.rsplit(".", 1)[-1].lower() if "." in path else ""
+
+    if ext != "pb" and (input_names or output_names):
+        # fail loudly rather than silently ignoring a binding request
+        raise BackendError(
+            f"inputname/outputname bind GraphDef nodes and apply to .pb "
+            f"models only (got a .{ext} file)")
+
+    def mk(shapes, dtypes):
+        return TensorsSpec(tensors=tuple(
+            TensorInfo(shape=tuple(s), dtype=DType.from_np(d))
+            for s, d in zip(shapes, dtypes)))
 
     if ext == "tflite":
         graph = parse_tflite(path)
@@ -68,14 +81,30 @@ def load_model_file(path: str, batch: Optional[int] = None,
             lowered = lower_tflite(graph, batch=batch,
                                    compute_dtype=compute_dtype,
                                    quantize_output=quantize_output)
-        mk = lambda shapes, dtypes: TensorsSpec(tensors=tuple(
-            TensorInfo(shape=tuple(s), dtype=DType.from_np(d))
-            for s, d in zip(shapes, dtypes)))
         return ModelBundle(
             fn=lowered.fn, params=lowered.params,
             in_spec=mk(lowered.in_shapes, lowered.in_dtypes),
             out_spec=mk(lowered.out_shapes, lowered.out_dtypes),
             name=lowered.name)
+
+    if ext == "pb":
+        from nnstreamer_tpu.modelio.graphdef import (
+            lower_graphdef, parse_graphdef)
+
+        lowered = lower_graphdef(
+            parse_graphdef(path), input_names=input_names,
+            output_names=output_names, batch=batch,
+            sample_rate=sample_rate)
+        wav = getattr(lowered, "wav_input", False)
+        return ModelBundle(
+            fn=lowered.fn, params=lowered.params,
+            # wav-entry graphs take raw file bytes whose length is
+            # pipeline-declared (reference: input=1:16022 inputtype=int16)
+            in_spec=None if wav else mk(lowered.in_shapes,
+                                        lowered.in_dtypes),
+            out_spec=mk(lowered.out_shapes, lowered.out_dtypes),
+            name=os.path.basename(path),
+            host_pre=getattr(lowered, "host_pre", None))
 
     if ext == "npz":
         arch, params = load_params(path)
@@ -116,6 +145,17 @@ def parse_loader_opts(custom: str) -> Dict[str, Any]:
             # consumed by XLABackend (flexible-shape spatial bucketing),
             # not by the file loaders
             opts["dynamic_spatial"] = v.lower() in ("1", "true", "yes")
+        elif k in ("inputname", "input_names"):
+            opts["input_names"] = [s for s in v.split(";") if s]
+        elif k in ("outputname", "output_names"):
+            opts["output_names"] = [s for s in v.split(";") if s]
+        elif k == "sample_rate":
+            try:
+                opts["sample_rate"] = int(v)
+            except ValueError:
+                raise BackendError(
+                    f"custom option sample_rate={v!r} is not an "
+                    f"integer") from None
     return opts
 
 

@@ -130,6 +130,8 @@ class DeviceFeeder:
         self._lock = threading.Lock()
 
     def put(self, host_batch: Any, timeout: Optional[float] = None) -> None:
+        if self._closed:      # cheap fast path (re-checked under lock)
+            raise RuntimeError("DeviceFeeder is closed")
         if not self._slots.acquire(timeout=timeout):
             raise queue.Full("DeviceFeeder staging buffer is full")
         try:

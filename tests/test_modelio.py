@@ -351,3 +351,179 @@ def test_npz_model_file_runs_zoo_arch(tmp_path):
     ref = SingleShot(bundle).invoke(x)
     np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),
                                rtol=2e-2, atol=1e-3)
+
+
+# -- TF frozen GraphDef ingestion (graphdef.py) ------------------------------
+
+MNIST_PB = os.path.join(MODELS, "mnist.pb")
+CONV_ACTIONS_PB = os.path.join(MODELS, "conv_actions_frozen.pb")
+NINE_RAW = "/root/reference/tests/test_models/data/9.raw"
+YES_WAV = "/root/reference/tests/test_models/data/yes.wav"
+
+
+@needs_models
+def test_graphdef_mnist_digit():
+    """Reference runTest.sh case 1: 9.raw → normalize → mnist.pb
+    (inputname=input outputname=softmax) classifies digit 9."""
+    import jax
+
+    from nnstreamer_tpu.modelio.graphdef import (
+        lower_graphdef, parse_graphdef)
+
+    m = lower_graphdef(parse_graphdef(MNIST_PB), input_names=["input"],
+                       output_names=["softmax"])
+    assert m.in_shapes == [(1, 784)]
+    assert m.out_shapes == [(1, 10)]
+    raw = np.fromfile(NINE_RAW, np.uint8).astype(np.float32)
+    x = ((raw - 127.5) / 127.5).reshape(1, 784)
+    y = np.asarray(jax.jit(m.fn)(m.params, x)[0])
+    assert int(y.argmax()) == 9
+
+
+@needs_models
+def test_graphdef_mnist_golden_vs_tf():
+    tf = pytest.importorskip("tensorflow")
+    import jax
+
+    from nnstreamer_tpu.modelio.graphdef import (
+        lower_graphdef, parse_graphdef)
+
+    m = lower_graphdef(parse_graphdef(MNIST_PB), input_names=["input"],
+                       output_names=["softmax"])
+    x = np.random.RandomState(0).uniform(-1, 1, (1, 784)).astype(np.float32)
+    ours = np.asarray(jax.jit(m.fn)(m.params, x)[0])
+    gd = tf.compat.v1.GraphDef()
+    gd.ParseFromString(open(MNIST_PB, "rb").read())
+    with tf.Graph().as_default() as g:
+        tf.import_graph_def(gd, name="")
+        with tf.compat.v1.Session(graph=g) as sess:
+            ref = sess.run("softmax:0", {"input:0": x})
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+@needs_models
+def test_graphdef_speech_command_yes():
+    """Reference runTest.sh case 3: yes.wav raw bytes (int16 tensor,
+    header included) → conv_actions_frozen.pb → label 2 ('yes'). The
+    DecodeWav entry decodes host-side; spectrogram+MFCC+conv run as one
+    XLA program."""
+    import jax
+
+    from nnstreamer_tpu.modelio.graphdef import (
+        lower_graphdef, parse_graphdef)
+
+    m = lower_graphdef(parse_graphdef(CONV_ACTIONS_PB),
+                       input_names=["wav_data"],
+                       output_names=["labels_softmax"])
+    wav = open(YES_WAV, "rb").read()
+    raw = np.frombuffer(wav, np.int16)[None, :]
+    (audio,) = m.host_pre((raw,))
+    assert audio.shape == (16000, 1)
+    y = np.asarray(jax.jit(m.fn)(m.params, audio)[0])
+    assert y.shape == (1, 12)
+    assert int(y.argmax()) == 2
+
+
+@needs_models
+def test_graphdef_audio_frontend_golden_vs_tf_kernels():
+    tf = pytest.importorskip("tensorflow")
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.modelio.graphdef import (
+        audio_spectrogram, decode_wav_bytes, mfcc)
+
+    rng = np.random.default_rng(0)
+    audio = rng.normal(0, 0.1, (4000, 1)).astype(np.float32)
+    spec_tf = tf.raw_ops.AudioSpectrogram(
+        input=audio, window_size=320, stride=160,
+        magnitude_squared=True).numpy()
+    spec_us = np.asarray(audio_spectrogram(jnp, jnp.asarray(audio),
+                                           320, 160, True))
+    np.testing.assert_allclose(spec_us, spec_tf, rtol=2e-3, atol=1e-4)
+    mf_tf = tf.raw_ops.Mfcc(
+        spectrogram=spec_tf, sample_rate=16000,
+        upper_frequency_limit=4000.0, lower_frequency_limit=20.0,
+        filterbank_channel_count=40, dct_coefficient_count=13).numpy()
+    mf_us = np.asarray(mfcc(jnp, jnp.asarray(spec_tf), 16000,
+                            upper_hz=4000.0, lower_hz=20.0,
+                            fb_channels=40, dct_count=13))
+    np.testing.assert_allclose(mf_us, mf_tf, atol=0.05)
+    wav = open(YES_WAV, "rb").read()
+    a_tf = tf.raw_ops.DecodeWav(contents=wav, desired_samples=16000,
+                                desired_channels=1)
+    a_us, rate = decode_wav_bytes(wav, 16000, 1)
+    assert rate == int(a_tf.sample_rate)
+    np.testing.assert_allclose(a_us, a_tf.audio.numpy(), atol=1e-6)
+
+
+@needs_models
+def test_graphdef_pipeline_mnist():
+    """Full pipeline with the reference's property surface: inputname/
+    outputname bind graph nodes (tensor_filter_tensorflow.cc parity)."""
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=784:1 types=uint8 ! "
+        f"tensor_transform mode=arithmetic "
+        f"option=typecast:float32,add:-127.5,div:127.5 ! "
+        f"tensor_filter model={MNIST_PB} inputname=input "
+        f"outputname=softmax ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    raw = np.fromfile(NINE_RAW, np.uint8).reshape(1, 784)
+    pipe.get("src").push(TensorBuffer.of(raw))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    assert int(np.asarray(res[0].tensors[0]).argmax()) == 9
+
+
+@needs_models
+def test_graphdef_pipeline_speech_wav():
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    wav = open(YES_WAV, "rb").read()
+    n16 = len(wav) // 2
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=1:{n16} types=int16 ! "
+        f"tensor_filter model={CONV_ACTIONS_PB} inputname=wav_data "
+        f"outputname=labels_softmax ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    raw = np.frombuffer(wav[:n16 * 2], np.int16).reshape(n16, 1)
+    pipe.get("src").push(TensorBuffer.of(raw))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    assert int(np.asarray(res[0].tensors[0]).argmax()) == 2
+
+
+def test_graphdef_rejects_garbage(tmp_path):
+    from nnstreamer_tpu.modelio.graphdef import parse_graphdef
+
+    p = tmp_path / "junk.pb"
+    p.write_bytes(b"\xff\xfe definitely not a graphdef \x00\x01")
+    with pytest.raises(BackendError, match="GraphDef"):
+        parse_graphdef(str(p))
+
+
+@needs_models
+def test_graphdef_unsupported_op_fails_loudly(tmp_path):
+    """A graph containing an op outside the vocabulary must name it."""
+    import jax
+
+    from nnstreamer_tpu.modelio.graphdef import (
+        lower_graphdef, parse_graphdef)
+
+    nodes = parse_graphdef(MNIST_PB)
+    bad = [n for n in nodes]
+    bad[-3].op = "SomeExoticOp"       # the MatMul node
+    # the lowering's shape probe walks the graph, so the unsupported op
+    # is reported at load time, naming the op
+    with pytest.raises(BackendError, match="SomeExoticOp"):
+        lower_graphdef(bad, input_names=["input"],
+                       output_names=["softmax"])
