@@ -391,6 +391,11 @@ class XLABackend(FilterBackend):
         import jax
         import numpy as np_
 
+        if self._bundle.host_pre is not None:
+            raise BackendError(
+                f"model {self._bundle.name!r} has a host-side input "
+                f"stage (host_pre) which the flexible-shape path does "
+                f"not support; use the fixed-shape invoke path")
         params = self._packed_params()
         rs = [np_.asarray(r) if not hasattr(r, "shape") else r
               for r in regions]

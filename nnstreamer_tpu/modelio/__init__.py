@@ -20,7 +20,9 @@ from typing import Any, Dict, Optional
 
 from nnstreamer_tpu.core.errors import BackendError
 from nnstreamer_tpu.modelio.params_io import load_params, save_params
-from nnstreamer_tpu.modelio.tflite import lower_tflite, parse_tflite
+from nnstreamer_tpu.modelio.tflite import (
+    lower_tflite, parse_tflite, register_tflite_custom_op)
+import nnstreamer_tpu.modelio.tflite_custom  # noqa: F401 (registers ops)
 
 #: extensions this package can ingest → default backend
 MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla", "pb": "xla"}

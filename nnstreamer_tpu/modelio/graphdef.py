@@ -250,8 +250,12 @@ def decode_wav_bytes(data: bytes, desired_samples: int = -1,
         raise BackendError("DecodeWav: missing fmt/data chunk")
     x = (samples.astype(np.float32) / 32768.0).reshape(-1, channels)
     if desired_channels > 0 and x.shape[1] != desired_channels:
-        x = x[:, :desired_channels] if x.shape[1] > desired_channels \
-            else np.repeat(x, desired_channels, axis=1)
+        if x.shape[1] > desired_channels:
+            x = x[:, :desired_channels]
+        else:  # TF kernel: duplicate the last channel up to the target
+            pad = np.repeat(x[:, -1:], desired_channels - x.shape[1],
+                            axis=1)
+            x = np.concatenate([x, pad], axis=1)
     if desired_samples > 0:
         if x.shape[0] >= desired_samples:
             x = x[:desired_samples]
@@ -403,6 +407,13 @@ def lower_graphdef(nodes: Sequence[NodeDef],
         wav_entry = wn.name
         want_s = wn.attr_i("desired_samples", -1)
         want_c = wn.attr_i("desired_channels", -1)
+        if want_s <= 0:
+            raise BackendError(
+                f"DecodeWav node {wn.name!r} has no desired_samples "
+                f"attr; the XLA lowering needs a static sample count "
+                f"(re-export the graph with desired_samples set)")
+        if want_c <= 0:
+            want_c = 1
         rate_holder = {"rate": sample_rate}
 
         def host_pre(tensors):

@@ -23,7 +23,12 @@ final output is re-quantized with the stored scale/zero-point.
 Op coverage targets the reference's own test models
 (mobilenet_v2_1.0_224_quant / deeplabv3 / add: CONV_2D,
 DEPTHWISE_CONV_2D, ADD, AVERAGE_POOL_2D, RESHAPE, …) plus the common
-CNN vocabulary; unsupported ops fail loudly with the op name.
+CNN vocabulary, **control flow** (WHILE with cond/body subgraphs →
+`lax.while_loop`, covering converter-emitted LSTM/RNN loops), and
+**custom ops** via `register_tflite_custom_op` (built-in:
+`TFLite_Detection_PostProcess`, `tflite_custom.py` — the op the
+reference's query-server SSD demo model ends in). Unsupported ops fail
+loudly with the op name.
 """
 
 from __future__ import annotations
@@ -73,11 +78,14 @@ _OP_OPTIONS = 4
 # Buffer
 _BUF_DATA = 0
 
-# TensorType enum → numpy dtype
+# TensorType enum → numpy dtype. RESOURCE(13)/VARIANT(14) are opaque
+# handles (LSTM/RNN state variables); they carry no data and map to a
+# placeholder dtype — ops that consume them handle the state explicitly.
 _TENSOR_TYPES: Dict[int, np.dtype] = {
     0: np.dtype(np.float32), 1: np.dtype(np.float16), 2: np.dtype(np.int32),
     3: np.dtype(np.uint8), 4: np.dtype(np.int64), 6: np.dtype(np.bool_),
     7: np.dtype(np.int16), 9: np.dtype(np.int8), 10: np.dtype(np.float64),
+    13: np.dtype(np.int32), 14: np.dtype(np.int32),
 }
 
 # BuiltinOperator enum values used below
@@ -85,13 +93,63 @@ OP = dict(
     ADD=0, AVERAGE_POOL_2D=1, CONCATENATION=2, CONV_2D=3,
     DEPTHWISE_CONV_2D=4, DEQUANTIZE=6, FULLY_CONNECTED=9, LOGISTIC=14,
     MAX_POOL_2D=17, MUL=18, RELU=19, RELU6=21, RESHAPE=22,
-    RESIZE_BILINEAR=23, SOFTMAX=25, TANH=28, PAD=34, TRANSPOSE=39,
+    RESIZE_BILINEAR=23, SOFTMAX=25, TANH=28, PAD=34, GATHER=36,
+    TRANSPOSE=39,
     MEAN=40, SUB=41, DIV=42, SQUEEZE=43, STRIDED_SLICE=45,
-    LOG_SOFTMAX=50, MAXIMUM=55, ARG_MAX=56, MINIMUM=57, SLICE=65,
-    EXPAND_DIMS=70, SUM=74, PACK=83, LEAKY_RELU=98, ABS=101,
+    SPLIT=49, LOG_SOFTMAX=50, MAXIMUM=55, ARG_MAX=56, MINIMUM=57,
+    LESS=58, GREATER=61, GREATER_EQUAL=62, LESS_EQUAL=63, SLICE=65,
+    EXPAND_DIMS=70, EQUAL=71, SUM=74, PACK=83, LOGICAL_AND=86,
+    LEAKY_RELU=98, ABS=101,
     RESIZE_NEAREST_NEIGHBOR=97, HARD_SWISH=117, QUANTIZE=114,
+    WHILE=119, BATCH_MATMUL=126,
 )
 _OP_NAMES = {v: k for k, v in OP.items()}
+
+#: custom-op registry: name → fn(op, inputs_tuple, opts_dict, jnp) →
+#: tuple of outputs. Register via `register_tflite_custom_op`.
+TFLITE_CUSTOM_OPS: Dict[str, Callable] = {}
+
+
+def register_tflite_custom_op(name: str):
+    """Decorator registering a jax lowering for a TFLite custom op (the
+    subplugin-style extension point the reference exposes through each
+    NN framework's own custom-op resolver)."""
+    def deco(fn):
+        TFLITE_CUSTOM_OPS[name] = fn
+        return fn
+    return deco
+
+
+def _decode_flexbuffer_map(data: bytes) -> Dict[str, Any]:
+    """Custom-op options arrive as a FlexBuffers map (converter
+    convention); decode to a plain dict. Undecodable options raise —
+    running a custom op with default options would be silently wrong."""
+    if not data:
+        return {}
+    try:
+        from flatbuffers import flexbuffers
+
+        root = flexbuffers.GetRoot(bytearray(data))
+        if not root.IsMap:
+            raise ValueError("custom_options root is not a map")
+        m = root.AsMap
+        out: Dict[str, Any] = {}
+        for key in m.Keys:
+            k = key.AsKey
+            v = m[k]
+            if v.IsBool:
+                out[k] = v.AsBool
+            elif v.IsInt:
+                out[k] = v.AsInt
+            elif v.IsFloat:
+                out[k] = v.AsFloat
+            elif v.IsString:
+                out[k] = v.AsString
+        return out
+    except Exception as e:
+        raise BackendError(
+            f"undecodable TFLite custom_options ({e}); cannot run the "
+            f"custom op with defaults") from None
 
 # ActivationFunctionType
 _ACT_NONE, _ACT_RELU, _ACT_RELU_N1_1, _ACT_RELU6 = 0, 1, 2, 3
@@ -124,20 +182,39 @@ class OpDef:
     outputs: List[int]
     opts: Optional[int]          # options table position in the flatbuffer
     custom_name: Optional[str] = None
+    custom_options: bytes = b""
+
+
+@dataclass
+class Subgraph:
+    """One TFLite subgraph (main graph or a control-flow body)."""
+
+    tensors: List[TensorDef]
+    ops: List[OpDef]
+    inputs: List[int]
+    outputs: List[int]
 
 
 @dataclass
 class TFLiteGraph:
     reader: Reader
-    tensors: List[TensorDef]
-    ops: List[OpDef]
+    tensors: List[TensorDef]     # = subgraphs[0].tensors
+    ops: List[OpDef]             # = subgraphs[0].ops
     inputs: List[int]
     outputs: List[int]
     path: str = ""
+    subgraphs: List[Subgraph] = field(default_factory=list)
+
+
+# Operator.custom_options (schema field id 5)
+_OP_CUSTOM_OPTIONS = 5
 
 
 def parse_tflite(path: str) -> TFLiteGraph:
-    """Parse a .tflite flatbuffer into a graph description (host-side)."""
+    """Parse a .tflite flatbuffer into a graph description (host-side).
+
+    All subgraphs are parsed — control-flow ops (WHILE) reference the
+    extra subgraphs as their cond/body."""
     with open(path, "rb") as f:
         buf = f.read()
     if len(buf) < 8 or buf[4:8] != b"TFL3":
@@ -155,57 +232,71 @@ def parse_tflite(path: str) -> TFLiteGraph:
         codes.append((max(dep, full), r.field_string(oc, _OPCODE_CUSTOM)))
 
     buffers = r.field_vec_tables(model, _MODEL_BUFFERS)
-    subgraphs = r.field_vec_tables(model, _MODEL_SUBGRAPHS)
-    if not subgraphs:
+    raw_subgraphs = r.field_vec_tables(model, _MODEL_SUBGRAPHS)
+    if not raw_subgraphs:
         raise BackendError(f"{path!r}: no subgraphs")
-    sg = subgraphs[0]
 
-    tensors: List[TensorDef] = []
-    for i, tpos in enumerate(r.field_vec_tables(sg, _SG_TENSORS)):
-        shape_v = r.field_vec_scalars(tpos, _T_SHAPE, np.int32)
-        shape = tuple(int(d) for d in shape_v) if shape_v is not None else ()
-        ttype = r.field_scalar(tpos, _T_TYPE, "<b", 0)
-        dtype = _TENSOR_TYPES.get(ttype)
-        if dtype is None:
-            raise BackendError(
-                f"{path!r}: tensor {i} has unsupported TensorType {ttype}")
-        buf_idx = r.field_scalar(tpos, _T_BUFFER, "<I", 0)
-        data = None
-        if buf_idx and buf_idx < len(buffers):
-            raw = r.field_vec_scalars(buffers[buf_idx], _BUF_DATA, np.uint8)
-            if raw is not None and raw.size:
-                data = raw.view(dtype).reshape(shape if shape else (-1,))
-        scale = zp = None
-        qdim = 0
-        q = r.field_table(tpos, _T_QUANT)
-        if q is not None:
-            scale = r.field_vec_scalars(q, _Q_SCALE, np.float32)
-            zp = r.field_vec_scalars(q, _Q_ZERO_POINT, np.int64)
-            qdim = r.field_scalar(q, _Q_QUANTIZED_DIM, "<i", 0)
-        tensors.append(TensorDef(
-            index=i, shape=shape, dtype=dtype,
-            name=r.field_string(tpos, _T_NAME) or f"t{i}",
-            buffer=data, scale=scale, zero_point=zp, qdim=qdim))
+    def parse_sg(sg) -> Subgraph:
+        tensors: List[TensorDef] = []
+        for i, tpos in enumerate(r.field_vec_tables(sg, _SG_TENSORS)):
+            shape_v = r.field_vec_scalars(tpos, _T_SHAPE, np.int32)
+            shape = tuple(int(d) for d in shape_v) \
+                if shape_v is not None else ()
+            ttype = r.field_scalar(tpos, _T_TYPE, "<b", 0)
+            dtype = _TENSOR_TYPES.get(ttype)
+            if dtype is None:
+                raise BackendError(
+                    f"{path!r}: tensor {i} has unsupported TensorType "
+                    f"{ttype}")
+            buf_idx = r.field_scalar(tpos, _T_BUFFER, "<I", 0)
+            data = None
+            if buf_idx and buf_idx < len(buffers):
+                raw = r.field_vec_scalars(buffers[buf_idx], _BUF_DATA,
+                                          np.uint8)
+                if raw is not None and raw.size:
+                    data = raw.view(dtype).reshape(
+                        shape if shape else (-1,))
+            scale = zp = None
+            qdim = 0
+            q = r.field_table(tpos, _T_QUANT)
+            if q is not None:
+                scale = r.field_vec_scalars(q, _Q_SCALE, np.float32)
+                zp = r.field_vec_scalars(q, _Q_ZERO_POINT, np.int64)
+                qdim = r.field_scalar(q, _Q_QUANTIZED_DIM, "<i", 0)
+            tensors.append(TensorDef(
+                index=i, shape=shape, dtype=dtype,
+                name=r.field_string(tpos, _T_NAME) or f"t{i}",
+                buffer=data, scale=scale, zero_point=zp, qdim=qdim))
 
-    ops: List[OpDef] = []
-    for opos in r.field_vec_tables(sg, _SG_OPERATORS):
-        idx = r.field_scalar(opos, _OP_OPCODE_INDEX, "<I", 0)
-        code, custom = codes[idx]
-        ins = r.field_vec_scalars(opos, _OP_INPUTS, np.int32)
-        outs = r.field_vec_scalars(opos, _OP_OUTPUTS, np.int32)
-        ops.append(OpDef(
-            code=code, name=_OP_NAMES.get(code, f"builtin_{code}"),
-            inputs=[int(x) for x in (ins if ins is not None else [])],
-            outputs=[int(x) for x in (outs if outs is not None else [])],
-            opts=r.field_table(opos, _OP_OPTIONS), custom_name=custom))
+        ops: List[OpDef] = []
+        for opos in r.field_vec_tables(sg, _SG_OPERATORS):
+            idx = r.field_scalar(opos, _OP_OPCODE_INDEX, "<I", 0)
+            code, custom = codes[idx]
+            ins = r.field_vec_scalars(opos, _OP_INPUTS, np.int32)
+            outs = r.field_vec_scalars(opos, _OP_OUTPUTS, np.int32)
+            copts = r.field_vec_scalars(opos, _OP_CUSTOM_OPTIONS, np.uint8)
+            ops.append(OpDef(
+                code=code, name=_OP_NAMES.get(code, f"builtin_{code}"),
+                inputs=[int(x) for x in (ins if ins is not None else [])],
+                outputs=[int(x) for x in
+                         (outs if outs is not None else [])],
+                opts=r.field_table(opos, _OP_OPTIONS), custom_name=custom,
+                custom_options=(copts.tobytes()
+                                if copts is not None else b"")))
 
-    g_in = r.field_vec_scalars(sg, _SG_INPUTS, np.int32)
-    g_out = r.field_vec_scalars(sg, _SG_OUTPUTS, np.int32)
+        g_in = r.field_vec_scalars(sg, _SG_INPUTS, np.int32)
+        g_out = r.field_vec_scalars(sg, _SG_OUTPUTS, np.int32)
+        return Subgraph(
+            tensors=tensors, ops=ops,
+            inputs=[int(x) for x in (g_in if g_in is not None else [])],
+            outputs=[int(x) for x in (g_out if g_out is not None else [])])
+
+    sgs = [parse_sg(sg) for sg in raw_subgraphs]
+    main = sgs[0]
     return TFLiteGraph(
-        reader=r, tensors=tensors, ops=ops,
-        inputs=[int(x) for x in (g_in if g_in is not None else [])],
-        outputs=[int(x) for x in (g_out if g_out is not None else [])],
-        path=path)
+        reader=r, tensors=main.tensors, ops=main.ops,
+        inputs=main.inputs, outputs=main.outputs,
+        path=path, subgraphs=sgs)
 
 
 def _is_float(dtype) -> bool:
@@ -274,31 +365,70 @@ def lower_tflite(graph: TFLiteGraph, batch: Optional[int] = None,
             return (batch,) + shape[1:]
         return shape
 
-    # params: all dequantized / raw constants, keyed by tensor index.
+    # params: all dequantized / raw constants, keyed by (subgraph, index).
     # Shape-only constants (reshape targets, pad widths, reduce axes) stay
     # host-side: they must be static at trace time.
+    subgraphs = graph.subgraphs or [Subgraph(
+        tensors=graph.tensors, ops=graph.ops,
+        inputs=graph.inputs, outputs=graph.outputs)]
     params: Dict[str, Any] = {}
-    static_consts: Dict[int, np.ndarray] = {}
-    consumed_as_static = _static_input_indices(graph)
-    for t in graph.tensors:
-        if t.buffer is None:
-            continue
-        if t.index in consumed_as_static:
-            static_consts[t.index] = np.asarray(t.buffer)
-            continue
-        arr = _dequantize_const(t) if t.quantized else np.asarray(t.buffer)
-        params[f"t{t.index}"] = arr
+    static_by_sg: List[Dict[int, np.ndarray]] = []
+    for si, sg in enumerate(subgraphs):
+        static_consts: Dict[int, np.ndarray] = {}
+        consumed_as_static = _static_input_indices(sg)
+        for t in sg.tensors:
+            if t.buffer is None:
+                continue
+            if t.index in consumed_as_static:
+                # shape/axis constants must be host-side at trace time —
+                # but the same tensor may ALSO feed a runtime op input
+                # (e.g. a scalar used as both SPLIT axis and ADD step),
+                # so it stays available as a param too
+                static_consts[t.index] = np.asarray(t.buffer)
+            arr = _dequantize_const(t) if t.quantized \
+                else np.asarray(t.buffer)
+            params[_pkey(si, t.index)] = arr
+        static_by_sg.append(static_consts)
 
     cdt = jnp.dtype(compute_dtype)
-    ops = list(graph.ops)
     tensors = graph.tensors
+
+    def run_sg(si: int, p, in_vals: Tuple) -> Tuple:
+        """Evaluate one subgraph given its input values (used for the
+        main graph and recursively for WHILE cond/body graphs)."""
+        sg = subgraphs[si]
+        vals: Dict[int, Any] = dict(zip(sg.inputs, in_vals))
+
+        def get(i):
+            if i in vals:
+                return vals[i]
+            key = _pkey(si, i)
+            if key in p:
+                arr = jnp.asarray(p[key])
+                return arr.astype(cdt) if _is_float(arr.dtype) else arr
+            raise BackendError(
+                f"op input tensor {i} ({sg.tensors[i].name!r}) has no "
+                f"value (dynamic graph order not supported)")
+
+        ctx = dict(run_sg=lambda si2, c: run_sg(si2, p, c), sg_index=si)
+        for op in sg.ops:
+            out = _eval_op(graph, sg, op, get, static_by_sg[si], jnp,
+                           cdt, ctx)
+            outs = out if isinstance(out, tuple) else (out,)
+            for oi, o in zip(op.outputs, outs):
+                ot = sg.tensors[oi]
+                if ot.quantized and _is_float(o.dtype):
+                    lo, hi = _qrange(ot)
+                    o = jnp.clip(o, lo, hi)
+                vals[oi] = o
+        return tuple(vals[i] for i in sg.outputs)
 
     def fn(p, *inputs):
         if len(inputs) != len(graph.inputs):
             raise BackendError(
                 f"model {graph.path!r} expects {len(graph.inputs)} inputs, "
                 f"got {len(inputs)}")
-        vals: Dict[int, Any] = {}
+        staged = []
         for idx, x in zip(graph.inputs, inputs):
             t = tensors[idx]
             x = jnp.asarray(x)
@@ -306,33 +436,12 @@ def lower_tflite(graph: TFLiteGraph, batch: Optional[int] = None,
                 s = float(t.scale[0])
                 z = float(t.zero_point[0]) if t.zero_point is not None else 0.0
                 x = (x.astype(jnp.float32) - z) * s
-            vals[idx] = x.astype(cdt) if _is_float(x.dtype) else x
-
-        def get(i):
-            if i in vals:
-                return vals[i]
-            key = f"t{i}"
-            if key in p:
-                arr = jnp.asarray(p[key])
-                return arr.astype(cdt) if _is_float(arr.dtype) else arr
-            raise BackendError(
-                f"op input tensor {i} ({tensors[i].name!r}) has no value "
-                f"(dynamic graph order not supported)")
-
-        for op in ops:
-            out = _eval_op(graph, op, get, static_consts, jnp, cdt)
-            outs = out if isinstance(out, tuple) else (out,)
-            for oi, o in zip(op.outputs, outs):
-                ot = tensors[oi]
-                if ot.quantized and _is_float(o.dtype):
-                    lo, hi = _qrange(ot)
-                    o = jnp.clip(o, lo, hi)
-                vals[oi] = o
+            staged.append(x.astype(cdt) if _is_float(x.dtype) else x)
+        outs = run_sg(0, p, tuple(staged))
 
         results = []
-        for idx in graph.outputs:
+        for idx, y in zip(graph.outputs, outs):
             t = tensors[idx]
-            y = vals[idx]
             if t.quantized and quantize_output:
                 s = float(t.scale[0])
                 z = float(t.zero_point[0]) if t.zero_point is not None else 0.0
@@ -359,8 +468,15 @@ def lower_tflite(graph: TFLiteGraph, batch: Optional[int] = None,
         name=os.path.basename(graph.path))
 
 
-def _static_input_indices(graph: TFLiteGraph) -> set:
-    """Tensor indices consumed as static shape/axis/padding arguments."""
+def _pkey(si: int, idx: int) -> str:
+    """Params-dict key for tensor `idx` of subgraph `si` (subgraph 0
+    keeps the historical bare key)."""
+    return f"t{idx}" if si == 0 else f"s{si}t{idx}"
+
+
+def _static_input_indices(graph) -> set:
+    """Tensor indices consumed as static shape/axis/padding arguments
+    (accepts a TFLiteGraph or a Subgraph)."""
     static = set()
     for op in graph.ops:
         ins = op.inputs
@@ -381,6 +497,8 @@ def _static_input_indices(graph: TFLiteGraph) -> set:
             static.add(ins[1])
         elif op.code in (OP["SLICE"], OP["STRIDED_SLICE"]):
             static.update(ins[1:])
+        elif op.code == OP["SPLIT"] and len(ins) > 1:
+            static.add(ins[0])          # axis
     return static
 
 
@@ -449,13 +567,27 @@ def _pad_str(padding: int) -> str:
     return "SAME" if padding == _PAD_SAME else "VALID"
 
 
-def _eval_op(graph: TFLiteGraph, op: OpDef, get, static_consts, jnp, cdt):
+def _eval_op(graph: TFLiteGraph, sg: "Subgraph", op: OpDef, get,
+             static_consts, jnp, cdt, ctx=None):
     import jax
     from jax import lax
 
     r = graph.reader
     o = op.opts
     code = op.code
+    tensors = sg.tensors
+    ctx = ctx or {}
+
+    if op.custom_name:
+        impl = TFLITE_CUSTOM_OPS.get(op.custom_name)
+        if impl is None:
+            raise BackendError(
+                f"TFLite custom op {op.custom_name!r} in {graph.path!r} "
+                f"has no registered lowering; register one with "
+                f"modelio.tflite.register_tflite_custom_op")
+        opts = _decode_flexbuffer_map(op.custom_options)
+        return impl(op, tuple(get(i) for i in op.inputs if i >= 0),
+                    opts, jnp)
 
     def opt_i(fid, default=0):
         return r.field_scalar(o, fid, "<i", default) if o is not None \
@@ -472,7 +604,7 @@ def _eval_op(graph: TFLiteGraph, op: OpDef, get, static_consts, jnp, cdt):
     def static(i):
         if i in static_consts:
             return static_consts[i]
-        t = graph.tensors[i]
+        t = tensors[i]
         if t.buffer is not None:
             return np.asarray(t.buffer)
         raise BackendError(
@@ -554,7 +686,7 @@ def _eval_op(graph: TFLiteGraph, op: OpDef, get, static_consts, jnp, cdt):
         else:
             shape = [int(d) for d in
                      (r.field_vec_scalars(o, 0, np.int32) or [])]
-        out_t = graph.tensors[op.outputs[0]]
+        out_t = tensors[op.outputs[0]]
         if len(shape) == len(out_t.shape) and shape and \
                 x.shape[0] != shape[0] and shape[0] == out_t.shape[0]:
             shape[0] = -1          # batch-override: keep runtime batch
@@ -643,7 +775,7 @@ def _eval_op(graph: TFLiteGraph, op: OpDef, get, static_consts, jnp, cdt):
     if code == OP["ARG_MAX"]:
         x = get(op.inputs[0])
         axis = int(static(op.inputs[1]).ravel()[0])
-        out_dt = graph.tensors[op.outputs[0]].dtype
+        out_dt = tensors[op.outputs[0]].dtype
         return jnp.argmax(x, axis=axis).astype(out_dt)
 
     if code == OP["SLICE"]:
@@ -657,6 +789,87 @@ def _eval_op(graph: TFLiteGraph, op: OpDef, get, static_consts, jnp, cdt):
     if code == OP["PACK"]:
         axis = opt_i(1, 0)
         return jnp.stack([get(i) for i in op.inputs], axis=axis)
+
+    if code == OP["STRIDED_SLICE"]:
+        x = get(op.inputs[0])
+        begin = [int(v) for v in static(op.inputs[1]).ravel()]
+        end = [int(v) for v in static(op.inputs[2]).ravel()]
+        strides = [int(v) for v in static(op.inputs[3]).ravel()]
+        bm, em = opt_i(0, 0), opt_i(1, 0)
+        ellipsis_m, new_axis_m = opt_i(2, 0), opt_i(3, 0)
+        shrink_m = opt_i(4, 0)
+        if ellipsis_m or new_axis_m:
+            raise BackendError(
+                "STRIDED_SLICE ellipsis/new-axis masks not supported")
+        idx = []
+        shrink_axes = []
+        for i in range(len(begin)):
+            if shrink_m & (1 << i):
+                b = begin[i]
+                idx.append(slice(b, None if b == -1 else b + 1, 1))
+                shrink_axes.append(i)
+                continue
+            b = None if bm & (1 << i) else begin[i]
+            e = None if em & (1 << i) else end[i]
+            idx.append(slice(b, e, strides[i]))
+        y = x[tuple(idx)]
+        if shrink_axes:
+            y = jnp.squeeze(y, axis=tuple(shrink_axes))
+        return y
+
+    if code == OP["GATHER"]:
+        x = get(op.inputs[0])
+        idx = get(op.inputs[1])
+        axis = opt_i(0, 0)
+        return jnp.take(x, idx, axis=axis)
+
+    if code == OP["SPLIT"]:
+        axis = int(static(op.inputs[0]).ravel()[0])
+        x = get(op.inputs[1])
+        n = opt_i(0, len(op.outputs)) or len(op.outputs)
+        return tuple(jnp.split(x, n, axis=axis))
+
+    if code in (OP["LESS"], OP["GREATER"], OP["GREATER_EQUAL"],
+                OP["LESS_EQUAL"], OP["EQUAL"]):
+        a, b = get(op.inputs[0]), get(op.inputs[1])
+        f = {OP["LESS"]: jnp.less, OP["GREATER"]: jnp.greater,
+             OP["GREATER_EQUAL"]: jnp.greater_equal,
+             OP["LESS_EQUAL"]: jnp.less_equal, OP["EQUAL"]: jnp.equal}[code]
+        return f(a, b)
+
+    if code == OP["LOGICAL_AND"]:
+        return jnp.logical_and(get(op.inputs[0]), get(op.inputs[1]))
+
+    if code == OP["BATCH_MATMUL"]:
+        a, b = get(op.inputs[0]), get(op.inputs[1])
+        if opt_b(0):                       # adj_x
+            a = jnp.swapaxes(a, -1, -2)
+        if opt_b(1):                       # adj_y
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32) \
+            .astype(a.dtype) if _is_float(a.dtype) else jnp.matmul(a, b)
+
+    if code == OP["WHILE"]:
+        # WhileOptions: cond_subgraph_index=0, body_subgraph_index=1.
+        # Subgraph evaluation comes through ctx["run_sg"]; the loop
+        # carry is the op's full input tuple (TFLite guarantees matched
+        # shapes/dtypes between body inputs and outputs).
+        run = ctx.get("run_sg")
+        if run is None:
+            raise BackendError(
+                "WHILE op encountered without subgraph context")
+        cond_idx = opt_i(0, 0)
+        body_idx = opt_i(1, 0)
+        carry = tuple(get(i) for i in op.inputs)
+
+        def cond_fn(c):
+            out = run(cond_idx, c)
+            return jnp.reshape(out[0], ()).astype(jnp.bool_)
+
+        def body_fn(c):
+            return tuple(run(body_idx, c))
+
+        return tuple(jax.lax.while_loop(cond_fn, body_fn, carry))
 
     raise BackendError(
         f"TFLite op {op.name} (builtin code {code}"

@@ -74,6 +74,8 @@ def quantized_graph_supported(graph: TFLiteGraph) -> bool:
     to the float lowering)."""
     from nnstreamer_tpu.modelio.tflite import _static_input_indices
 
+    if len(graph.subgraphs) > 1:     # control-flow models → float path
+        return False
     static = _static_input_indices(graph)
     for op in graph.ops:
         if op.code not in _QOPS:

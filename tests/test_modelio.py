@@ -527,3 +527,204 @@ def test_graphdef_unsupported_op_fails_loudly(tmp_path):
     with pytest.raises(BackendError, match="SomeExoticOp"):
         lower_graphdef(bad, input_names=["input"],
                        output_names=["softmax"])
+
+
+# -- converter-built models: custom detection op + control-flow LSTM ---------
+
+@pytest.fixture(scope="module")
+def built_models(tmp_path_factory):
+    """Tiny models built in-test with the TF converter (VERDICT r2 next
+    #3): a detection head ending in the TFLite_Detection_PostProcess
+    CUSTOM op (the reference query-server demo's model shape) and a
+    keras LSTM (converts to a WHILE control-flow graph)."""
+    tf = pytest.importorskip("tensorflow")
+    d = tmp_path_factory.mktemp("built_tflite")
+
+    # detection: frozen GraphDef with the custom op, TF1-style convert
+    N, C = 96, 4
+    gd = tf.compat.v1.GraphDef()
+
+    def node(name, op, inputs=(), **attrs):
+        n = gd.node.add()
+        n.name = name
+        n.op = op
+        n.input.extend(inputs)
+        for k, v in attrs.items():
+            if isinstance(v, bool):
+                n.attr[k].b = v
+            elif isinstance(v, int):
+                n.attr[k].i = v
+            elif isinstance(v, float):
+                n.attr[k].f = v
+            elif isinstance(v, np.ndarray):
+                n.attr[k].tensor.CopyFrom(tf.make_tensor_proto(v))
+        n.attr.get_or_create("T")
+        return n
+
+    pl = node("box_encodings", "Placeholder")
+    pl.attr["dtype"].type = tf.float32.as_datatype_enum
+    pl.attr["shape"].shape.CopyFrom(tf.TensorShape((1, N, 4)).as_proto())
+    pl2 = node("class_predictions", "Placeholder")
+    pl2.attr["dtype"].type = tf.float32.as_datatype_enum
+    pl2.attr["shape"].shape.CopyFrom(
+        tf.TensorShape((1, N, C + 1)).as_proto())
+    rng = np.random.default_rng(0)
+    anch = np.concatenate([rng.uniform(0.1, 0.9, (N, 2)),
+                           rng.uniform(0.1, 0.3, (N, 2))],
+                          axis=1).astype(np.float32)
+    cn = node("anchors", "Const", value=anch)
+    cn.attr["dtype"].type = tf.float32.as_datatype_enum
+    node("TFLite_Detection_PostProcess", "TFLite_Detection_PostProcess",
+         ["box_encodings", "class_predictions", "anchors"],
+         max_detections=10, max_classes_per_detection=1,
+         nms_score_threshold=0.3, nms_iou_threshold=0.5, num_classes=C,
+         y_scale=10.0, x_scale=10.0, h_scale=5.0, w_scale=5.0,
+         use_regular_nms=False, detections_per_class=100)
+    pb = d / "detect.pb"
+    pb.write_bytes(gd.SerializeToString())
+    conv = tf.compat.v1.lite.TFLiteConverter.from_frozen_graph(
+        str(pb), ["box_encodings", "class_predictions"],
+        ["TFLite_Detection_PostProcess", "TFLite_Detection_PostProcess:1",
+         "TFLite_Detection_PostProcess:2",
+         "TFLite_Detection_PostProcess:3"],
+        input_shapes={"box_encodings": [1, N, 4],
+                      "class_predictions": [1, N, C + 1]})
+    conv.allow_custom_ops = True
+    det = d / "detect.tflite"
+    det.write_bytes(conv.convert())
+
+    # LSTM: keras → WHILE-loop tflite (frozen consts)
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((8, 6), batch_size=1),
+        tf.keras.layers.LSTM(5, return_sequences=False),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    f = tf.function(lambda x: m(x),
+                    input_signature=[tf.TensorSpec((1, 8, 6), tf.float32)])
+    frozen = convert_variables_to_constants_v2(f.get_concrete_function())
+    c2 = tf.lite.TFLiteConverter.from_concrete_functions([frozen], m)
+    lstm = d / "lstm.tflite"
+    lstm.write_bytes(c2.convert())
+    return {"detect": str(det), "lstm": str(lstm), "anchors": anch,
+            "N": N, "C": C}
+
+
+def _detection_case(N, C, n_objects, seed):
+    rng = np.random.default_rng(seed)
+    be = rng.normal(0, 0.5, (1, N, 4)).astype(np.float32)
+    sc = rng.uniform(0, 0.25, (1, N, C + 1)).astype(np.float32)
+    for i in rng.choice(N, n_objects, replace=False):
+        sc[0, i, rng.integers(1, C + 1)] = rng.uniform(0.6, 0.99)
+    return be, sc
+
+
+def test_detection_postprocess_custom_op_golden(built_models):
+    """Importer vs interpreter on the custom-op model: identical
+    detections (count, boxes, classes, scores)."""
+    tf = pytest.importorskip("tensorflow")
+    import jax
+
+    m = load_model_file(built_models["detect"], compute_dtype="float32")
+    interp = tf.lite.Interpreter(model_path=built_models["detect"])
+    interp.allocate_tensors()
+    ids = interp.get_input_details()
+    ods = interp.get_output_details()
+    fn = jax.jit(m.fn)
+    for trial in range(4):
+        be, sc = _detection_case(built_models["N"], built_models["C"],
+                                 6, 10 + trial)
+        interp.set_tensor(ids[0]["index"], be)
+        interp.set_tensor(ids[1]["index"], sc)
+        interp.invoke()
+        ref = [interp.get_tensor(dd["index"]) for dd in ods]
+        ours = [np.asarray(t) for t in fn(m.params, be, sc)]
+        nd = int(ref[3][0])
+        assert int(ours[3][0]) == nd
+        np.testing.assert_allclose(ours[0][0][:nd], ref[0][0][:nd],
+                                   atol=1e-4)
+        np.testing.assert_array_equal(ours[1][0][:nd], ref[1][0][:nd])
+        np.testing.assert_allclose(ours[2][0][:nd], ref[2][0][:nd],
+                                   atol=1e-5)
+
+
+def test_lstm_while_loop_golden(built_models):
+    """Control-flow TFLite (WHILE + cond/body subgraphs + GATHER/SPLIT/
+    STRIDED_SLICE) matches the interpreter."""
+    tf = pytest.importorskip("tensorflow")
+    import jax
+
+    m = load_model_file(built_models["lstm"], compute_dtype="float32")
+    g = parse_tflite(built_models["lstm"])
+    assert len(g.subgraphs) == 3          # main + while cond + body
+    interp = tf.lite.Interpreter(model_path=built_models["lstm"])
+    interp.allocate_tensors()
+    x = np.random.default_rng(5).normal(0, 1, (1, 8, 6)).astype(np.float32)
+    interp.set_tensor(interp.get_input_details()[0]["index"], x)
+    interp.invoke()
+    ref = interp.get_tensor(interp.get_output_details()[0]["index"])
+    ours = np.asarray(jax.jit(m.fn)(m.params, x)[0])
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_detect_decode_pipeline_correct_boxes(built_models):
+    """Real detect→decode pipeline: the custom-op model's detections
+    flow through tensor_decoder mode=bounding_boxes (postprocess
+    scheme) and come out as the same boxes the interpreter finds."""
+    tf = pytest.importorskip("tensorflow")
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    N, C = built_models["N"], built_models["C"]
+    be, sc = _detection_case(N, C, 5, 99)
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=4:{N}:1,{C + 1}:{N}:1 "
+        f"types=float32,float32 ! "
+        f"tensor_filter model={built_models['detect']} "
+        f"custom=dtype=float32 ! "
+        f"tensor_decoder mode=bounding_boxes "
+        f"option1=mobilenet-ssd-postprocess option3=0.5:0.5 "
+        f"option4=200:200 ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    pipe.get("src").push(TensorBuffer.of(be, sc))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    got = res[0].meta["boxes"]            # (K,6) output-pixel coords
+
+    interp = tf.lite.Interpreter(model_path=built_models["detect"])
+    interp.allocate_tensors()
+    ids = interp.get_input_details()
+    ods = interp.get_output_details()
+    interp.set_tensor(ids[0]["index"], be)
+    interp.set_tensor(ids[1]["index"], sc)
+    interp.invoke()
+    rb = interp.get_tensor(ods[0]["index"])[0]
+    rs = interp.get_tensor(ods[2]["index"])[0]
+    nd = int(interp.get_tensor(ods[3]["index"])[0])
+    keep = rs[:nd] >= 0.5
+    exp = rb[:nd][keep] * 200.0           # expected pixel boxes
+    assert len(got) == keep.sum()
+    np.testing.assert_allclose(
+        np.sort(got[:, :4], axis=0), np.sort(exp, axis=0), atol=0.05)
+
+
+def test_custom_op_unregistered_fails_loudly(built_models, tmp_path):
+    from nnstreamer_tpu.modelio.tflite import TFLITE_CUSTOM_OPS
+
+    saved = TFLITE_CUSTOM_OPS.pop("TFLite_Detection_PostProcess")
+    try:
+        import jax
+
+        m = load_model_file(built_models["detect"],
+                            compute_dtype="float32")
+        be, sc = _detection_case(built_models["N"], built_models["C"],
+                                 2, 1)
+        with pytest.raises(BackendError, match="no registered lowering"):
+            jax.eval_shape(m.fn, m.params, be, sc)
+    finally:
+        TFLITE_CUSTOM_OPS["TFLite_Detection_PostProcess"] = saved
