@@ -80,7 +80,8 @@ def main(argv=None) -> int:
 
         broker = EdgeBroker(args.bind, args.broker)
         print(f"edge broker listening on {args.bind}:{broker.port} "
-              f"(^C to stop)", file=sys.stderr)
+              f"(mqtt 3.1.1 on :{broker.mqtt_port}; ^C to stop)",
+              file=sys.stderr)
         try:
             while True:
                 time.sleep(3600)

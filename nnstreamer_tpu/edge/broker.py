@@ -74,20 +74,37 @@ def unpack_publish(payload: bytes) -> Tuple[str, int, bytes]:
 
 class EdgeBroker:
     """The broker service. Threading: MsgServer owns the sockets; all
-    state mutations run on reader threads under one lock."""
+    state mutations run on reader threads under one lock.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    With `mqtt_port` set (0 = auto-pick), a second listener speaks real
+    MQTT 3.1.1 (edge/mqtt_wire.py): stock clients (paho, mosquitto_sub)
+    CONNECT/SUBSCRIBE/PUBLISH against it, and topics bridge both ways
+    between the MQTT domain and the edge-protocol pub/sub domain —
+    reference parity with gst/mqtt's any-broker interop
+    (`mqttcommon.h:43-63`) without requiring an external daemon."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 mqtt_port: Optional[int] = 0):
         self._lock = threading.Lock()
         self._registry: Dict[str, dict] = {}          # name → {host,port,owner}
         self._subs: Dict[str, Set[P.Connection]] = {}  # topic → conns
         self._server = P.MsgServer(
             host, port, on_message=self._on_message,
             on_disconnect=self._on_disconnect)
-        log.info("edge broker on %s:%d", host, self._server.port)
+        self._mqtt = None
+        if mqtt_port is not None:
+            self._mqtt = _MqttListener(self, host, mqtt_port)
+        log.info("edge broker on %s:%d (mqtt: %s)", host,
+                 self._server.port,
+                 self._mqtt.port if self._mqtt else "off")
 
     @property
     def port(self) -> int:
         return self._server.port
+
+    @property
+    def mqtt_port(self) -> Optional[int]:
+        return self._mqtt.port if self._mqtt else None
 
     # -- dispatch ----------------------------------------------------------
     def _on_message(self, conn: P.Connection, mtype: int,
@@ -134,7 +151,7 @@ class EdgeBroker:
             with self._lock:
                 self._subs.setdefault(topic, set()).add(conn)
         elif mtype == T_PUBLISH:
-            topic, _, _ = unpack_publish(payload)
+            topic, _, frame = unpack_publish(payload)
             with self._lock:
                 targets = list(self._subs.get(topic, ()))
             for sub in targets:
@@ -144,6 +161,9 @@ class EdgeBroker:
                     sub.send(T_PUBLISH, payload)
                 except OSError:
                     pass   # reader thread will reap it
+            # bridge into the MQTT domain (payload = the bare frame)
+            if self._mqtt is not None:
+                self._mqtt.fanout(topic, frame, exclude=None)
         else:
             log.warning("broker: unknown message type %d", mtype)
 
@@ -183,8 +203,207 @@ class EdgeBroker:
             return {n: (e["host"], e["port"])
                     for n, e in self._registry.items()}
 
+    def _publish_from_mqtt(self, topic: str, frame: bytes) -> None:
+        """Bridge an MQTT-side PUBLISH into edge-protocol subscribers."""
+        payload = pack_publish(topic, time.time_ns(), frame)
+        with self._lock:
+            targets = list(self._subs.get(topic, ()))
+        for sub in targets:
+            try:
+                sub.send(T_PUBLISH, payload)
+            except OSError:
+                pass
+
     def close(self) -> None:
+        if self._mqtt is not None:
+            self._mqtt.close()
         self._server.close()
+
+
+class _MqttListener:
+    """Minimal MQTT 3.1.1 broker listener bridged to the EdgeBroker's
+    topic space. QoS 0/1 (QoS 1 acks immediately: at-most-once delivery
+    to subscribers, like the reference's default sink QoS), wildcard
+    filters (+/#), keepalive via PINGREQ/PINGRESP."""
+
+    def __init__(self, broker: "EdgeBroker", host: str, port: int):
+        import socket as _socket
+
+        from nnstreamer_tpu.edge import mqtt_wire as M
+
+        self._M = M
+        self._broker = broker
+        self._lock = threading.Lock()
+        self._conns: Dict[int, dict] = {}    # id → {sock, filters, lock}
+        self._next_id = 0
+        self._closing = False
+        self._srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(32)
+        self.port = self._srv.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="mqtt-accept", daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        import queue as _q
+
+        while not self._closing:
+            try:
+                sock, addr = self._srv.accept()
+            except OSError:
+                return
+            with self._lock:
+                cid = self._next_id
+                self._next_id += 1
+                # outbound frames go through a bounded queue + writer
+                # thread so a stalled subscriber can NEVER block the
+                # publishing thread (which may be an edge-protocol
+                # reader via the topic bridge); overflow drops frames —
+                # QoS 0 delivery semantics
+                self._conns[cid] = dict(sock=sock, filters=[],
+                                        outq=_q.Queue(maxsize=256),
+                                        client_id="")
+            threading.Thread(target=self._reader, args=(cid, sock),
+                             name=f"mqtt-conn-{cid}", daemon=True).start()
+            threading.Thread(target=self._writer, args=(cid, sock),
+                             name=f"mqtt-send-{cid}", daemon=True).start()
+
+    def _writer(self, cid: int, sock) -> None:
+        import queue as _q
+
+        with self._lock:
+            ent = self._conns.get(cid)
+        if ent is None:
+            return
+        outq = ent["outq"]
+        try:
+            while True:
+                data = outq.get()
+                if data is None:
+                    return
+                sock.sendall(data)
+        except OSError:
+            pass
+
+    def _send(self, cid: int, data: bytes) -> None:
+        import queue as _q
+
+        with self._lock:
+            ent = self._conns.get(cid)
+        if ent is None:
+            return
+        try:
+            ent["outq"].put_nowait(data)
+        except _q.Full:
+            log.warning("mqtt conn %d: send queue full, dropping frame",
+                        cid)
+
+    def _reader(self, cid: int, sock) -> None:
+        M = self._M
+        split = M.PacketSplitter()
+        connected = False
+        try:
+            while True:
+                data = sock.recv(1 << 16)
+                if not data:
+                    return
+                for p in split.feed(data):
+                    if p.ptype == M.CONNECT:
+                        client_id, _ka, _clean = M.parse_connect(p)
+                        with self._lock:
+                            if cid in self._conns:
+                                self._conns[cid]["client_id"] = client_id
+                        self._send(cid, M.encode_connack(False,
+                                                         M.CONNACK_ACCEPTED))
+                        connected = True
+                    elif not connected:
+                        log.warning("mqtt: packet %d before CONNECT",
+                                    p.ptype)
+                        return
+                    elif p.ptype == M.SUBSCRIBE:
+                        pid, topics = M.parse_subscribe(p)
+                        with self._lock:
+                            ent = self._conns.get(cid)
+                            if ent is not None:
+                                ent["filters"].extend(
+                                    t for t, _q in topics)
+                        self._send(cid, M.encode_suback(
+                            pid, [min(q, 1) for _t, q in topics]))
+                    elif p.ptype == M.UNSUBSCRIBE:
+                        pid, topics = M.parse_unsubscribe(p)
+                        with self._lock:
+                            ent = self._conns.get(cid)
+                            if ent is not None:
+                                ent["filters"] = [
+                                    f for f in ent["filters"]
+                                    if f not in topics]
+                        self._send(cid, M.encode_unsuback(pid))
+                    elif p.ptype == M.PUBLISH:
+                        M.parse_publish(p)
+                        if p.qos == 1:
+                            self._send(cid, M.encode_puback(p.packet_id))
+                        # MQTT 3.1.1 has no no-local option: a client
+                        # subscribed to its own publish topic gets the
+                        # echo, exactly like a stock broker
+                        self.fanout(p.topic, p.payload, exclude=None)
+                        self._broker._publish_from_mqtt(p.topic, p.payload)
+                    elif p.ptype == M.PINGREQ:
+                        self._send(cid, M.encode_pingresp())
+                    elif p.ptype == M.PUBACK:
+                        pass                      # QoS1 publisher ack
+                    elif p.ptype == M.DISCONNECT:
+                        return
+                    else:
+                        log.warning("mqtt: unsupported packet type %d",
+                                    p.ptype)
+        except (StreamError, OSError, UnicodeDecodeError, struct.error,
+                IndexError, ValueError) as e:
+            # truncated/garbage packets from an open network port must
+            # log one line, never kill the thread with a traceback
+            log.warning("mqtt conn %d: %s: %s", cid, type(e).__name__, e)
+        finally:
+            with self._lock:
+                ent = self._conns.pop(cid, None)
+            if ent is not None:
+                try:
+                    ent["outq"].put_nowait(None)   # stop the writer
+                except Exception:
+                    pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def fanout(self, topic: str, payload: bytes,
+               exclude: Optional[int]) -> None:
+        M = self._M
+        with self._lock:
+            targets = [(cid, ent) for cid, ent in self._conns.items()
+                       if cid != exclude
+                       and any(M.topic_matches(f, topic)
+                               for f in ent["filters"])]
+        if not targets:
+            return
+        pkt = M.encode_publish(topic, payload, qos=0)
+        for cid, _ent in targets:
+            self._send(cid, pkt)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            socks = [e["sock"] for e in self._conns.values()]
+            self._conns.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class BrokerClient:

@@ -39,7 +39,24 @@ def _broker_props():
         "host": PropDef(str, "127.0.0.1", "broker host"),
         "port": PropDef(int, None, "broker port (required)"),
         "topic": PropDef(str, None, "topic (required)"),
+        # protocol=mqtt speaks real MQTT 3.1.1 (edge/mqtt_wire.py) so a
+        # STOCK broker (mosquitto, EMQX, EdgeBroker's MQTT listener)
+        # carries the stream — full wire parity with the reference's
+        # paho-based gst/mqtt. protocol=edge uses the EdgeBroker native
+        # protocol, which adds the broker-time PTS rebase (sync=broker).
+        "protocol": PropDef(str, "edge", "edge|mqtt wire protocol"),
+        "qos": PropDef(int, 0, "MQTT QoS for publishes (0|1)"),
     }
+
+
+def _check_protocol(name, props):
+    if props["protocol"] not in ("edge", "mqtt"):
+        raise PipelineError(
+            f"{name}: protocol= must be edge|mqtt, got "
+            f"{props['protocol']!r}")
+    if props["qos"] not in (0, 1):
+        raise PipelineError(
+            f"{name}: qos= must be 0|1, got {props['qos']!r}")
 
 
 @register_element("mqttsink")
@@ -55,15 +72,31 @@ class MqttSink(SinkElement):
         if self.props["port"] is None or not self.props["topic"]:
             raise PipelineError(
                 f"{self.name}: port= (broker) and topic= are required")
+        _check_protocol(self.name, self.props)
         self._bc: Optional[BrokerClient] = None
+        self._mc = None                      # MqttClient (protocol=mqtt)
 
     def start(self) -> None:
+        if self.props["protocol"] == "mqtt":
+            from nnstreamer_tpu.edge.mqtt_wire import MqttClient
+
+            self._mc = MqttClient(self.props["host"], self.props["port"],
+                                  client_id=f"nns-{self.name}")
+            return
         self._bc = BrokerClient(self.props["host"], self.props["port"])
         # one clock sync up front; frames stamp broker_now from it
         off = self._bc.clock_offset_ns()
         log.info("%s: broker clock offset %+d us", self.name, off // 1000)
 
     def render(self, buf: TensorBuffer) -> None:
+        if self._mc is not None:
+            if not self._mc.alive:
+                raise StreamError(
+                    f"{self.name}: MQTT connection lost (topic "
+                    f"{self.props['topic']!r})")
+            self._mc.publish(self.props["topic"], encode_buffer(buf),
+                             qos=self.props["qos"])
+            return
         if not self._bc.alive:
             raise StreamError(
                 f"{self.name}: broker connection lost (topic "
@@ -74,6 +107,9 @@ class MqttSink(SinkElement):
         if self._bc is not None:
             self._bc.close()
             self._bc = None
+        if self._mc is not None:
+            self._mc.close()
+            self._mc = None
 
 
 @register_element("mqttsrc")
@@ -106,6 +142,14 @@ class MqttSrc(SourceElement):
             raise PipelineError(
                 f"{self.name}: sync= must be none|broker, got "
                 f"{self.props['sync']!r}")
+        _check_protocol(self.name, self.props)
+        if self.props["protocol"] == "mqtt" and \
+                self.props["sync"] == "broker":
+            raise PipelineError(
+                f"{self.name}: sync=broker needs the broker-time stamps "
+                f"of protocol=edge (stock MQTT has no shared clock; the "
+                f"reference runs an external NTP daemon for this)")
+        self._mc = None                      # MqttClient (protocol=mqtt)
         self._bc: Optional[BrokerClient] = None
         self._q: _queue.Queue = _queue.Queue(maxsize=self.props["queue_size"])
         self._stop = threading.Event()
@@ -134,7 +178,23 @@ class MqttSrc(SourceElement):
             except (_queue.Empty, _queue.Full):
                 pass
 
+    def _on_mqtt_frame(self, _topic: str, payload: bytes) -> None:
+        # stock-MQTT path: no publish-time stamp on the wire; frames
+        # keep the sender's PTS from the wire frame itself
+        self._on_frame(0, payload)
+
     def _ensure_connected(self) -> None:
+        if self.props["protocol"] == "mqtt":
+            if self._mc is None:
+                from nnstreamer_tpu.edge.mqtt_wire import MqttClient
+
+                self._mc = MqttClient(
+                    self.props["host"], self.props["port"],
+                    client_id=f"nns-{self.name}")
+                self._mc.subscribe(self.props["topic"],
+                                   self._on_mqtt_frame,
+                                   qos=self.props["qos"])
+            return
         if self._bc is None:
             self._bc = BrokerClient(self.props["host"], self.props["port"])
             # no clock exchange here: PTS rebasing reads the *publish*
@@ -170,6 +230,10 @@ class MqttSrc(SourceElement):
                     raise StreamError(
                         f"{self.name}: broker connection lost (topic "
                         f"{self.props['topic']!r})")
+                if self._mc is not None and not self._mc.alive:
+                    raise StreamError(
+                        f"{self.name}: MQTT connection lost (topic "
+                        f"{self.props['topic']!r})")
                 continue
             yield buf
 
@@ -181,3 +245,6 @@ class MqttSrc(SourceElement):
         if self._bc is not None:
             self._bc.close()
             self._bc = None
+        if self._mc is not None:
+            self._mc.close()
+            self._mc = None

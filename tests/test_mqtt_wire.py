@@ -1,0 +1,268 @@
+"""MQTT 3.1.1 wire protocol tests (VERDICT r2 next #6).
+
+Three layers: frame-level spec vectors (encodings match the OASIS
+3.1.1 byte layout), an external raw-socket MQTT client against the
+EdgeBroker's MQTT listener (stands in for a stock paho client — paho is
+not installed in this image), and the mqttsink/mqttsrc pipeline path in
+protocol=mqtt mode, including the MQTT↔edge-protocol topic bridge.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.edge import mqtt_wire as M
+from nnstreamer_tpu.edge.broker import BrokerClient, EdgeBroker
+
+
+# -- spec vectors -----------------------------------------------------------
+
+def test_remaining_length_vectors():
+    # §2.2.3 table: 0, 127 → 1 byte; 128, 16383 → 2; 16384 → 3
+    assert M._encode_remaining(0) == b"\x00"
+    assert M._encode_remaining(127) == b"\x7f"
+    assert M._encode_remaining(128) == b"\x80\x01"
+    assert M._encode_remaining(16383) == b"\xff\x7f"
+    assert M._encode_remaining(16384) == b"\x80\x80\x01"
+    assert M._encode_remaining(268_435_455) == b"\xff\xff\xff\x7f"
+    for n in (0, 1, 127, 128, 16383, 16384, 2_097_151, 268_435_455):
+        enc = M._encode_remaining(n)
+        assert M.decode_remaining(b"\x00" + enc, 1) == (n, len(enc))
+    with pytest.raises(StreamError):
+        M._encode_remaining(268_435_456)
+    with pytest.raises(StreamError):
+        M.decode_remaining(b"\x80\x80\x80\x80\x01", 0)
+
+
+def test_connect_packet_layout():
+    pkt = M.encode_connect("cid", keepalive=60, clean_session=True)
+    # fixed header: type 1 << 4, then remaining length
+    assert pkt[0] == 0x10
+    body = pkt[2:]
+    # variable header: len(4) "MQTT" level=4 flags=0x02 keepalive=60
+    assert body[:6] == b"\x00\x04MQTT"
+    assert body[6] == 4
+    assert body[7] == 0x02
+    assert body[8:10] == struct.pack(">H", 60)
+    assert body[10:] == b"\x00\x03cid"
+    (p,) = M.PacketSplitter().feed(pkt)
+    cid, ka, clean = M.parse_connect(p)
+    assert (cid, ka, clean) == ("cid", 60, True)
+
+
+def test_publish_roundtrip_qos0_and_qos1():
+    pkt = M.encode_publish("a/b", b"payload", qos=0)
+    assert pkt[0] == 0x30
+    (p,) = M.PacketSplitter().feed(pkt)
+    M.parse_publish(p)
+    assert (p.topic, p.payload, p.qos) == ("a/b", b"payload", 0)
+
+    pkt1 = M.encode_publish("t", b"x" * 300, qos=1, packet_id=7)
+    assert pkt1[0] == 0x32                    # qos1 flag
+    (p1,) = M.PacketSplitter().feed(pkt1)
+    M.parse_publish(p1)
+    assert (p1.topic, p1.packet_id, p1.qos) == ("t", 7, 1)
+    assert p1.payload == b"x" * 300
+
+
+def test_subscribe_suback_layout():
+    pkt = M.encode_subscribe(5, [("sensors/+/temp", 1), ("all/#", 0)])
+    assert pkt[0] == 0x82                     # type 8 | reserved 0x02
+    (p,) = M.PacketSplitter().feed(pkt)
+    pid, topics = M.parse_subscribe(p)
+    assert pid == 5
+    assert topics == [("sensors/+/temp", 1), ("all/#", 0)]
+    sub = M.encode_suback(5, [1, 0])
+    (ps,) = M.PacketSplitter().feed(sub)
+    assert ps.ptype == M.SUBACK and ps.body == b"\x00\x05\x01\x00"
+
+
+def test_splitter_handles_fragmentation_and_coalescing():
+    frames = (M.encode_publish("t", b"A" * 1000) + M.encode_pingreq()
+              + M.encode_publish("u", b"B"))
+    split = M.PacketSplitter()
+    got = []
+    for i in range(0, len(frames), 7):        # drip-feed 7-byte chunks
+        got.extend(split.feed(frames[i:i + 7]))
+    assert [p.ptype for p in got] == [M.PUBLISH, M.PINGREQ, M.PUBLISH]
+    assert M.parse_publish(got[0]).payload == b"A" * 1000
+
+
+def test_topic_matches():
+    assert M.topic_matches("a/b", "a/b")
+    assert not M.topic_matches("a/b", "a/c")
+    assert M.topic_matches("a/+", "a/b")
+    assert not M.topic_matches("a/+", "a/b/c")
+    assert M.topic_matches("a/#", "a/b/c")
+    assert M.topic_matches("#", "anything/at/all")
+    assert not M.topic_matches("a/b/#", "a")
+
+
+# -- external raw-socket client vs the EdgeBroker MQTT listener -------------
+
+class _RawMqtt:
+    """Stands in for an unmodified external client (paho analog)."""
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=5)
+        self.split = M.PacketSplitter()
+        self.inbox = []
+
+    def send(self, data):
+        self.sock.sendall(data)
+
+    def expect(self, ptype, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            for i, p in enumerate(self.inbox):
+                if p.ptype == ptype:
+                    return self.inbox.pop(i)
+            self.sock.settimeout(max(deadline - time.monotonic(), 0.01))
+            data = self.sock.recv(1 << 16)
+            if not data:
+                raise AssertionError("connection closed")
+            self.inbox.extend(self.split.feed(data))
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture()
+def broker():
+    b = EdgeBroker(port=0, mqtt_port=0)
+    yield b
+    b.close()
+
+
+def test_external_mqtt_client_roundtrip(broker):
+    """CONNECT → SUBSCRIBE → (second client) PUBLISH → receive."""
+    sub = _RawMqtt(broker.mqtt_port)
+    sub.send(M.encode_connect("ext-sub"))
+    ack = sub.expect(M.CONNACK)
+    assert ack.body[1] == M.CONNACK_ACCEPTED
+    sub.send(M.encode_subscribe(1, [("demo/frames", 0)]))
+    sa = sub.expect(M.SUBACK)
+    assert sa.body[:2] == b"\x00\x01"
+
+    pub = _RawMqtt(broker.mqtt_port)
+    pub.send(M.encode_connect("ext-pub"))
+    pub.expect(M.CONNACK)
+    pub.send(M.encode_publish("demo/frames", b"hello tensor", qos=1,
+                              packet_id=9))
+    pa = pub.expect(M.PUBACK)
+    assert pa.body == b"\x00\x09"
+
+    got = sub.expect(M.PUBLISH)
+    M.parse_publish(got)
+    assert got.topic == "demo/frames" and got.payload == b"hello tensor"
+    # keepalive works
+    sub.send(M.encode_pingreq())
+    sub.expect(M.PINGRESP)
+    sub.close()
+    pub.close()
+
+
+def test_mqtt_wildcard_subscription(broker):
+    sub = _RawMqtt(broker.mqtt_port)
+    sub.send(M.encode_connect("w"))
+    sub.expect(M.CONNACK)
+    sub.send(M.encode_subscribe(2, [("sensors/#", 0)]))
+    sub.expect(M.SUBACK)
+    pub = _RawMqtt(broker.mqtt_port)
+    pub.send(M.encode_connect("p"))
+    pub.expect(M.CONNACK)
+    pub.send(M.encode_publish("sensors/cam0/frames", b"F"))
+    got = sub.expect(M.PUBLISH)
+    M.parse_publish(got)
+    assert got.topic == "sensors/cam0/frames"
+    sub.close()
+    pub.close()
+
+
+def test_packet_before_connect_is_rejected(broker):
+    c = _RawMqtt(broker.mqtt_port)
+    c.send(M.encode_publish("t", b"x"))       # no CONNECT first
+    # listener drops the connection
+    c.sock.settimeout(5)
+    assert c.sock.recv(100) == b""
+
+
+def test_mqtt_bridges_to_edge_protocol(broker):
+    """A stock-MQTT publish reaches edge-protocol subscribers and
+    vice versa (one topic space across both domains)."""
+    got = []
+    evt = threading.Event()
+    bc = BrokerClient("127.0.0.1", broker.port)
+    bc.subscribe("bridge/t", lambda ns, frame: (got.append(frame),
+                                                evt.set()))
+    time.sleep(0.1)
+    pub = _RawMqtt(broker.mqtt_port)
+    pub.send(M.encode_connect("b"))
+    pub.expect(M.CONNACK)
+    pub.send(M.encode_publish("bridge/t", b"from-mqtt"))
+    assert evt.wait(5)
+    assert got == [b"from-mqtt"]
+
+    # reverse: edge publish → mqtt subscriber
+    sub = _RawMqtt(broker.mqtt_port)
+    sub.send(M.encode_connect("s"))
+    sub.expect(M.CONNACK)
+    sub.send(M.encode_subscribe(3, [("bridge/u", 0)]))
+    sub.expect(M.SUBACK)
+    bc.publish("bridge/u", b"from-edge")
+    gp = sub.expect(M.PUBLISH)
+    M.parse_publish(gp)
+    assert gp.payload == b"from-edge"
+    bc.close()
+    pub.close()
+    sub.close()
+
+
+# -- pipeline path: mqttsink/mqttsrc protocol=mqtt --------------------------
+
+def test_mqtt_pipeline_roundtrip(broker):
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    recv = nns.parse_launch(
+        f"mqttsrc name=src protocol=mqtt port={broker.mqtt_port} "
+        f"topic=pipe/t dims=4:1 types=float32 ! tensor_sink name=out")
+    rr = nns.PipelineRunner(recv).start()
+    send = nns.parse_launch(
+        f"appsrc name=in dims=4:1 types=float32 ! "
+        f"mqttsink protocol=mqtt qos=1 port={broker.mqtt_port} "
+        f"topic=pipe/t")
+    rs = nns.PipelineRunner(send).start()
+    time.sleep(0.3)                          # subscriber attach
+    x = np.arange(4, dtype=np.float32).reshape(1, 4)
+    for i in range(3):
+        send.get("in").push(TensorBuffer.of(x + i, pts=i))
+    send.get("in").end()
+    rs.wait(30)
+    deadline = time.monotonic() + 15
+    sink = recv.get("out")
+    while len(sink.results) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    recv.get("src").interrupt()
+    rr.stop()
+    rs.stop()
+    assert len(sink.results) == 3
+    np.testing.assert_array_equal(
+        np.asarray(sink.results[2].tensors[0]), x + 2)
+    assert sink.results[2].pts == 2          # sender PTS travels
+
+
+def test_mqtt_src_rejects_broker_sync():
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.core.errors import PipelineError
+
+    with pytest.raises(PipelineError, match="sync=broker"):
+        nns.parse_launch(
+            "mqttsrc protocol=mqtt sync=broker port=1 topic=t "
+            "dims=1 types=uint8 ! tensor_sink")
