@@ -55,9 +55,15 @@ def _block_attn(q, k, v, m_prev, l_prev, o_prev, mask=None):
 
 
 def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
-                   causal: bool = False, block_impl: str = "auto"):
+                   causal: bool = False, block_impl: str = "auto",
+                   batch_axis: str = None):
     """Sequence-parallel attention. q/k/v: (B, S, H, D) with S sharded
     over `axis`; returns (B, S, H, D) with the same sharding.
+
+    `batch_axis` composes sequence parallelism with data parallelism:
+    B additionally shards over that mesh axis (each dp group runs its
+    own independent ring over `axis`) — the dp×sp layout of a composed
+    dp×tp×sp mesh. None keeps B replicated within the shard_map.
 
     block_impl picks the per-rotation block math: "pallas" runs each
     incoming K/V block through the flash_block_update kernel (MXU
@@ -72,7 +78,8 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
         and s_local % 128 == 0)
     if use_pallas:
         return _ring_attention_pallas(q, k, v, mesh=mesh, axis=axis,
-                                      causal=causal, n=n)
+                                      causal=causal, n=n,
+                                      batch_axis=batch_axis)
 
     def local(q, k, v):
         # q/k/v here: the per-device shard (B, S/n, H, D)
@@ -113,7 +120,7 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
         out = o / l.transpose(0, 2, 1)[..., None]
         return out.astype(q.dtype)
 
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
     return shard_map(
         local, mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -122,7 +129,8 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis: str = "sp",
     )(q, k, v)
 
 
-def _ring_attention_pallas(q, k, v, *, mesh, axis, causal, n):
+def _ring_attention_pallas(q, k, v, *, mesh, axis, causal, n,
+                           batch_axis=None):
     """Ring rotation with the Pallas flash block kernel doing each
     device's attend step (backends/pallas_ops.flash_block_update)."""
     from nnstreamer_tpu.backends.pallas_ops import (
@@ -160,7 +168,7 @@ def _ring_attention_pallas(q, k, v, *, mesh, axis, causal, n):
         out = flash_carry_finalize(l, acc)
         return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
     return shard_map(
         local, mesh=mesh,
         in_specs=(spec, spec, spec),
