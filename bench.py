@@ -402,15 +402,16 @@ def _build_composite():
 
 
 def offload_bench(n_frames=None, n_lat=None):
-    """BASELINE row 5: edge offload. A client pipeline ships frames to a
-    loopback query server running MobileNet, results route back per
-    client id. Open-loop FPS + closed-loop p50/p99 like the other
-    configs; the per-frame wire encode/decode makes this an honest
-    host-path measurement (the reference's tensor_query shape)."""
+    """BASELINE row 5: edge offload. Frames from FOUR concurrent client
+    pipelines ship to one loopback BatchedQueryServer (MeshDispatcher
+    coalesces all clients' frames into dp-sharded batches — SURVEY §3.4
+    north star; the reference round-trips one frame per request,
+    tensor_query_client.c:657-699). Reports aggregate open-loop FPS over
+    all clients + closed-loop p50/p99 on a strict single client."""
     import numpy as np
 
     import nnstreamer_tpu as nns
-    from nnstreamer_tpu.edge import QueryServer
+    from nnstreamer_tpu.edge import BatchedQueryServer, QueryServer
     from nnstreamer_tpu.tensor.buffer import TensorBuffer
 
     on_tpu = _on_tpu()
@@ -419,53 +420,85 @@ def offload_bench(n_frames=None, n_lat=None):
     if n_lat is None:
         n_lat = 24 if on_tpu else 3
     QueryServer.reset_all()
-    server = nns.parse_launch(
-        "tensor_query_serversrc name=ssrc id=9 dims=3:224:224:1 "
-        "types=uint8 port=0 ! "
-        "tensor_transform mode=arithmetic option=" + NORMALIZE_OPT + " ! "
-        "tensor_filter model=zoo://mobilenet_v2 ! "
-        "tensor_query_serversink id=9")
-    srunner = nns.PipelineRunner(server).start()
-    port = server.get("ssrc").port
+
+    def normalize(x):
+        import jax.numpy as jnp
+
+        return (x.astype(jnp.float32) - 127.5) / 127.5
+
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    bqs = BatchedQueryServer(
+        "zoo://mobilenet_v2", sid=9, port=0, bucket=8, max_delay_ms=3.0,
+        pre=normalize,
+        in_spec=TensorsSpec.of(TensorInfo((1, 224, 224, 3), DType.UINT8)))
+    port = bqs.port
     frame = np.random.default_rng(0).integers(0, 256, (1, 224, 224, 3),
                                               np.uint8)
 
     def wait(runner, sink, target, timeout=600.0, poll=0.002):
         t0 = time.perf_counter()
         while len(sink.results) < target:
-            for rn in (runner, srunner):
-                if rn._error is not None:
-                    raise RuntimeError(
-                        f"offload pipeline failed: {rn._error}"
-                    ) from rn._error
+            if runner._error is not None:
+                raise RuntimeError(
+                    f"offload pipeline failed: {runner._error}"
+                ) from runner._error
+            if bqs.error is not None:
+                raise RuntimeError(
+                    f"offload server dispatch failed: {bqs.error}"
+                ) from bqs.error
             if time.perf_counter() - t0 > timeout:
                 raise RuntimeError(
                     f"offload stalled at {len(sink.results)}/{target}")
             time.sleep(poll)
 
-    r1 = r2 = None
+    n_clients = 4
+    runners = []
+    r2 = None
     try:
-        # open-loop throughput with a PIPELINED client (max_in_flight=8:
-        # network+server latency overlaps across frames — the batched-
-        # dispatch upgrade over the reference's per-frame sync). Replies
-        # drain on later process() calls and at EOS flush, so all frames
-        # are pushed up front and the post-warmup segment is timed.
-        warm = 4
-        c1 = nns.parse_launch(
-            f"appsrc name=src dims=3:224:224:1 types=uint8 ! "
-            f"tensor_query_client port={port} timeout=120 "
-            f"max_in_flight=8 ! tensor_sink name=sink")
-        r1 = nns.PipelineRunner(c1).start()
-        src, sink = c1.get("src"), c1.get("sink")
-        for i in range(warm + n_frames):
-            src.push(TensorBuffer.of(frame, pts=i))
-        src.end()
-        wait(r1, sink, warm)             # compile + ramp complete
+        # dispatcher-only ceiling FIRST (tunnel convention: pure-compute
+        # measurements before anything that does per-frame host reads,
+        # which degrade subsequent dispatch in-process)
+        d = bqs.dispatcher
+        direct = np.random.default_rng(1).integers(
+            0, 256, (224, 224, 3), np.uint8)
+        d.infer(direct)
+        nd = 96 if on_tpu else 8
         t0 = time.perf_counter()
-        wait(r1, sink, warm + n_frames)
-        fps = n_frames / (time.perf_counter() - t0)
-        r1.wait(60)
-        r1.stop()
+        futs = [d.submit(direct) for _ in range(nd)]
+        for f in futs:
+            f.result(120)
+        dispatch_fps = nd / (time.perf_counter() - t0)
+        st0 = bqs.stats()              # snapshot: isolate the 4-client
+                                       # phase's coalescing statistics
+
+        # aggregate open-loop throughput: 4 concurrent pipelined clients
+        # (max_in_flight=8 each) — the server coalesces their frames
+        # into shared batches
+        warm = 4
+        clients = []
+        for c in range(n_clients):
+            cp = nns.parse_launch(
+                f"appsrc name=src dims=3:224:224:1 types=uint8 ! "
+                f"tensor_query_client port={port} timeout=120 "
+                f"max_in_flight=8 ! tensor_sink name=sink")
+            runners.append(nns.PipelineRunner(cp).start())
+            clients.append(cp)
+        for c, cp in enumerate(clients):
+            for i in range(warm + n_frames):
+                cp.get("src").push(TensorBuffer.of(frame, pts=i))
+            cp.get("src").end()
+        for rn, cp in zip(runners, clients):
+            wait(rn, cp.get("sink"), warm)    # compile + ramp complete
+        t0 = time.perf_counter()
+        for rn, cp in zip(runners, clients):
+            wait(rn, cp.get("sink"), warm + n_frames)
+        fps = n_clients * n_frames / (time.perf_counter() - t0)
+        st1 = bqs.stats()              # end of the 4-client phase
+        for rn in runners:
+            rn.wait(60)
+            rn.stop()
 
         # closed-loop latency with the reference-semantics client
         # (max_in_flight=1: push -> block for the reply)
@@ -486,17 +519,21 @@ def offload_bench(n_frames=None, n_lat=None):
         r2.wait(60)
         r2.stop()
         return {"fps": round(fps, 2),
+                "dispatch_fps": round(dispatch_fps, 2),
                 "p50_ms": round(_percentile(lats, 50), 3),
-                "p99_ms": round(_percentile(lats, 99), 3)}
+                "p99_ms": round(_percentile(lats, 99), 3),
+                "clients": n_clients,
+                "frames_per_batch": round(
+                    (st1["frames"] - st0["frames"])
+                    / max(st1["batches"] - st0["batches"], 1), 2)}
     finally:
-        for rn in (r1, r2):      # dead clients must not keep threads
-            if rn is not None:   # blocked on 120s reply timeouts
+        for rn in runners + [r2]:   # dead clients must not keep threads
+            if rn is not None:      # blocked on 120s reply timeouts
                 try:
                     rn.stop()
                 except Exception:
                     pass
-        server.get("ssrc").interrupt()
-        srunner.stop()
+        bqs.close()
         QueryServer.reset_all()
 
 

@@ -18,8 +18,8 @@ Modules:
 
 from nnstreamer_tpu.edge.broker import BrokerClient, EdgeBroker
 from nnstreamer_tpu.edge.query import (
-    QueryServer, TensorQueryClient, TensorQueryServerSink,
-    TensorQueryServerSrc)
+    BatchedQueryServer, QueryServer, TensorQueryClient,
+    TensorQueryServerSink, TensorQueryServerSrc)
 from nnstreamer_tpu.edge.pubsub import EdgeSink, EdgeSrc
 from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
 
@@ -28,6 +28,7 @@ __all__ = [
     "EdgeBroker",
     "EdgeSink",
     "EdgeSrc",
+    "BatchedQueryServer",
     "QueryServer",
     "TensorQueryClient",
     "TensorQueryServerSink",

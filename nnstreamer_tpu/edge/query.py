@@ -406,3 +406,128 @@ class TensorQueryClient(Element):
         # unblock a thread parked in _take_reply: teardown must not wait
         # out the full reply timeout for frames that will never answer
         self._replies.put(_STOPPED)
+
+
+class BatchedQueryServer:
+    """Offload serving with batch coalescing — MeshDispatcher wired into
+    the query transport (the SURVEY §3.4 north star, VERDICT r2 #9).
+
+    The element pipeline form (serversrc ! filter ! serversink) processes
+    one frame per pass; this server instead feeds every arriving client
+    frame straight into a `parallel.dispatch.MeshDispatcher`, which packs
+    frames from ALL connected clients into dp-sharded batches (padded to
+    one static bucket → a single compilation) and resolves each client's
+    reply from its row of the batch. Wire format, HELLO caps handshake
+    and per-client result routing are identical to the pipeline form, so
+    unmodified tensor_query_client pipelines work against it.
+
+    model: a ModelBundle, "zoo://name", or a model file path (modelio).
+    pre: optional jax-traceable per-batch preprocess (e.g. uint8
+    normalize) traced into the same XLA program as the model.
+
+    One drain thread feeds the dispatcher so each client's frames enter
+    batches in arrival order — the client contract is ordered replies
+    (TensorQueryClient enforces the pts sequence). A frame whose
+    dispatch fails gets no reply (the client's per-frame timeout
+    applies); the failure is kept on `.error` for supervisors.
+    """
+
+    def __init__(self, model, *, sid: int = 0, host: str = "127.0.0.1",
+                 port: int = 0, mesh=None, bucket: int = 8,
+                 max_delay_ms: float = 2.0, pre=None,
+                 in_spec: Optional[TensorsSpec] = None):
+        import jax
+
+        from nnstreamer_tpu.backends.xla import XLABackend
+        from nnstreamer_tpu.parallel.dispatch import MeshDispatcher
+        from nnstreamer_tpu.parallel.mesh import MeshSpec, make_mesh
+
+        bundle = XLABackend()._resolve(model)
+        if mesh is None:
+            n = len(jax.devices())
+            dp = n if bucket % n == 0 else 1
+            mesh = make_mesh(MeshSpec(dp=dp, tp=1, sp=1),
+                             jax.devices()[:dp])
+        params = jax.device_put(bundle.params) \
+            if bundle.params is not None else None
+
+        model_fn = bundle.fn
+
+        def fn(p, x):
+            if pre is not None:
+                x = pre(x)
+            out = model_fn(p, x)
+            return out if isinstance(out, tuple) else (out,)
+
+        self.dispatcher = MeshDispatcher(fn, params, mesh, bucket=bucket,
+                                         max_delay_ms=max_delay_ms)
+        # the dispatcher hands back per-frame rows (batch dim stripped);
+        # the wire contract is the model's out_spec — restore a leading
+        # batch=1 dim where the spec declares one
+        self._lead1 = [t.shape and t.shape[0] == 1
+                       for t in bundle.out_spec.tensors] \
+            if bundle.out_spec else []
+        self.qs = QueryServer.get(sid)
+        # in_spec override: when `pre` changes the wire dtype (e.g.
+        # uint8 camera frames normalized on-device), the HELLO contract
+        # is the PRE-transform spec, not the model's
+        self.qs.in_spec = in_spec if in_spec is not None \
+            else bundle.in_spec
+        self.qs.out_spec = bundle.out_spec
+        self.qs.start(host, port)
+        self._stop = threading.Event()
+        self.error: Optional[Exception] = None
+        # exactly ONE drainer: a second thread could swap the order of a
+        # client's consecutive frames between queue-get and submit,
+        # desyncing its ordered reply stream
+        self._drainers = [
+            threading.Thread(target=self._drain, name="batched-query",
+                             daemon=True)
+        ]
+        for t in self._drainers:
+            t.start()
+
+    @property
+    def port(self) -> int:
+        return self.qs.server.port
+
+    def _drain(self) -> None:
+        while not self._stop.is_set():
+            try:
+                buf = self.qs.frames.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            cid = buf.meta.get("client_id", 0)
+            pts = buf.pts
+            try:
+                fut = self.dispatcher.submit(buf.tensors[0])
+            except StreamError as e:
+                log.warning("batched query: submit failed: %s", e)
+                continue
+
+            def done(f, cid=cid, pts=pts):
+                try:
+                    outs = f.result()
+                except Exception as e:
+                    log.warning("batched query: dispatch failed for "
+                                "client %d: %s", cid, e)
+                    self.error = e
+                    return
+                outs = tuple(
+                    o[None] if i < len(self._lead1) and self._lead1[i]
+                    else o
+                    for i, o in enumerate(outs))
+                self.qs.reply(cid, TensorBuffer.of(*outs, pts=pts))
+
+            fut.add_done_callback(done)
+
+    def stats(self) -> Dict[str, int]:
+        return {"frames": self.dispatcher.frames,
+                "batches": self.dispatcher.batches}
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._drainers:
+            t.join(timeout=5)
+        self.dispatcher.shutdown()
+        self.qs.stop()

@@ -65,6 +65,18 @@ class MeshDispatcher:
         self._thread = threading.Thread(target=self._loop,
                                         name="mesh-dispatch", daemon=True)
         self._thread.start()
+        # completion stage: device results queue here and a second
+        # thread performs the host readback + future resolution, so the
+        # batcher can dispatch batch N+1 while batch N's D2H is still in
+        # flight (the readback dominates on remote/tunneled hosts —
+        # overlapping it measured ~4x offload throughput)
+        import queue as _q
+
+        self._done_q: "_q.Queue" = _q.Queue(maxsize=4)
+        self._completer = threading.Thread(target=self._complete_loop,
+                                           name="mesh-dispatch-complete",
+                                           daemon=True)
+        self._completer.start()
         # perf counters (BASELINE.md: p50 latency / batches)
         self.frames = 0
         self.batches = 0
@@ -87,7 +99,9 @@ class MeshDispatcher:
         with self._lock:
             self._stop = True
         self._wake.set()
-        self._thread.join(timeout=10)
+        self._thread.join(timeout=30)
+        self._done_q.put(None)
+        self._completer.join(timeout=10)
 
     # -- batcher loop ------------------------------------------------------
     def _loop(self) -> None:
@@ -126,13 +140,48 @@ class MeshDispatcher:
                 batch = np.concatenate([batch, pad], axis=0)
             out = self._fn(self._params, jnp.asarray(batch))
             outs = out if isinstance(out, (tuple, list)) else (out,)
-            host = [np.asarray(o) for o in outs]
-            for i, (_, fut) in enumerate(take):
-                fut.set_result(tuple(h[i] for h in host))
-            self.frames += n
-            self.batches += 1
+            for o in outs:       # start the D2H now; the completion
+                start = getattr(o, "copy_to_host_async", None)
+                if start is not None:    # thread reads it later
+                    try:
+                        start()
+                    except Exception:
+                        pass     # best-effort; np.asarray still correct
+            # hand off to the completion stage (bounded: backpressure
+            # keeps at most a few batches in flight on device)
+            self._done_q.put((outs, take, n))
         except Exception as e:  # resolve futures, never hang clients
             for _, fut in take:
                 if not fut.done():
                     fut.set_exception(
                         StreamError(f"mesh dispatch failed: {e}"))
+
+    def _complete_loop(self) -> None:
+        import queue as _q
+
+        sentinel_seen = False
+        while True:
+            if sentinel_seen:
+                # drain anything the batcher enqueued just before the
+                # sentinel, then exit — no future may be left hanging
+                try:
+                    item = self._done_q.get_nowait()
+                except _q.Empty:
+                    return
+            else:
+                item = self._done_q.get()
+            if item is None:
+                sentinel_seen = True
+                continue
+            outs, take, n = item
+            try:
+                host = [np.asarray(o) for o in outs]
+                for i, (_, fut) in enumerate(take):
+                    fut.set_result(tuple(h[i] for h in host))
+                self.frames += n
+                self.batches += 1
+            except Exception as e:
+                for _, fut in take:
+                    if not fut.done():
+                        fut.set_exception(
+                            StreamError(f"mesh dispatch failed: {e}"))

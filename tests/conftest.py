@@ -33,3 +33,14 @@ def eight_cpu_devices():
     if len(devs) < 8:
         pytest.skip(f"need 8 virtual devices, got {len(devs)}")
     return devs
+
+
+def free_port() -> int:
+    """Ephemeral TCP port for loopback test servers (shared helper)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -33,10 +33,7 @@ from nnstreamer_tpu.tensor.info import TensorFormat
 INTEROP_DIR = "nnstreamer_tpu/interop"
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from conftest import free_port  # noqa: E402 (shared helper)
 
 
 # -- GstTensorMetaInfo header -------------------------------------------------

@@ -13,7 +13,6 @@ harness (same pattern as `__graft_entry__._dryrun_in_subprocess`).
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
@@ -102,16 +101,10 @@ print(json.dumps({"pid": pid, "sum": s, "loss": float(loss),
 """
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def test_two_process_global_mesh_and_train_step(tmp_path):
-    coord = f"127.0.0.1:{_free_port()}"
+    from conftest import free_port
+
+    coord = f"127.0.0.1:{free_port()}"
     env = {k: v for k, v in os.environ.items()
            # a tunneled-TPU plugin in the parent env (axon) must not
            # leak into the pure-CPU worker processes
