@@ -95,8 +95,26 @@ class Connection:
             self.client_id = Connection._next_id
             Connection._next_id += 1
 
-    def send(self, mtype: int, payload: bytes = b"") -> None:
-        write_msg(self.sock, mtype, payload, self.send_lock)
+    def send(self, mtype: int, payload: bytes = b"",
+             timeout: Optional[float] = None) -> None:
+        """With `timeout`, a stalled peer (full kernel send buffer)
+        raises OSError instead of blocking the caller forever — servers
+        replying from shared worker threads must bound their sends."""
+        if timeout is None:
+            write_msg(self.sock, mtype, payload, self.send_lock)
+            return
+        with self.send_lock:
+            prev = self.sock.gettimeout()
+            self.sock.settimeout(timeout)
+            try:
+                write_msg(self.sock, mtype, payload, None)
+            except socket.timeout as e:
+                raise OSError(f"send timed out after {timeout}s") from e
+            finally:
+                try:
+                    self.sock.settimeout(prev)
+                except OSError:
+                    pass
 
     def close(self) -> None:
         try:

@@ -123,7 +123,11 @@ class QueryServer:
                         self.sid, client_id)
             return
         try:
-            conn.send(P.T_RESULT, encode_buffer(buf, client_id))
+            # bounded send: a stalled client (full kernel buffer) must
+            # not wedge the replying thread — which may be shared with
+            # other clients (BatchedQueryServer's completion path)
+            conn.send(P.T_RESULT, encode_buffer(buf, client_id),
+                      timeout=10.0)
         except OSError as e:
             log.warning("server %d: reply to %d failed: %s",
                         self.sid, client_id, e)

@@ -100,7 +100,12 @@ class MeshDispatcher:
             self._stop = True
         self._wake.set()
         self._thread.join(timeout=30)
-        self._done_q.put(None)
+        # bounded sentinel enqueue: if the completion stage is wedged
+        # (hung D2H) its queue may be full — shutdown must still return
+        try:
+            self._done_q.put(None, timeout=10)
+        except Exception:
+            log.warning("dispatcher completion queue wedged at shutdown")
         self._completer.join(timeout=10)
 
     # -- batcher loop ------------------------------------------------------

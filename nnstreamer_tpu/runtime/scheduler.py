@@ -49,18 +49,29 @@ class ElementStats:
     analog (SURVEY.md §5.1: tools/tracing/README.md:34-41), first-class
     instead of out-sourced. Read via PipelineRunner.stats()."""
 
-    __slots__ = ("buffers", "total_s", "max_s")
+    __slots__ = ("buffers", "total_s", "max_s", "wait_s", "wait_max_s")
 
     def __init__(self):
         self.buffers = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        # time buffers spent parked in this element's input queue —
+        # separates "this element is slow" (proctime) from "this element
+        # is starved/stalled behind others" (queue wait), the split the
+        # composite-tail diagnosis needs (GstShark interlatency analog)
+        self.wait_s = 0.0
+        self.wait_max_s = 0.0
 
     def record(self, dt: float) -> None:
         self.buffers += 1
         self.total_s += dt
         if dt > self.max_s:
             self.max_s = dt
+
+    def record_wait(self, dt: float) -> None:
+        self.wait_s += dt
+        if dt > self.wait_max_s:
+            self.wait_max_s = dt
 
     @property
     def avg_us(self) -> float:
@@ -69,7 +80,10 @@ class ElementStats:
     def as_dict(self) -> dict:
         return {"buffers": self.buffers, "proctime_avg_us": self.avg_us,
                 "proctime_max_us": 1e6 * self.max_s,
-                "proctime_total_s": self.total_s}
+                "proctime_total_s": self.total_s,
+                "queue_wait_avg_us": (1e6 * self.wait_s / self.buffers
+                                      if self.buffers else 0.0),
+                "queue_wait_max_us": 1e6 * self.wait_max_s}
 
 
 class PipelineRunner:
@@ -154,7 +168,7 @@ class PipelineRunner:
         # unblock workers waiting on get()
         for q in self._queues.values():
             try:
-                q.put_nowait((None, EOS))
+                q.put_nowait((None, EOS, 0.0))
             except queue.Full:
                 pass
         for e in self.pipeline.elements.values():
@@ -214,7 +228,7 @@ class PipelineRunner:
         self._stop_evt.set()
         for q in self._queues.values():
             try:
-                q.put_nowait((None, EOS))
+                q.put_nowait((None, EOS, 0.0))
             except queue.Full:
                 pass
 
@@ -230,9 +244,10 @@ class PipelineRunner:
             # overlaps with compute of other in-flight frames
             item.prefetch_host()
         q = self._queues[link.dst.name]
+        t_enq = time.perf_counter()
         while not self._stop_evt.is_set():
             try:
-                q.put((link.dst_pad, item), timeout=0.1)
+                q.put((link.dst_pad, item, t_enq), timeout=0.1)
                 return
             except queue.Full:
                 continue
@@ -263,7 +278,7 @@ class PipelineRunner:
         try:
             while not self._stop_evt.is_set():
                 try:
-                    pad, item = q.get(timeout=0.1)
+                    pad, item, t_enq = q.get(timeout=0.1)
                 except queue.Empty:
                     continue
                 if item is EOS:
@@ -277,6 +292,8 @@ class PipelineRunner:
                         return
                     continue
                 t0 = time.perf_counter()
+                if t_enq:
+                    stats.record_wait(t0 - t_enq)
                 emissions = elem.process(pad, item)
                 stats.record(time.perf_counter() - t0)
                 for sp, b in emissions:

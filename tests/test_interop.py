@@ -8,7 +8,6 @@ tests/nnstreamer_converter_{protobuf,flexbuf}, nnstreamer_decoder_*,
 nnstreamer_grpc (SURVEY.md §4).
 """
 
-import socket
 import subprocess
 import sys
 import textwrap

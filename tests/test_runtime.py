@@ -322,3 +322,33 @@ class TestInputPipeline:
             DeviceFeeder(depth=0)
         with pytest.raises(ValueError, match="depth"):
             list(prefetch_to_device(iter([]), depth=0))
+
+
+def test_stats_report_queue_wait():
+    """Per-element queue-wait counters (GstShark interlatency analog)
+    separate starvation from slow elements in stats()."""
+    import time as _time
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    class SlowSink(nns.elements.FakeSink):
+        pass
+
+    pipe = nns.parse_launch(
+        "appsrc name=src dims=4:1 types=float32 ! "
+        "tensor_transform mode=arithmetic option=add:1.0 name=tr ! "
+        "tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe, optimize=False).start()
+    for i in range(6):
+        pipe.get("src").push(TensorBuffer.of(
+            np.ones((1, 4), np.float32), pts=i))
+    pipe.get("src").end()
+    runner.wait(30)
+    runner.stop()
+    st = runner.stats()
+    tr = st["tr"]
+    assert tr["buffers"] == 6
+    assert "queue_wait_avg_us" in tr and "queue_wait_max_us" in tr
+    assert tr["queue_wait_max_us"] >= tr["queue_wait_avg_us"] >= 0.0
+    assert tr["proctime_avg_us"] > 0.0
