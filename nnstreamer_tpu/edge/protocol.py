@@ -99,22 +99,34 @@ class Connection:
              timeout: Optional[float] = None) -> None:
         """With `timeout`, a stalled peer (full kernel send buffer)
         raises OSError instead of blocking the caller forever — servers
-        replying from shared worker threads must bound their sends."""
+        replying from shared worker threads must bound their sends.
+
+        The bound uses select-for-writability + partial send()s rather
+        than socket timeouts: settimeout() would mutate state shared
+        with this connection's reader thread. NOTE: a timeout may leave
+        a PARTIAL frame on the wire — the stream is unrecoverable and
+        the caller must close the connection.
+        """
         if timeout is None:
             write_msg(self.sock, mtype, payload, self.send_lock)
             return
+        import select
+
+        data = memoryview(_FRAME.pack(mtype, len(payload)) + payload)
+        deadline = time.monotonic() + timeout
         with self.send_lock:
-            prev = self.sock.gettimeout()
-            self.sock.settimeout(timeout)
-            try:
-                write_msg(self.sock, mtype, payload, None)
-            except socket.timeout as e:
-                raise OSError(f"send timed out after {timeout}s") from e
-            finally:
-                try:
-                    self.sock.settimeout(prev)
-                except OSError:
-                    pass
+            while data:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise OSError(f"send timed out after {timeout}s "
+                                  f"({len(data)} bytes unsent)")
+                _, writable, _ = select.select([], [self.sock], [],
+                                               remain)
+                if not writable:
+                    continue
+                # writable ⇒ send() accepts ≥1 byte and returns without
+                # waiting for the full buffer
+                data = data[self.sock.send(data):]
 
     def close(self) -> None:
         try:

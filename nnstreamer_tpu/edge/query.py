@@ -129,8 +129,14 @@ class QueryServer:
             conn.send(P.T_RESULT, encode_buffer(buf, client_id),
                       timeout=10.0)
         except OSError as e:
-            log.warning("server %d: reply to %d failed: %s",
-                        self.sid, client_id, e)
+            log.warning("server %d: reply to %d failed (%s); closing "
+                        "the connection — a timed-out send may have "
+                        "left a partial frame, the stream is "
+                        "unrecoverable", self.sid, client_id, e)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def stop(self) -> None:
         if self.server is not None:

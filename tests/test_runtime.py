@@ -327,13 +327,8 @@ class TestInputPipeline:
 def test_stats_report_queue_wait():
     """Per-element queue-wait counters (GstShark interlatency analog)
     separate starvation from slow elements in stats()."""
-    import time as _time
-
     import nnstreamer_tpu as nns
     from nnstreamer_tpu.tensor.buffer import TensorBuffer
-
-    class SlowSink(nns.elements.FakeSink):
-        pass
 
     pipe = nns.parse_launch(
         "appsrc name=src dims=4:1 types=float32 ! "
