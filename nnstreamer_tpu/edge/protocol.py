@@ -120,8 +120,14 @@ class Connection:
                 if remain <= 0:
                     raise OSError(f"send timed out after {timeout}s "
                                   f"({len(data)} bytes unsent)")
-                _, writable, _ = select.select([], [self.sock], [],
-                                               remain)
+                try:
+                    _, writable, _ = select.select([], [self.sock], [],
+                                                   remain)
+                except ValueError as e:
+                    # fd == -1: the socket was closed concurrently —
+                    # callers handle OSError, keep that contract
+                    raise OSError(f"socket closed during send: {e}") \
+                        from None
                 if not writable:
                     continue
                 # writable ⇒ send() accepts ≥1 byte and returns without

@@ -32,11 +32,39 @@ def load_model_file(path: str, batch: Optional[int] = None,
                     compute_dtype: str = "bfloat16",
                     quantize_output: bool = True,
                     input_names=None, output_names=None,
-                    sample_rate: int = 16000):
+                    sample_rate: int = 16000, side: Optional[int] = None):
     """Load a model file into a ModelBundle (extension-dispatched)."""
     from nnstreamer_tpu.backends.xla import ModelBundle
     from nnstreamer_tpu.tensor.dtypes import DType
     from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    def mk(shapes, dtypes):
+        return TensorsSpec(tensors=tuple(
+            TensorInfo(shape=tuple(s), dtype=DType.from_np(d))
+            for s, d in zip(shapes, dtypes)))
+
+    if "," in path:
+        # "init_net.pb,predict_net.pb" — the reference's caffe2 filter
+        # model-pair syntax (tensor_filter_caffe2.cc)
+        parts = [p.strip() for p in path.split(",") if p.strip()]
+        if len(parts) != 2:
+            raise BackendError(
+                f"a comma model pair must be exactly "
+                f"'init_net.pb,predict_net.pb', got {path!r}")
+        for p in parts:
+            if not os.path.exists(p):
+                raise BackendError(f"model file {p!r} does not exist")
+        from nnstreamer_tpu.modelio.caffe2 import lower_caffe2
+
+        lowered = lower_caffe2(parts[0], parts[1],
+                               input_names=input_names,
+                               output_names=output_names, batch=batch,
+                               side=side)
+        return ModelBundle(
+            fn=lowered.fn, params=lowered.params,
+            in_spec=mk(lowered.in_shapes, lowered.in_dtypes),
+            out_spec=mk(lowered.out_shapes, lowered.out_dtypes),
+            name=lowered.name)
 
     if not os.path.exists(path):
         raise BackendError(
@@ -47,13 +75,8 @@ def load_model_file(path: str, batch: Optional[int] = None,
     if ext != "pb" and (input_names or output_names):
         # fail loudly rather than silently ignoring a binding request
         raise BackendError(
-            f"inputname/outputname bind GraphDef nodes and apply to .pb "
-            f"models only (got a .{ext} file)")
-
-    def mk(shapes, dtypes):
-        return TensorsSpec(tensors=tuple(
-            TensorInfo(shape=tuple(s), dtype=DType.from_np(d))
-            for s, d in zip(shapes, dtypes)))
+            f"inputname/outputname bind GraphDef/NetDef nodes and apply "
+            f"to .pb models only (got a .{ext} file)")
 
     if ext == "tflite":
         graph = parse_tflite(path)
@@ -151,6 +174,14 @@ def parse_loader_opts(custom: str) -> Dict[str, Any]:
             opts["input_names"] = [s for s in v.split(";") if s]
         elif k in ("outputname", "output_names"):
             opts["output_names"] = [s for s in v.split(";") if s]
+        elif k == "side":
+            # caffe2 NetDef input spatial size (pixels per side)
+            try:
+                opts["side"] = int(v)
+            except ValueError:
+                raise BackendError(
+                    f"custom option side={v!r} is not an integer") \
+                    from None
         elif k == "sample_rate":
             try:
                 opts["sample_rate"] = int(v)

@@ -728,3 +728,55 @@ def test_custom_op_unregistered_fails_loudly(built_models, tmp_path):
             jax.eval_shape(m.fn, m.params, be, sc)
     finally:
         TFLITE_CUSTOM_OPS["TFLite_Detection_PostProcess"] = saved
+
+
+# -- caffe2 NetDef pair ingestion (caffe2.py) --------------------------------
+
+C2_INIT = os.path.join(MODELS, "caffe2_init_net.pb")
+C2_PRED = os.path.join(MODELS, "caffe2_predict_net.pb")
+C2_DATA = "/root/reference/tests/test_models/data/5"
+
+
+@needs_models
+def test_caffe2_pair_classifies_reference_sample():
+    """The reference's own CIFAR ResNet pair classifies its own data/5
+    sample as label 5 — the exact expectation its checkLabel.py
+    asserts (tests/nnstreamer_filter_caffe2/runTest.sh)."""
+    import jax
+
+    m = load_model_file(f"{C2_INIT},{C2_PRED}")
+    assert m.in_spec.tensors[0].shape == (1, 3, 32, 32)
+    assert m.out_spec.tensors[0].shape == (1, 10)
+    raw = np.fromfile(C2_DATA, np.float32).reshape(1, 3, 32, 32)
+    y = np.asarray(jax.jit(m.fn)(m.params, raw)[0])
+    assert int(y.argmax()) == 5
+    assert y[0, 5] > 0.5
+
+
+@needs_models
+def test_caffe2_pipeline_reference_shape():
+    """Pipeline parity with the reference test: octet data → converter →
+    caffe2 pair filter → label 5 out."""
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=32:32:3:1 types=float32 ! "
+        f"tensor_filter model={C2_INIT},{C2_PRED} ! "
+        f"tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    raw = np.fromfile(C2_DATA, np.float32).reshape(1, 3, 32, 32)
+    pipe.get("src").push(TensorBuffer.of(raw))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    assert int(np.asarray(res[0].tensors[0]).argmax()) == 5
+
+
+def test_caffe2_pair_errors():
+    with pytest.raises(BackendError, match="exactly"):
+        load_model_file("a.pb,b.pb,c.pb")
+    with pytest.raises(BackendError, match="does not exist"):
+        load_model_file("/nope/i.pb,/nope/p.pb")
