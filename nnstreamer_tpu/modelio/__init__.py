@@ -77,6 +77,10 @@ def load_model_file(path: str, batch: Optional[int] = None,
         raise BackendError(
             f"inputname/outputname bind GraphDef/NetDef nodes and apply "
             f"to .pb models only (got a .{ext} file)")
+    if side is not None:
+        raise BackendError(
+            f"custom=side= declares a caffe2 NetDef input resolution "
+            f"and applies to init,predict pairs only (got {path!r})")
 
     if ext == "tflite":
         graph = parse_tflite(path)

@@ -21,9 +21,10 @@ its `checkLabel.py` asserts (tests/test_modelio.py).
 
 from __future__ import annotations
 
+import os
 import struct
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -188,6 +189,15 @@ def lower_caffe2(init_path: str, predict_path: str,
                     f"caffe2 {t}: only order=NCHW supported")
             if t == "Conv":
                 x, w = get(op.inputs[0]), get(op.inputs[1])
+                unsupported = [a for a in (
+                    "dilation", "dilations", "kernels", "strides",
+                    "pads", "pad_t", "pad_l", "pad_b", "pad_r",
+                    "group") if a in op.args]
+                if unsupported:
+                    raise BackendError(
+                        f"caffe2 Conv: args {unsupported} are not "
+                        f"lowered (square kernel/stride/pad only); "
+                        f"refusing to run with silently-wrong numerics")
                 k = int(op.args.get("kernel", w.shape[-1]))
                 if k != w.shape[-1]:
                     raise BackendError(
@@ -225,17 +235,19 @@ def lower_caffe2(init_path: str, predict_path: str,
             elif t in ("AveragePool", "MaxPool"):
                 x = get(op.inputs[0])
                 k = int(op.args.get("kernel", 0))
+                pool_pad = int(op.args.get("pad", 0))
                 if op.args.get("global_pooling", 0) or \
-                        k == x.shape[-1] == x.shape[-2]:
+                        (pool_pad == 0 and k == x.shape[-1]
+                         == x.shape[-2]):
                     red = jnp.mean if t == "AveragePool" else jnp.max
                     vals[op.outputs[0]] = red(x, axis=(2, 3),
                                               keepdims=True)
                     continue
                 stride = int(op.args.get("stride", 1))
-                pad = int(op.args.get("pad", 0))
                 dims = (1, 1, k, k)
                 strides = (1, 1, stride, stride)
-                pads = [(0, 0), (0, 0), (pad, pad), (pad, pad)]
+                pads = [(0, 0), (0, 0), (pool_pad, pool_pad),
+                        (pool_pad, pool_pad)]
                 if t == "MaxPool":
                     vals[op.outputs[0]] = lax.reduce_window(
                         x, -jnp.inf, lax.max, dims, strides, pads)
@@ -252,8 +264,13 @@ def lower_caffe2(init_path: str, predict_path: str,
                 x2 = x.reshape(x.shape[0], -1)
                 vals[op.outputs[0]] = x2 @ w.T + b
             elif t == "Softmax":
-                vals[op.outputs[0]] = jax.nn.softmax(
-                    get(op.inputs[0]), axis=-1)
+                # caffe2 semantics: flatten to 2D around `axis`
+                # (default 1) and normalize over the trailing block
+                x = get(op.inputs[0])
+                ax = int(op.args.get("axis", 1))
+                lead = int(np.prod(x.shape[:ax])) if ax else 1
+                y = jax.nn.softmax(x.reshape(lead, -1), axis=-1)
+                vals[op.outputs[0]] = y.reshape(x.shape)
             elif t in ("Dropout",):
                 vals[op.outputs[0]] = get(op.inputs[0])
             else:
@@ -268,11 +285,16 @@ def lower_caffe2(init_path: str, predict_path: str,
         raise BackendError(
             "caffe2 predict net has no Conv; cannot infer the input "
             "shape (declare it with custom=side=<pixels>)")
-    c_in = params[first_conv.inputs[1]].shape[1]
+    w_name = first_conv.inputs[1]
+    if w_name not in params:
+        raise BackendError(
+            f"caffe2: first Conv weight blob {w_name!r} is not filled "
+            f"by the init net (mismatched init/predict pair, or the "
+            f"blob was claimed as an input)")
+    c_in = params[w_name].shape[1]
     # spatial size is data-dependent: custom=side=<n> declares it,
     # defaulting to 32 (the reference's CIFAR pair)
     import jax
-    import os as _os
 
     side = side or 32
     b = batch or 1
@@ -284,4 +306,4 @@ def lower_caffe2(init_path: str, predict_path: str,
         in_dtypes=[np.dtype(np.float32)],
         out_shapes=[tuple(a.shape) for a in out_avals],
         out_dtypes=[np.dtype(a.dtype) for a in out_avals],
-        name=_os.path.basename(predict_path))
+        name=os.path.basename(predict_path))
