@@ -723,8 +723,15 @@ def pallas_check():
         err = float(jnp.max(jnp.abs(
             ff(q, k, v).astype(jnp.float32)
             - fr(q, k, v).astype(jnp.float32))))
-        ours = _step_ms(ff, q, k, v, n1=20, n2=80)
-        xla = _step_ms(fr, q, k, v, n1=20, n2=80)
+
+        def med3(f, *a):
+            # tunnel jitter can make one differencing sample implausible
+            # (even negative); the median of three is stable
+            return sorted(_step_ms(f, *a, n1=20, n2=80)
+                          for _ in range(3))[1]
+
+        ours = med3(ff, q, k, v)
+        xla = med3(fr, q, k, v)
         flops = 4 * B * H * S * S * D / 2          # causal
         out["flash_attention"] = {
             "s2048_ms": round(ours, 3),

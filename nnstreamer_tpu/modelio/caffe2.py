@@ -209,6 +209,7 @@ def lower_caffe2(init_path: str, predict_path: str,
                     x, w, window_strides=(stride, stride),
                     padding=[(pad, pad), (pad, pad)],
                     dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                    precision=lax.Precision.HIGHEST,
                     preferred_element_type=jnp.float32)
                 if len(op.inputs) > 2:
                     y = y + get(op.inputs[2]).reshape(1, -1, 1, 1)

@@ -485,6 +485,7 @@ def lower_graphdef(nodes: Sequence[NodeDef],
                 b = b.T
             return jax.lax.dot_general(
                 a, b, (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
                 preferred_element_type=jnp.float32).astype(a.dtype)
         if op in ("Add", "AddV2", "BiasAdd"):
             if op == "BiasAdd" and \
@@ -545,6 +546,7 @@ def lower_graphdef(nodes: Sequence[NodeDef],
                 padding=n.attr_s("padding", "VALID"),
                 rhs_dilation=tuple(dil[1:3]),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=jax.lax.Precision.HIGHEST,
                 preferred_element_type=jnp.float32).astype(x.dtype)
         if op == "DepthwiseConv2dNative":
             x, w = get(ins[0]), get(ins[1])

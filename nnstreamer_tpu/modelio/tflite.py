@@ -91,17 +91,23 @@ _TENSOR_TYPES: Dict[int, np.dtype] = {
 # BuiltinOperator enum values used below
 OP = dict(
     ADD=0, AVERAGE_POOL_2D=1, CONCATENATION=2, CONV_2D=3,
-    DEPTHWISE_CONV_2D=4, DEQUANTIZE=6, FULLY_CONNECTED=9, LOGISTIC=14,
+    DEPTHWISE_CONV_2D=4, DEPTH_TO_SPACE=5, DEQUANTIZE=6, FLOOR=8,
+    FULLY_CONNECTED=9, L2_NORMALIZATION=11, LOGISTIC=14,
     MAX_POOL_2D=17, MUL=18, RELU=19, RELU6=21, RESHAPE=22,
-    RESIZE_BILINEAR=23, SOFTMAX=25, TANH=28, PAD=34, GATHER=36,
-    TRANSPOSE=39,
-    MEAN=40, SUB=41, DIV=42, SQUEEZE=43, STRIDED_SLICE=45,
-    SPLIT=49, LOG_SOFTMAX=50, MAXIMUM=55, ARG_MAX=56, MINIMUM=57,
-    LESS=58, GREATER=61, GREATER_EQUAL=62, LESS_EQUAL=63, SLICE=65,
-    EXPAND_DIMS=70, EQUAL=71, SUM=74, PACK=83, LOGICAL_AND=86,
-    LEAKY_RELU=98, ABS=101,
+    RESIZE_BILINEAR=23, SOFTMAX=25, SPACE_TO_DEPTH=26, TANH=28, PAD=34,
+    GATHER=36, TRANSPOSE=39,
+    MEAN=40, SUB=41, DIV=42, SQUEEZE=43, STRIDED_SLICE=45, EXP=47,
+    SPLIT=49, LOG_SOFTMAX=50, CAST=53, MAXIMUM=55, ARG_MAX=56,
+    MINIMUM=57,
+    LESS=58, NEG=59, GREATER=61, GREATER_EQUAL=62, LESS_EQUAL=63,
+    SELECT=64, SLICE=65, SIN=66, TRANSPOSE_CONV=67, TILE=69,
+    EXPAND_DIMS=70, EQUAL=71, LOG=73, SUM=74, SQRT=75, RSQRT=76,
+    POW=78, ARG_MIN=79, REDUCE_PROD=81, REDUCE_MAX=82, PACK=83,
+    LOGICAL_AND=86, UNPACK=88, REDUCE_MIN=89,
+    LEAKY_RELU=98, SQUARED_DIFFERENCE=99, MIRROR_PAD=100, ABS=101,
+    CEIL=104, COS=108, ELU=111,
     RESIZE_NEAREST_NEIGHBOR=97, HARD_SWISH=117, QUANTIZE=114,
-    WHILE=119, BATCH_MATMUL=126,
+    WHILE=119, SELECT_V2=123, BATCH_MATMUL=126, GELU=150,
 )
 _OP_NAMES = {v: k for k, v in OP.items()}
 
@@ -353,6 +359,7 @@ def lower_tflite(graph: TFLiteGraph, batch: Optional[int] = None,
     quantize_output: re-quantize integer graph outputs (spec parity with
       the file); False emits dequantized float outputs.
     """
+    import jax
     import jax.numpy as jnp
 
     orig_batch = None
@@ -436,6 +443,8 @@ def lower_tflite(graph: TFLiteGraph, batch: Optional[int] = None,
                 s = float(t.scale[0])
                 z = float(t.zero_point[0]) if t.zero_point is not None else 0.0
                 x = (x.astype(jnp.float32) - z) * s
+            elif t.dtype == np.bool_:
+                x = x.astype(jnp.bool_)     # uint8 on the wire → bool
             staged.append(x.astype(cdt) if _is_float(x.dtype) else x)
         outs = run_sg(0, p, tuple(staged))
 
@@ -450,12 +459,23 @@ def lower_tflite(graph: TFLiteGraph, batch: Optional[int] = None,
                 y = jnp.clip(q, info.min, info.max).astype(t.dtype)
             elif _is_float(y.dtype):
                 y = y.astype(jnp.float32)
+            elif y.dtype == jnp.bool_:
+                y = y.astype(jnp.uint8)     # bool → uint8 on the wire
             results.append(y)
         return tuple(results)
 
     def io_dtype(t: TensorDef, is_out: bool) -> np.dtype:
         if t.quantized and (not is_out or quantize_output):
             return t.dtype
+        if t.dtype == np.int64 and not jax.config.jax_enable_x64:
+            # argmax/argmin-style int64 outputs truncate to int32 under
+            # default JAX; the declared spec must match the arrays the
+            # traced fn actually produces (buffer sizing reads it)
+            return np.dtype(np.int32)
+        if t.dtype == np.bool_:
+            # the tensor type system (like the reference's) has no bool:
+            # bool tensors ride the wire as uint8
+            return np.dtype(np.uint8)
         return np.dtype(np.float32) if t.dtype.kind == "f" or t.quantized \
             else t.dtype
 
@@ -499,6 +519,14 @@ def _static_input_indices(graph) -> set:
             static.update(ins[1:])
         elif op.code == OP["SPLIT"] and len(ins) > 1:
             static.add(ins[0])          # axis
+        elif op.code in (OP["TILE"], OP["MIRROR_PAD"]) and len(ins) > 1:
+            static.add(ins[1])          # multiples / pads
+        elif op.code in (OP["REDUCE_MAX"], OP["REDUCE_MIN"],
+                         OP["REDUCE_PROD"], OP["ARG_MIN"]) \
+                and len(ins) > 1:
+            static.add(ins[1])          # axes
+        elif op.code == OP["TRANSPOSE_CONV"]:
+            static.add(ins[0])          # output_shape
     return static
 
 
@@ -870,6 +898,122 @@ def _eval_op(graph: TFLiteGraph, sg: "Subgraph", op: OpDef, get,
             return tuple(run(body_idx, c))
 
         return tuple(jax.lax.while_loop(cond_fn, body_fn, carry))
+
+    _UNARY = {
+        OP["EXP"]: jnp.exp, OP["LOG"]: jnp.log, OP["SQRT"]: jnp.sqrt,
+        OP["RSQRT"]: lambda x: 1.0 / jnp.sqrt(x), OP["NEG"]: jnp.negative,
+        OP["FLOOR"]: jnp.floor, OP["CEIL"]: jnp.ceil, OP["SIN"]: jnp.sin,
+        OP["COS"]: jnp.cos, OP["ELU"]: jax.nn.elu,
+    }
+    if code in _UNARY:
+        return _UNARY[code](get(op.inputs[0]))
+
+    if code == OP["GELU"]:
+        # GeluOptions: approximate (field 0)
+        return jax.nn.gelu(get(op.inputs[0]), approximate=bool(opt_b(0)))
+
+    if code == OP["POW"]:
+        return jnp.power(get(op.inputs[0]), get(op.inputs[1]))
+
+    if code == OP["SQUARED_DIFFERENCE"]:
+        d_ = get(op.inputs[0]) - get(op.inputs[1])
+        return d_ * d_
+
+    if code == OP["CAST"]:
+        return get(op.inputs[0]).astype(
+            tensors[op.outputs[0]].dtype)
+
+    if code in (OP["REDUCE_MAX"], OP["REDUCE_MIN"], OP["REDUCE_PROD"]):
+        x = get(op.inputs[0])
+        axes = tuple(int(a) for a in static(op.inputs[1]).ravel())
+        keep = bool(opt_b(0))
+        red = {OP["REDUCE_MAX"]: jnp.max, OP["REDUCE_MIN"]: jnp.min,
+               OP["REDUCE_PROD"]: jnp.prod}[code]
+        return red(x, axis=axes, keepdims=keep)
+
+    if code == OP["ARG_MIN"]:
+        x = get(op.inputs[0])
+        axis = int(static(op.inputs[1]).ravel()[0])
+        return jnp.argmin(x, axis=axis).astype(
+            tensors[op.outputs[0]].dtype)
+
+    if code in (OP["SELECT"], OP["SELECT_V2"]):
+        cond = get(op.inputs[0])
+        a, b2 = get(op.inputs[1]), get(op.inputs[2])
+        # SELECT (v1): a rank-1 condition picks along the FIRST axis of
+        # higher-rank operands (TFLite kernel semantics); SELECT_V2 is
+        # plain numpy-style broadcasting
+        if code == OP["SELECT"] and cond.ndim == 1 and a.ndim > 1:
+            cond = cond.reshape((cond.shape[0],) + (1,) * (a.ndim - 1))
+        return jnp.where(cond, a, b2)
+
+    if code == OP["TILE"]:
+        reps = [int(v) for v in static(op.inputs[1]).ravel()]
+        return jnp.tile(get(op.inputs[0]), reps)
+
+    if code == OP["UNPACK"]:
+        # UnpackOptions: num (field 0), axis (field 1)
+        x = get(op.inputs[0])
+        axis = opt_i(1, 0)
+        n = opt_i(0, 0) or x.shape[axis]
+        parts = jnp.split(x, n, axis=axis)
+        return tuple(jnp.squeeze(pp, axis=axis) for pp in parts)
+
+    if code == OP["MIRROR_PAD"]:
+        x = get(op.inputs[0])
+        pads = static(op.inputs[1]).reshape(-1, 2)
+        mode = "reflect" if opt_i(0, 0) == 0 else "symmetric"
+        return jnp.pad(x, [(int(a), int(b)) for a, b in pads],
+                       mode=mode)
+
+    if code in (OP["DEPTH_TO_SPACE"], OP["SPACE_TO_DEPTH"]):
+        x = get(op.inputs[0])
+        bs = opt_i(0, 2)
+        b, h, w, c = x.shape
+        if code == OP["DEPTH_TO_SPACE"]:
+            y = x.reshape(b, h, w, bs, bs, c // (bs * bs))
+            y = y.transpose(0, 1, 3, 2, 4, 5)
+            return y.reshape(b, h * bs, w * bs, c // (bs * bs))
+        y = x.reshape(b, h // bs, bs, w // bs, bs, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5)
+        return y.reshape(b, h // bs, w // bs, c * bs * bs)
+
+    if code == OP["L2_NORMALIZATION"]:
+        x = get(op.inputs[0]).astype(jnp.float32)
+        denom = jnp.sqrt(jnp.maximum(
+            jnp.sum(x * x, axis=-1, keepdims=True), 1e-12))
+        return _act(jnp, (x / denom).astype(cdt), opt_b(0))
+
+    if code == OP["TRANSPOSE_CONV"]:
+        # inputs: output_shape (static), weights (O,H,W,I), activations.
+        # TRANSPOSE_CONV is exactly the input-gradient of the forward
+        # conv over the declared output shape — build it as that VJP,
+        # which is correct by construction for every stride/padding
+        # combination (hand-rolled lax.conv_transpose padding math
+        # measured 7e-3 off the interpreter).
+        out_shape = [int(v) for v in static(op.inputs[0]).ravel()]
+        w = get(op.inputs[1])
+        x = get(op.inputs[2])
+        # TransposeConvOptions: padding=0, stride_w=1, stride_h=2
+        stride = (opt_i(2, 1), opt_i(1, 1))
+        pad = _pad_str(opt_b(0))
+        w_fwd = jnp.transpose(w, (1, 2, 0, 3))       # → HWIO (I=out ch)
+
+        def fwd(t):
+            # HIGHEST: the default conv precision truncates to ~bf16 on
+            # some backends (measured 7e-3 vs the interpreter)
+            return lax.conv_general_dilated(
+                t, w_fwd, window_strides=stride, padding=pad,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32)
+
+        _, vjp = jax.vjp(fwd, jnp.zeros(out_shape, x.dtype))
+        y = vjp(x.astype(jnp.float32))[0].astype(cdt)
+        if len(op.inputs) > 3 and op.inputs[3] >= 0:
+            y = y + get(op.inputs[3]).astype(cdt)
+        # TransposeConvOptions: fused_activation_function = field 3
+        return _act(jnp, y, opt_b(3))
 
     raise BackendError(
         f"TFLite op {op.name} (builtin code {code}"

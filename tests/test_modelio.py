@@ -780,3 +780,110 @@ def test_caffe2_pair_errors():
         load_model_file("a.pb,b.pb,c.pb")
     with pytest.raises(BackendError, match="does not exist"):
         load_model_file("/nope/i.pb,/nope/p.pb")
+
+
+@needs_models
+def test_singleshot_runs_all_ingestion_formats():
+    """SingleShot (the pipeline-less C-API analog) reaches every
+    model-file route through the same backend resolver."""
+    from nnstreamer_tpu.single import SingleShot
+
+    # TF GraphDef
+    s1 = SingleShot(model=MNIST_PB)
+    raw = np.fromfile(NINE_RAW, np.uint8).astype(np.float32)
+    (y1,) = s1.invoke(((raw - 127.5) / 127.5).reshape(1, 784))
+    assert int(np.asarray(y1).argmax()) == 9
+    s1.close()
+
+    # caffe2 pair
+    s2 = SingleShot(model=f"{C2_INIT},{C2_PRED}")
+    x = np.fromfile(C2_DATA, np.float32).reshape(1, 3, 32, 32)
+    (y2,) = s2.invoke(x)
+    assert int(np.asarray(y2).argmax()) == 5
+    s2.close()
+
+    # int8-native TFLite
+    s3 = SingleShot(model=MOBILENET, custom="dtype=int8")
+    img = next(iter(_synthetic_images(1)))
+    (y3,) = s3.invoke(img)
+    assert np.asarray(y3).shape == (1, 1001)
+    s3.close()
+
+
+# -- converter-built op-breadth goldens --------------------------------------
+
+def _convert_fn(tf, fn, sig, path):
+    f = tf.function(fn, input_signature=sig)
+    c = tf.lite.TFLiteConverter.from_concrete_functions(
+        [f.get_concrete_function()])
+    path.write_bytes(c.convert())
+    return str(path)
+
+
+def _golden_vs_interpreter(tf, path, *xs, atol=1e-4):
+    import jax
+
+    m = load_model_file(path, compute_dtype="float32")
+    interp = tf.lite.Interpreter(model_path=path)
+    interp.allocate_tensors()
+    for d, x in zip(interp.get_input_details(), xs):
+        interp.set_tensor(d["index"], x)
+    interp.invoke()
+    refs = [interp.get_tensor(d["index"])
+            for d in interp.get_output_details()]
+    ours = [np.asarray(t) for t in jax.jit(m.fn)(m.params, *xs)]
+    assert len(refs) == len(ours)
+    for r, o in zip(refs, ours):
+        np.testing.assert_allclose(o, r, atol=atol, rtol=1e-4)
+
+
+def test_tflite_elementwise_reduce_select_breadth(tmp_path):
+    """~20 builtins in one converter-built graph (EXP/LOG/SQRT/RSQRT/
+    POW/SQUARED_DIFFERENCE/FLOOR/CEIL/NEG/SIN/COS/ELU/GELU/SELECT/
+    REDUCE_MAX/MIN/PROD/ARG_MIN/CAST/TILE/MIRROR_PAD) — golden vs the
+    interpreter."""
+    tf = pytest.importorskip("tensorflow")
+
+    def sink(x):
+        a = tf.exp(x) + tf.math.log(tf.abs(x) + 1.0)
+        b = tf.sqrt(tf.abs(x)) * tf.math.rsqrt(tf.abs(x) + 1.0)
+        c = tf.pow(x, 3.0) - tf.math.squared_difference(x, 2.0)
+        d = tf.floor(x) + tf.math.ceil(x) - (-x)
+        e = tf.sin(x) + tf.cos(x) + tf.nn.elu(x) + tf.nn.gelu(x)
+        f = tf.where(x > 0, a, b)
+        g = tf.reduce_max(c, axis=1, keepdims=True) \
+            + tf.reduce_min(d, axis=1, keepdims=True)
+        h = tf.reduce_prod(tf.clip_by_value(x, 0.5, 1.5), axis=1,
+                           keepdims=True)
+        i = tf.cast(tf.argmin(x, axis=1), tf.float32)
+        j = tf.tile(g + h, [1, 8])
+        k = tf.pad(e, [[0, 0], [2, 2]], mode="REFLECT")
+        return f + j, k, i
+
+    path = _convert_fn(tf, sink, [tf.TensorSpec((2, 8), tf.float32)],
+                       tmp_path / "sink1.tflite")
+    x = np.random.default_rng(0).normal(0, 1, (2, 8)).astype(np.float32)
+    _golden_vs_interpreter(tf, path, x)
+
+
+def test_tflite_spatial_breadth(tmp_path):
+    """DEPTH_TO_SPACE/SPACE_TO_DEPTH/L2_NORMALIZATION/UNPACK/
+    TRANSPOSE_CONV — golden vs the interpreter (transpose conv is built
+    as the VJP of the forward conv, exact by construction)."""
+    tf = pytest.importorskip("tensorflow")
+    rng = np.random.default_rng(0)
+    w = tf.constant(rng.normal(0, 0.3, (2, 2, 3, 8)).astype(np.float32))
+
+    def sink(x):
+        a = tf.nn.depth_to_space(x, 2)
+        b = tf.nn.space_to_depth(a, 2)
+        c = tf.math.l2_normalize(b, axis=-1)
+        parts = tf.unstack(c, axis=3)
+        d = tf.nn.conv2d_transpose(c, w, [1, 8, 8, 3], [1, 2, 2, 1])
+        return d, parts[0] + parts[1]
+
+    path = _convert_fn(tf, sink,
+                       [tf.TensorSpec((1, 4, 4, 8), tf.float32)],
+                       tmp_path / "sink2.tflite")
+    x = rng.normal(0, 1, (1, 4, 4, 8)).astype(np.float32)
+    _golden_vs_interpreter(tf, path, x)
