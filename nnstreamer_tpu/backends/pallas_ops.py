@@ -91,6 +91,14 @@ def sparse_to_dense(values, flat_indices, shape: Tuple[int, ...]):
 
 # -- flash attention ---------------------------------------------------------
 
+def _causal_mask(jnp_mod, row_off, col_off, bq, bk):
+    """rows>=cols block mask from global offsets (shared by all three
+    flash kernels so the mask semantics can never diverge)."""
+    rows = row_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = col_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows >= cols
+
+
 def _online_softmax_update(q, k_blk, v_blk, m, l, acc, scale, mask):
     """One flash block update shared by both kernels: scaled QK^T on the
     MXU, optional mask, running max/normalizer, PV accumulation (f32)."""
@@ -131,14 +139,8 @@ def _flash_kernel(scale: float, causal: bool, bq: int, bk: int,
         # is f32 via preferred_element_type — the standard flash recipe
         k_blk = k_ref[0, pl.ds(j * bk, bk), :]
         v_blk = v_ref[0, pl.ds(j * bk, bk), :]
-        if causal:
-            rows = qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            mask = rows >= cols
-        else:
-            mask = None
+        mask = _causal_mask(jnp, qi * bq, j * bk, bq, bk) \
+            if causal else None
         return _online_softmax_update(q, k_blk, v_blk, *carry, scale, mask)
 
     d = q.shape[-1]
@@ -152,6 +154,79 @@ def _flash_kernel(scale: float, causal: bool, bq: int, bk: int,
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, a0))
     l = jnp.maximum(l, 1e-20)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_kgrid_kernel(scale: float, causal: bool, bq: int, bk: int,
+                        q_ref, k_ref, v_ref, o_ref,
+                        m_scr, l_scr, acc_scr):
+    """K-blocked grid program for LONG sequences: grid is
+    (batch·head, q_blocks, k_blocks) with k innermost, so K/V stream
+    through VMEM one (bk, D) block at a time — per-step VMEM is O(bq·D +
+    bk·D) regardless of S. The online-softmax carry (m, l, acc) lives in
+    VMEM scratch, which persists across sequential grid steps on TPU."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    run = True
+    if causal:
+        # K blocks fully above the diagonal contribute nothing
+        run = (ki * bk) <= (qi * bq + bq - 1)
+    q = q_ref[0]
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
+
+    @pl.when(run)
+    def _step():
+        m = m_scr[0, :]
+        l = l_scr[0, :]
+        acc = acc_scr[...]
+        mask = _causal_mask(jnp, qi * bq, ki * bk, bq, bk) \
+            if causal else None
+        m, l, acc = _online_softmax_update(q, k_blk, v_blk, m, l, acc,
+                                           scale, mask)
+        m_scr[...] = jnp.broadcast_to(m[None, :], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l[None, :], l_scr.shape)
+        acc_scr[...] = acc
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[0, :], 1e-20)
+        o_ref[0] = (acc_scr[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_attention_kgrid(qf, kf, vf, *, scale: float, causal: bool,
+                           bq: int, bk: int, interpret: bool):
+    bh, s, d = qf.shape
+    kern = functools.partial(_flash_kgrid_kernel, scale, causal, bq, bk)
+    return pl.pallas_call(
+        kern,
+        grid=(bh, s // bq, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, k: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, k: (i, k, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, k: (i, k, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, k: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), qf.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((8, bq), jnp.float32),       # m (sublane-repl)
+            pltpu.VMEM((8, bq), jnp.float32),       # l
+            pltpu.VMEM((bq, d), jnp.float32),       # acc
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+
+#: VMEM budget for holding a head's full K+V in the single-program
+#: kernel; beyond it the K-grid streaming path takes over (long context)
+_FLASH_VMEM_KV_BYTES = 8 << 20
 
 
 def _auto_block(s: int, want: int) -> int:
@@ -172,7 +247,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     5.6× XLA's fused attention and 3.9× the stock
     jax.experimental.pallas TPU kernel (whose defaults undersize the
     MXU work per step). Requires S % block == 0 (pad upstream); falls
-    back to interpret mode off-TPU like every kernel here."""
+    back to interpret mode off-TPU like every kernel here.
+
+    Long sequences: when a head's full K+V would exceed the VMEM budget
+    (S ≳ 16k at D=128), the kernel switches to a K-blocked grid that
+    streams K/V through VMEM with scratch-carried online-softmax state —
+    per-step VMEM is independent of S, so S=64k+ compiles and runs."""
     b, s, h, d = q.shape
     bq = block_q or _auto_block(s, 512)
     bk = block_k or _auto_block(s, 1024)
@@ -187,6 +267,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     qf, kf, vf = bhsd(q), bhsd(k), bhsd(v)
+    kv_bytes = 2 * s * d * q.dtype.itemsize
+    if kv_bytes > _FLASH_VMEM_KV_BYTES:
+        out = _flash_attention_kgrid(qf, kf, vf, scale=scale,
+                                     causal=causal, bq=bq, bk=bk,
+                                     interpret=_interpret())
+        return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     kern = functools.partial(_flash_kernel, scale, causal, bq, bk)
     out = pl.pallas_call(
         kern,
@@ -226,13 +312,8 @@ def _flash_block_kernel(scale: float, bk: int, causal: bool,
     def body(j, carry):
         k_blk = k_ref[0, pl.ds(j * bk, bk), :]
         v_blk = v_ref[0, pl.ds(j * bk, bk), :]
-        if causal:
-            rows = qoff + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = koff + j * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            mask = rows >= cols
-        else:
-            mask = None
+        mask = _causal_mask(jnp, qoff, koff + j * bk, bq, bk) \
+            if causal else None
         return _online_softmax_update(q, k_blk, v_blk, *carry, scale, mask)
 
     n_kb = s_k // bk
