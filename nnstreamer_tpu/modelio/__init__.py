@@ -198,4 +198,4 @@ def parse_loader_opts(custom: str) -> Dict[str, Any]:
 
 __all__ = ["load_model_file", "load_params", "save_params",
            "parse_tflite", "lower_tflite", "parse_loader_opts",
-           "MODEL_EXTENSIONS"]
+           "register_tflite_custom_op", "MODEL_EXTENSIONS"]

@@ -49,7 +49,7 @@ goal (ties differ in the last bit); goldens assert top-1 agreement vs
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
