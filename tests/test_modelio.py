@@ -887,3 +887,32 @@ def test_tflite_spatial_breadth(tmp_path):
                        tmp_path / "sink2.tflite")
     x = rng.normal(0, 1, (1, 4, 4, 8)).astype(np.float32)
     _golden_vs_interpreter(tf, path, x)
+
+
+@needs_models
+def test_pipeline_classifies_reference_orange_sample():
+    """Real-image semantic parity: the reference's own orange.raw
+    through the full pipeline (filter + image_labeling decoder with its
+    labels file) yields label 951 'orange' — the exact expectation of
+    the reference's tflite checkLabel tests."""
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    orange = "/root/reference/tests/test_models/data/orange.raw"
+    if not os.path.exists(orange):
+        pytest.skip("orange.raw absent")
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=3:224:224:1 types=uint8 ! "
+        f"tensor_filter model={MOBILENET} ! "
+        f"tensor_decoder mode=image_labeling option1={LABELS} ! "
+        f"tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    raw = np.fromfile(orange, np.uint8).reshape(1, 224, 224, 3)
+    pipe.get("src").push(TensorBuffer.of(raw))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    assert res[0].meta["label"] == "orange"
+    assert res[0].meta["label_index"] == 951
