@@ -177,6 +177,12 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+#: decoder D2H pipelining depth for the host-decode throughput configs;
+#: the bench's emission-lag accounting derives from it (16 absorbs the
+#: tunnel's D2H jitter: measured 62 FPS vs 33 at depth 8 on ssd)
+SSD_MAX_IN_FLIGHT = 16
+
+
 # -- config builders ---------------------------------------------------------
 
 def _probe_env():
@@ -235,7 +241,7 @@ def _build_label_device():
     return pipe, src, sink, frame
 
 
-def _build_label():
+def _build_label(max_in_flight=SSD_MAX_IN_FLIGHT):
     import numpy as np
 
     import nnstreamer_tpu as nns
@@ -257,7 +263,8 @@ def _build_label():
             from nnstreamer_tpu.elements.decoder import TensorDecoder
 
             stages.append(TensorDecoder(name="d", mode="image_labeling",
-                                        option1=LABELS))
+                                        option1=LABELS,
+                                        max_in_flight=max_in_flight))
     else:
         if _on_tpu():
             # compiled Pallas ingest kernel (normalize_u8) as the filter
@@ -291,12 +298,6 @@ def _u8_frame(shape, seed):
     import numpy as np
 
     return np.random.default_rng(seed).integers(0, 256, shape, np.uint8)
-
-
-#: compact-decoder D2H pipelining depth for the SSD throughput config;
-#: the bench's emission-lag accounting derives from it (16 absorbs the
-#: tunnel's D2H jitter: measured 62 FPS vs 33 at depth 8)
-SSD_MAX_IN_FLIGHT = 16
 
 
 def _build_ssd(max_in_flight=SSD_MAX_IN_FLIGHT):
@@ -802,12 +803,18 @@ def main() -> int:
     # honest e2e configs (decoders read results to host per frame)
     ssd_cap = dict(n_frames=48, n_lat=12) if _on_tpu() else {}
     for name, build, kw, lat in (
-            ("label", _build_label, {}, None),
+            ("label", lambda: _build_label(), {},
+             lambda: _build_label(max_in_flight=1)),
             ("ssd", lambda: _build_ssd(), ssd_cap,
              lambda: _build_ssd(max_in_flight=1)),
             ("posenet", _build_posenet, {}, None)):
         try:
-            lag = SSD_MAX_IN_FLIGHT - 1 if name == "ssd" else 0
+            # the label pipeline only contains the lagging decoder on
+            # the real-model path (tflite + labels present)
+            label_lags = (os.path.exists(MOBILENET_TFLITE)
+                          and os.path.exists(LABELS))
+            lag = SSD_MAX_IN_FLIGHT - 1 if (
+                name == "ssd" or (name == "label" and label_lags)) else 0
             results[name] = _Bench(build, build_lat=lat,
                                    lag=lag).run(**kw)
         except Exception as e:

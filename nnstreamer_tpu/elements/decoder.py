@@ -110,12 +110,13 @@ class TensorDecoder(Element):
         # the compact candidate tensor crosses D2H.
         "device": PropDef(_prop_device, False,
                           "device-side decode (false|true|compact)"),
-        # compact mode: frames whose D2H readback may be in flight at
-        # once. >1 pipelines the host copies (copy_to_host_async) so the
-        # transfer latency overlaps across frames — decode emission lags
-        # by up to max_in_flight-1 frames mid-stream (flush drains at
-        # EOS). 1 (default) = strict per-frame synchronous behavior.
-        "max_in_flight": PropDef(int, 1, "compact D2H pipelining depth"),
+        # frames whose D2H readback may be in flight at once (compact
+        # AND plain host decode). >1 pipelines the host copies
+        # (copy_to_host_async) so the transfer latency overlaps across
+        # frames — decode emission lags by up to max_in_flight-1 frames
+        # mid-stream (flush drains at EOS). 1 (default) = strict
+        # per-frame synchronous behavior.
+        "max_in_flight": PropDef(int, 1, "decode D2H pipelining depth"),
         # reference passes up to 9 positional option strings; we accept
         # those plus named passthrough props via option_fields
         **{f"option{i}": PropDef(str, "") for i in range(1, 10)},
@@ -134,9 +135,13 @@ class TensorDecoder(Element):
         self.sub.init(dict(self.props))
         self._device_fn = None
         self._compact_fn = None
-        self._inflight: List = []     # compact mode: frames awaiting D2H
+        self._inflight: List = []     # frames awaiting D2H completion
         if self.props["device"]:
             self.WANTS_HOST = False   # keep payloads on device
+        # pipelined host decode (max_in_flight>1) keeps WANTS_HOST=True:
+        # the scheduler's enqueue-side prefetch_host starts the copy as
+        # early as possible; this element merely defers the blocking
+        # to_host() behind the window
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         spec = self.expect_tensors(in_specs[0])
@@ -173,6 +178,7 @@ class TensorDecoder(Element):
         return [out]
 
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        depth = max(1, int(self.props["max_in_flight"]))
         if self._compact_fn is not None:
             out = self._compact_fn(buf.tensors, self._device_aux)
             if not isinstance(out, (tuple, list)):
@@ -180,25 +186,32 @@ class TensorDecoder(Element):
             # best-effort async D2H start: overlaps the copy across
             # in-flight frames (buffer.prefetch_host guards backends
             # whose copy_to_host_async raises)
-            self._inflight.append(
-                buf.with_tensors(tuple(out)).prefetch_host())
-            ems: List[Emission] = []
-            depth = max(1, int(self.props["max_in_flight"]))
-            while len(self._inflight) >= depth:
-                ems.append((0, self._emit_compact()))
-            return ems
+            return self._window(buf.with_tensors(tuple(out)), depth)
         if self._device_fn is not None:
             out = self._device_fn(buf.tensors, self._device_aux)
             if not isinstance(out, (tuple, list)):
                 out = (out,)
             return [(0, buf.with_tensors(tuple(out)))]
+        if depth > 1:
+            # pipelined host decode: same windowing as compact mode,
+            # minus the device reduction step
+            return self._window(buf, depth)
         return [(0, self.sub.decode(buf.to_host()))]
 
-    def _emit_compact(self) -> TensorBuffer:
+    def _window(self, buf: TensorBuffer, depth: int) -> List[Emission]:
+        """Enqueue with async readback; emit decodes of frames whose
+        window slot expired (flush() drains the rest at EOS)."""
+        self._inflight.append(buf.prefetch_host())
+        ems: List[Emission] = []
+        while len(self._inflight) >= depth:
+            ems.append((0, self._emit_pending()))
+        return ems
+
+    def _emit_pending(self) -> TensorBuffer:
         return self.sub.decode(self._inflight.pop(0).to_host())
 
     def flush(self) -> List[Emission]:
         ems: List[Emission] = []
         while self._inflight:
-            ems.append((0, self._emit_compact()))
+            ems.append((0, self._emit_pending()))
         return ems

@@ -507,3 +507,31 @@ class TestCompactDecode:
         b.init({"option1": "yolov5"})
         with pytest.raises(PipelineError, match="compact"):
             b.device_compact((np.zeros((1, 5, 85), np.float32),))
+
+
+def test_host_decode_pipelined_window_matches_strict():
+    """max_in_flight>1 on a PLAIN host decoder pipelines the readbacks
+    but emits identical results in identical order (flush drains)."""
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    def run(extra):
+        pipe = nns.parse_launch(
+            f"appsrc name=src dims=10:1 types=float32 ! "
+            f"tensor_decoder mode=image_labeling {extra} ! "
+            f"tensor_sink name=out")
+        r = nns.PipelineRunner(pipe).start()
+        rng = np.random.default_rng(0)
+        for i in range(7):
+            pipe.get("src").push(TensorBuffer.of(
+                rng.normal(0, 1, (1, 10)).astype(np.float32), pts=i))
+        pipe.get("src").end()
+        r.wait(60)
+        r.stop()
+        return [(b.pts, b.meta["label_index"])
+                for b in pipe.get("out").results]
+
+    strict = run("")
+    piped = run("max_in_flight=4")
+    assert len(strict) == 7
+    assert piped == strict
