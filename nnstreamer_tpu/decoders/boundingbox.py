@@ -209,6 +209,21 @@ class BoundingBoxes(DecoderSubplugin):
                 raise PipelineError(
                     f"compact bounding-box tensor must be (K,6), got "
                     f"{det.shape}")
+            # Truncation signal: the compact tensor ships only the top-K
+            # candidates (no threshold applied on device).  If even the
+            # weakest shipped row clears the score threshold, rows that
+            # would also have cleared it may have been cut — host parity
+            # silently breaks.  Warn once per decoder; raise option7.
+            if (len(det) and det[-1, 4] >= self.score_thresh
+                    and not getattr(self, "_compact_trunc_warned", False)):
+                self._compact_trunc_warned = True
+                from nnstreamer_tpu.core.log import get_logger
+                get_logger("decoder.bounding_boxes").warning(
+                    "device=compact top-K (option7=%d) may be truncating: "
+                    "last compact row score %.3f >= threshold %.3f; "
+                    "detections above threshold may be missing — raise "
+                    "option7", len(det), float(det[-1, 4]),
+                    self.score_thresh)
             return det
         s = self.scheme
         if s == "mobilenet-ssd":

@@ -391,19 +391,33 @@ class _MqttListener:
             self._send(cid, pkt)
 
     def close(self) -> None:
+        import socket as _socket
+
         self._closing = True
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        # shutdown() before close(): close() alone does not wake a
+        # thread blocked in accept()/recv() on Linux.
+        for s in (self._srv,):
+            for op in (lambda: s.shutdown(_socket.SHUT_RDWR), s.close):
+                try:
+                    op()
+                except OSError:
+                    pass
         with self._lock:
-            socks = [e["sock"] for e in self._conns.values()]
+            ents = list(self._conns.values())
             self._conns.clear()
-        for s in socks:
+        for e in ents:
+            # Wake the writer thread: the reader's finally-block sentinel
+            # is skipped once the entry is popped, so enqueue it here.
             try:
-                s.close()
-            except OSError:
+                e["outq"].put_nowait(None)
+            except Exception:
                 pass
+            for op in (lambda s=e["sock"]: s.shutdown(_socket.SHUT_RDWR),
+                       e["sock"].close):
+                try:
+                    op()
+                except OSError:
+                    pass
 
 
 class BrokerClient:

@@ -215,6 +215,12 @@ class MsgServer:
 
     def close(self) -> None:
         self._stopping.set()
+        # shutdown() first: close() alone does not wake a thread
+        # blocked in accept() on Linux.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

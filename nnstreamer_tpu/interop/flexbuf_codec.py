@@ -22,9 +22,9 @@ from __future__ import annotations
 
 
 import numpy as np
-from flatbuffers import flexbuffers
 
 from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.interop.flexbuf_read import flexbuf_loads
 from nnstreamer_tpu.interop._codec_base import register_codec_pair
 from nnstreamer_tpu.interop.gst_meta import (
     check_wire_dtype,
@@ -38,7 +38,13 @@ from nnstreamer_tpu.tensor.info import TensorFormat
 
 
 def encode_flexbuf(buf: TensorBuffer, rate=None) -> bytes:
-    """TensorBuffer → flexbuffers frame (reference map layout)."""
+    """TensorBuffer → flexbuffers frame (reference map layout).
+
+    Encoding needs the flexbuffers *builder*; only the stock
+    ``flatbuffers`` package provides one (decode is dependency-free via
+    interop/flexbuf_read.py)."""
+    from flatbuffers import flexbuffers
+
     fbb = flexbuffers.Builder()
     non_static = buf.format != TensorFormat.STATIC
     frac = (rate if isinstance(rate, tuple) else (rate or 0, 1))
@@ -70,22 +76,19 @@ def encode_flexbuf(buf: TensorBuffer, rate=None) -> bytes:
 def decode_flexbuf(frame: bytes) -> TensorBuffer:
     """flexbuffers frame → TensorBuffer (host numpy)."""
     try:
-        root = flexbuffers.GetRoot(bytearray(frame)).AsMap
-        num = root["num_tensors"].AsInt
-        try:
-            fmt = TensorFormat(root["format"].AsInt)
-        except KeyError:   # older reference frames omit the format key
-            fmt = TensorFormat.STATIC
+        root = flexbuf_loads(frame)
+        if not isinstance(root, dict):
+            raise ValueError("frame root is not a map")
+        num = int(root["num_tensors"])
+        fmt = (TensorFormat(int(root["format"])) if "format" in root
+               else TensorFormat.STATIC)  # older frames omit the key
     except Exception as e:
         raise StreamError(f"corrupt flexbuf tensor frame: {e}") from None
     arrays, names = [], {}
     for i in range(num):
         try:
-            vec = root[f"tensor_{i}"].AsVector
-            name = vec[0].AsString
-            dt = DType(vec[1].AsInt)
-            dims = [e.AsInt for e in vec[2].AsTypedVector]
-            raw = bytes(vec[3].AsBlob)
+            name, ty, dims, raw = root[f"tensor_{i}"]
+            dt = DType(int(ty))
         except Exception as e:
             raise StreamError(
                 f"corrupt flexbuf tensor frame at tensor_{i}: {e}"

@@ -229,7 +229,7 @@ def decode_wav_bytes(data: bytes, desired_samples: int = -1,
     rate = None
     channels = None
     bits = None
-    samples = None
+    raw_data = None
     while pos + 8 <= len(data):
         cid = data[pos:pos + 4]
         (clen,) = struct.unpack_from("<I", data, pos + 4)
@@ -242,12 +242,14 @@ def decode_wav_bytes(data: bytes, desired_samples: int = -1,
                     f"DecodeWav supports PCM16 only (fmt={fmt}, "
                     f"bits={bits})")
         elif cid == b"data":
-            raw = data[body:body + clen]
-            samples = np.frombuffer(
-                raw[:len(raw) - (len(raw) % 2)], "<i2")
+            raw_data = data[body:body + clen]
         pos = body + clen + (clen & 1)
-    if rate is None or samples is None:
+    # Decode only after the walk: a `data` chunk may precede `fmt `, so
+    # channels/rate are validated here, not where the chunk was seen.
+    if rate is None or not channels or raw_data is None:
         raise BackendError("DecodeWav: missing fmt/data chunk")
+    samples = np.frombuffer(
+        raw_data[:len(raw_data) - (len(raw_data) % 2)], "<i2")
     x = (samples.astype(np.float32) / 32768.0).reshape(-1, channels)
     if desired_channels > 0 and x.shape[1] != desired_channels:
         if x.shape[1] > desired_channels:

@@ -133,25 +133,14 @@ def _decode_flexbuffer_map(data: bytes) -> Dict[str, Any]:
     if not data:
         return {}
     try:
-        from flatbuffers import flexbuffers
+        from nnstreamer_tpu.interop.flexbuf_read import flexbuf_loads
 
-        root = flexbuffers.GetRoot(bytearray(data))
-        if not root.IsMap:
+        root = flexbuf_loads(data)
+        if not isinstance(root, dict):
             raise ValueError("custom_options root is not a map")
-        m = root.AsMap
-        out: Dict[str, Any] = {}
-        for key in m.Keys:
-            k = key.AsKey
-            v = m[k]
-            if v.IsBool:
-                out[k] = v.AsBool
-            elif v.IsInt:
-                out[k] = v.AsInt
-            elif v.IsFloat:
-                out[k] = v.AsFloat
-            elif v.IsString:
-                out[k] = v.AsString
-        return out
+        # scalar/str options only (converter convention for op options)
+        return {k: v for k, v in root.items()
+                if isinstance(v, (bool, int, float, str))}
     except Exception as e:
         raise BackendError(
             f"undecodable TFLite custom_options ({e}); cannot run the "

@@ -422,3 +422,71 @@ def test_truncated_payload_raises_stream_error(codec_name, enc, dec):
     # chop the tail: header parses, payload short
     with pytest.raises(StreamError):
         dec(bytes(frame[:len(frame) // 2]))
+
+
+# -- from-scratch flexbuffers reader vs the stock builder ---------------------
+
+def test_flexbuf_read_matches_stock_builder():
+    """interop/flexbuf_read.py (dependency-free) must decode buffers
+    produced by the stock flatbuffers builder across the type zoo:
+    nested maps/vectors, typed vectors, bools, floats, strings, blobs,
+    indirect scalars — so custom-op options and flexbuf frames parse
+    identically with or without the external package installed."""
+    from flatbuffers import flexbuffers
+
+    from nnstreamer_tpu.interop.flexbuf_read import flexbuf_loads
+
+    fbb = flexbuffers.Builder()
+    with fbb.Map():
+        fbb.Key("i"); fbb.Int(-42)
+        fbb.Key("u"); fbb.UInt(2 ** 40)          # forces 8-byte width
+        fbb.Key("f"); fbb.Float(1.5)
+        fbb.Key("b_true"); fbb.Bool(True)
+        fbb.Key("b_false"); fbb.Bool(False)
+        fbb.Key("s"); fbb.String("hello flex")
+        fbb.Key("blob"); fbb.Blob(b"\x00\x01\xfe\xff")
+        fbb.Key("tv"); fbb.TypedVectorFromElements([3, 1, 4, 1, 5])
+        fbb.Key("vec")
+        with fbb.Vector():
+            fbb.Int(7)
+            fbb.String("mixed")
+            fbb.Float(0.25)
+        fbb.Key("nested")
+        with fbb.Map():
+            fbb.Key("x"); fbb.Int(1)
+            fbb.Key("y"); fbb.Float(-2.0)
+    out = flexbuf_loads(bytes(fbb.Finish()))
+    assert out == {
+        "i": -42, "u": 2 ** 40, "f": 1.5,
+        "b_true": True, "b_false": False,
+        "s": "hello flex", "blob": b"\x00\x01\xfe\xff",
+        "tv": [3, 1, 4, 1, 5],
+        "vec": [7, "mixed", 0.25],
+        "nested": {"x": 1, "y": -2.0},
+    }
+    assert isinstance(out["b_true"], bool) and isinstance(out["i"], int)
+
+
+def test_flexbuf_read_scalar_roots_and_errors():
+    from flatbuffers import flexbuffers
+
+    from nnstreamer_tpu.interop.flexbuf_read import (
+        FlexDecodeError,
+        flexbuf_loads,
+    )
+
+    for v in (0, -1, 3.75, True, "root-string"):
+        fbb = flexbuffers.Builder()
+        if isinstance(v, bool):
+            fbb.Bool(v)
+        elif isinstance(v, int):
+            fbb.Int(v)
+        elif isinstance(v, float):
+            fbb.Float(v)
+        else:
+            fbb.String(v)
+        assert flexbuf_loads(bytes(fbb.Finish())) == v
+    with pytest.raises(FlexDecodeError):
+        flexbuf_loads(b"")
+    with pytest.raises(FlexDecodeError):
+        flexbuf_loads(b"\x00\x00\x07")   # byte width 7 is invalid
