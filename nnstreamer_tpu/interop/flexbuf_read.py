@@ -83,6 +83,16 @@ def _key(data: bytes, addr: int) -> str:
     return data[addr:end].decode("utf-8")
 
 
+def _types_start(data: bytes, addr: int, w: int, n: int) -> int:
+    """Start of a vector/map's trailing per-element type bytes, bounds
+    checked so truncated buffers raise instead of IndexError."""
+    start = addr + n * w
+    if start + n > len(data):
+        raise FlexDecodeError(
+            f"vector type bytes at {start} (+{n}) exceed buffer")
+    return start
+
+
 def _typed_vector(data: bytes, addr: int, w: int, elem_type: int,
                   length: int) -> List[Any]:
     out: List[Any] = []
@@ -137,7 +147,7 @@ def _ref(data: bytes, off: int, parent_w: int, packed: int) -> Any:
         keys_w = _u(data, addr - 2 * child_w, child_w)
         keys_addr = _indirect(data, addr - 3 * child_w, child_w)
         keys = _typed_vector(data, keys_addr, keys_w, _KEY, n)
-        types_at = addr + n * child_w
+        types_at = _types_start(data, addr, child_w, n)
         out: Dict[str, Any] = {}
         for idx in range(n):
             out[keys[idx]] = _ref(data, addr + idx * child_w, child_w,
@@ -145,7 +155,7 @@ def _ref(data: bytes, off: int, parent_w: int, packed: int) -> Any:
         return out
     if t == _VECTOR:
         n = _u(data, addr - child_w, child_w)
-        types_at = addr + n * child_w
+        types_at = _types_start(data, addr, child_w, n)
         return [_ref(data, addr + idx * child_w, child_w,
                      data[types_at + idx]) for idx in range(n)]
     if _VECTOR_INT <= t <= _VECTOR_STRING_DEPR or t == _VECTOR_BOOL:

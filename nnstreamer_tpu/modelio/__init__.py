@@ -8,6 +8,9 @@ Formats:
   dequantize → bf16; see tflite.py).
 - `.npz` — this framework's own serialized params format for zoo /
   python-defined models (params_io.py).
+- `.pt` — TorchScript archives (legacy model.json AND modern data.pkl
+  generations), parsed from scratch and AST-lowered to one XLA
+  computation (torchscript.py) — no torch needed at load time.
 
 `load_model_file(path, **opts)` dispatches on extension and returns a
 `backends.xla.ModelBundle`.
@@ -25,11 +28,12 @@ from nnstreamer_tpu.modelio.tflite import (
 import nnstreamer_tpu.modelio.tflite_custom  # noqa: F401 (registers ops)
 
 #: extensions this package can ingest → default backend
-MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla", "pb": "xla"}
+MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla", "pb": "xla",
+                    "pt": "xla"}
 
 
 def load_model_file(path: str, batch: Optional[int] = None,
-                    compute_dtype: str = "bfloat16",
+                    compute_dtype: Optional[str] = None,
                     quantize_output: bool = True,
                     input_names=None, output_names=None,
                     sample_rate: int = 16000, side: Optional[int] = None):
@@ -83,6 +87,11 @@ def load_model_file(path: str, batch: Optional[int] = None,
             f"and applies to init,predict pairs only (got {path!r})")
 
     if ext == "tflite":
+        # per-format compute default: tflite runs bf16 (MXU-native,
+        # quantized models dequantize into it); .pt runs fp32 for
+        # fidelity with torch-exported weights — either is an explicit
+        # custom=dtype= away
+        compute_dtype = compute_dtype or "bfloat16"
         graph = parse_tflite(path)
         if compute_dtype in ("int8", "native", "auto"):
             from nnstreamer_tpu.modelio.tflite_quant import (
@@ -134,6 +143,18 @@ def load_model_file(path: str, batch: Optional[int] = None,
             out_spec=mk(lowered.out_shapes, lowered.out_dtypes),
             name=os.path.basename(path),
             host_pre=getattr(lowered, "host_pre", None))
+
+    if ext == "pt":
+        from nnstreamer_tpu.modelio.torchscript import lower_torchscript
+
+        lowered = lower_torchscript(
+            path, compute_dtype=compute_dtype or "float32")
+        # TorchScript archives carry no input shape metadata (like the
+        # reference, dims are pipeline-declared: tensor_filter_pytorch
+        # gets them from caps); specs resolve via set_input_info
+        return ModelBundle(fn=lowered.fn, params=lowered.params,
+                           in_spec=None, out_spec=None,
+                           name=os.path.basename(path))
 
     if ext == "npz":
         arch, params = load_params(path)

@@ -1,0 +1,1535 @@
+"""TorchScript ``.pt`` ingestion — from-scratch, no torch at load time.
+
+Reference parity: ``ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc``
+(:775 LoC) loads TorchScript archives through libtorch and runs them on
+CPU/GPU.  Here the archive is parsed directly and *lowered to one JAX
+computation* instead: TorchScript's serialized code is a restricted
+Python dialect, so the method bodies are parsed with :mod:`ast` and
+interpreted symbolically — tensor ops become jnp/lax ops traced into the
+XLA program, host scalars (shapes, flags, branch conditions) evaluate
+eagerly at trace time.  The result is a single fused XLA executable per
+input shape, not an op-by-op eager walk — the tpu-first answer to
+libtorch's kernel-per-node execution.
+
+Two container generations are handled, both without torch:
+
+* **legacy** (producerVersion 1.0, ``model.json`` + ``code/*.py`` +
+  ``tensors/N``) — torch ≥ 1.3 itself refuses to load these ("Legacy
+  model format is not supported on mobile"), but the reference ships its
+  pytorch goldens in exactly this format (pytorch_lenet5.pt), so this
+  loader runs models that the *installed* torch cannot.
+* **modern** (``data.pkl`` + ``constants.pkl`` + ``code/**.py`` +
+  ``data/N``) — module tree unpickled with a custom
+  ``pickle.Unpickler`` (``find_class``/``persistent_load`` stubs; no
+  torch classes are imported).
+
+Supported op set: the inference closure of common exported models —
+conv1d/2d (+transposed, groups), linear/addmm/matmul/bmm, pooling
+(max/avg/adaptive), batch/layer norm, activations, softmax, shape ops
+(reshape/view/permute/transpose/cat/…), elementwise math, reductions,
+top-k, embedding, interpolation.  Unsupported ops fail loud with the op
+name (never silently wrong).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import pickle
+import zipfile
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import BackendError
+
+__all__ = ["load_torchscript", "lower_torchscript", "TSProgram"]
+
+
+# ---------------------------------------------------------------------------
+# containers
+# ---------------------------------------------------------------------------
+
+_LEGACY_DTYPES = {
+    "FLOAT": np.float32, "DOUBLE": np.float64, "HALF": np.float16,
+    "INT64": np.int64, "INT32": np.int32, "INT16": np.int16,
+    "INT8": np.int8, "UINT8": np.uint8, "BOOL": np.bool_,
+}
+
+_STORAGE_DTYPES = {
+    "FloatStorage": np.float32, "DoubleStorage": np.float64,
+    "HalfStorage": np.float16, "LongStorage": np.int64,
+    "IntStorage": np.int32, "ShortStorage": np.int16,
+    "CharStorage": np.int8, "ByteStorage": np.uint8,
+    "BoolStorage": np.bool_,
+}
+
+# torch.dtype enum codes (aten/src/ATen/core/ScalarType) as they appear
+# in serialized `torch.to(x, <int>)` / `softmax(..., dtype)` calls
+_TORCH_DTYPE_CODES = {
+    0: np.uint8, 1: np.int8, 2: np.int16, 3: np.int32, 4: np.int64,
+    5: np.float16, 6: np.float32, 7: np.float64, 11: np.bool_,
+}
+
+
+class _ParamSlot:
+    """Marker for a learnable tensor living in the params dict."""
+
+    __slots__ = ("path",)
+
+    def __init__(self, path: str):
+        self.path = path
+
+
+class _TSModule:
+    """A deserialized module node: qualname + attribute bag."""
+
+    __slots__ = ("qualname", "attrs")
+
+    def __init__(self, qualname: str, attrs: Optional[dict] = None):
+        self.qualname = qualname
+        self.attrs = attrs if attrs is not None else {}
+
+
+@dataclass
+class _ClassInfo:
+    qualname: str
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    consts: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TSProgram:
+    root: _TSModule
+    classes: Dict[str, _ClassInfo]
+    functions: Dict[str, ast.FunctionDef]
+    params: Dict[str, np.ndarray]
+    constants: List[Any]
+    name: str
+
+
+def _strided_copy(flat: np.ndarray, offset: int, size, stride) -> np.ndarray:
+    """Materialize a (possibly strided/offset) tensor view of a flat
+    storage as a contiguous array."""
+    size = tuple(int(s) for s in size)
+    stride = tuple(int(s) for s in stride)
+    if not size:
+        return np.ascontiguousarray(flat[offset])
+    it = flat.itemsize
+    view = np.lib.stride_tricks.as_strided(
+        flat[offset:], shape=size, strides=tuple(s * it for s in stride))
+    return np.ascontiguousarray(view)
+
+
+# -- modern format: custom unpickler ----------------------------------------
+
+class _Storage:
+    __slots__ = ("array",)
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+
+
+def _rebuild_tensor_v2(storage, storage_offset, size, stride,
+                       requires_grad=False, backward_hooks=None,
+                       metadata=None):
+    return _strided_copy(storage.array, storage_offset, size, stride)
+
+
+def _rebuild_tensor(storage, storage_offset, size, stride):
+    return _strided_copy(storage.array, storage_offset, size, stride)
+
+
+class _StorageClass:
+    __slots__ = ("dtype",)
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+
+
+_DYN_CLASSES: Dict[str, type] = {}
+
+
+def _dyn_class(qualname: str) -> type:
+    cls = _DYN_CLASSES.get(qualname)
+    if cls is None:
+        cls = type(qualname.rsplit(".", 1)[-1], (), {"_ts_qual": qualname})
+        _DYN_CLASSES[qualname] = cls
+    return cls
+
+
+class _TSUnpickler(pickle.Unpickler):
+    """Unpickles data.pkl / constants.pkl with torch globals stubbed out
+    and storages resolved against the archive — no torch import."""
+
+    def __init__(self, fobj, read_record: Callable[[str], bytes]):
+        super().__init__(fobj)
+        self._read_record = read_record
+
+    def find_class(self, module, name):
+        if module.startswith("__torch__"):
+            return _dyn_class(f"{module}.{name}")
+        if module == "torch._utils":
+            if name == "_rebuild_tensor_v2":
+                return _rebuild_tensor_v2
+            if name == "_rebuild_tensor":
+                return _rebuild_tensor
+        if module == "torch" and name in _STORAGE_DTYPES:
+            return _StorageClass(_STORAGE_DTYPES[name])
+        if module == "torch" and name == "device":
+            return lambda s: s
+        if module == "torch.jit._pickle":
+            if name in ("build_intlist", "build_doublelist",
+                        "build_boollist", "build_tensorlist"):
+                return lambda data: list(data)
+            if name == "restore_type_tag":
+                return lambda value, _type: value
+        if module == "collections" and name == "OrderedDict":
+            return dict
+        raise BackendError(
+            f"TorchScript archive references unsupported global "
+            f"{module}.{name}")
+
+    def persistent_load(self, pid):
+        if not (isinstance(pid, tuple) and pid and pid[0] == "storage"):
+            raise BackendError(f"unknown persistent id {pid!r}")
+        _, storage_cls, key, _location, _numel = pid
+        raw = self._read_record(str(key))
+        return _Storage(np.frombuffer(raw, storage_cls.dtype).copy())
+
+
+# ---------------------------------------------------------------------------
+# code registry
+# ---------------------------------------------------------------------------
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _index_code(src: str, namespace: str,
+                classes: Dict[str, _ClassInfo],
+                functions: Dict[str, ast.FunctionDef]) -> None:
+    tree = ast.parse(src)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            qual = f"{namespace}.{node.name}" if namespace else node.name
+            ci = classes.setdefault(qual, _ClassInfo(qualname=qual))
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    ci.methods[item.name] = item
+                elif isinstance(item, ast.AnnAssign) and item.value is not None \
+                        and isinstance(item.target, ast.Name):
+                    # `padding : Final[Tuple[int, int]] = (1, 1)` — Final
+                    # attrs live only in code, not in the pickled state
+                    ci.consts[item.target.id] = _literal(item.value)
+                elif isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                        and isinstance(item.targets[0], ast.Name):
+                    ci.consts[item.targets[0].id] = _literal(item.value)
+        elif isinstance(node, ast.FunctionDef):
+            qual = f"{namespace}.{node.name}" if namespace else node.name
+            functions[qual] = node
+
+
+# ---------------------------------------------------------------------------
+# archive loading
+# ---------------------------------------------------------------------------
+
+def load_torchscript(path: str) -> TSProgram:
+    """Parse a TorchScript zip archive into a :class:`TSProgram`."""
+    try:
+        zf = zipfile.ZipFile(path)
+    except (zipfile.BadZipFile, OSError) as e:
+        raise BackendError(f"{path!r} is not a TorchScript archive: {e}") \
+            from None
+    names = zf.namelist()
+    if not names:
+        raise BackendError(f"{path!r}: empty archive")
+    root = names[0].split("/", 1)[0]
+
+    def read(rel: str) -> bytes:
+        return zf.read(f"{root}/{rel}")
+
+    def has(rel: str) -> bool:
+        return f"{root}/{rel}" in names
+
+    classes: Dict[str, _ClassInfo] = {}
+    functions: Dict[str, ast.FunctionDef] = {}
+
+    if has("model.json"):
+        return _load_legacy(root, read, names, zf, classes, functions)
+
+    # -- modern format -----------------------------------------------------
+    for n in names:
+        if n.startswith(f"{root}/code/") and n.endswith(".py"):
+            ns = n[len(f"{root}/code/"):-3].replace("/", ".")
+            try:
+                _index_code(zf.read(n).decode("utf-8"), ns, classes,
+                            functions)
+            except SyntaxError as e:
+                raise BackendError(
+                    f"{path!r}: cannot parse serialized code {n}: {e}") \
+                    from None
+
+    import io
+
+    constants: List[Any] = []
+    if has("constants.pkl"):
+        up = _TSUnpickler(io.BytesIO(read("constants.pkl")),
+                          lambda key: read(f"constants/{key}"))
+        constants = list(up.load())
+
+    up = _TSUnpickler(io.BytesIO(read("data.pkl")),
+                      lambda key: read(f"data/{key}"))
+    obj = up.load()
+
+    params: Dict[str, np.ndarray] = {}
+
+    def convert(node, prefix: str) -> Any:
+        if isinstance(node, np.ndarray):
+            params[prefix] = node
+            return _ParamSlot(prefix)
+        qual = getattr(type(node), "_ts_qual", None)
+        if qual is not None:
+            mod = _TSModule(qual)
+            for k, v in vars(node).items():
+                mod.attrs[k] = convert(v, f"{prefix}.{k}" if prefix else k)
+            return mod
+        if isinstance(node, (list, tuple)):
+            return type(node)(
+                convert(v, f"{prefix}.{i}") for i, v in enumerate(node))
+        return node
+
+    root_mod = convert(obj, "")
+    if not isinstance(root_mod, _TSModule):
+        raise BackendError(
+            f"{path!r}: data.pkl root is not a script module")
+    return TSProgram(root=root_mod, classes=classes, functions=functions,
+                     params=params, constants=constants,
+                     name=os.path.basename(path))
+
+
+def _load_legacy(root, read, names, zf, classes, functions) -> TSProgram:
+    """producerVersion-1.0 archives: model.json module tree +
+    tensors/N raw storages + per-module code arenas."""
+    meta = json.loads(read("model.json"))
+    tensors_meta = meta.get("tensors", [])
+    params: Dict[str, np.ndarray] = {}
+
+    def load_tensor(idx: int) -> np.ndarray:
+        t = tensors_meta[idx]
+        dt = _LEGACY_DTYPES.get(t.get("dataType"))
+        if dt is None:
+            raise BackendError(
+                f"legacy TorchScript tensor dataType "
+                f"{t.get('dataType')!r} unsupported")
+        flat = np.frombuffer(read(t["data"]["key"]), dt).copy()
+        return _strided_copy(flat, int(t.get("offset", 0)),
+                             [int(d) for d in t.get("dims", [])],
+                             [int(s) for s in t.get("strides", [])])
+
+    for n in names:
+        if n.startswith(f"{root}/code/") and n.endswith(".py"):
+            arena = n[len(root) + 1:]          # "code/xxx.py"
+            src = zf.read(n).decode("utf-8")
+            ci = _ClassInfo(qualname=arena)
+            tree = ast.parse(src)
+            for node in tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    ci.methods[node.name] = node
+            classes[arena] = ci
+
+    def build(node: dict, prefix: str) -> _TSModule:
+        arena = node.get("torchscriptArena", {}).get("key", "")
+        mod = _TSModule(arena or f"<legacy:{node.get('name', '?')}>")
+        for p in node.get("parameters", []):
+            pname = p["name"]
+            path = f"{prefix}.{pname}" if prefix else pname
+            params[path] = load_tensor(int(p["tensorId"]))
+            mod.attrs[pname] = _ParamSlot(path)
+        for sub in node.get("submodules", []):
+            sname = sub["name"]
+            mod.attrs[sname] = build(
+                sub, f"{prefix}.{sname}" if prefix else sname)
+        mod.attrs.setdefault("training", False)
+        return mod
+
+    root_mod = build(meta["mainModule"], "")
+    return TSProgram(root=root_mod, classes=classes, functions=functions,
+                     params=params, constants=[],
+                     name=meta["mainModule"].get("name", root))
+
+
+# ---------------------------------------------------------------------------
+# interpreter
+# ---------------------------------------------------------------------------
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class _AnyType:
+    """Stand-in for typing names in serialized annotations/`annotate`
+    calls — subscriptable, attribute-chainable, never executed."""
+
+    def __getitem__(self, _):
+        return self
+
+    def __getattr__(self, _):
+        return self
+
+    def __call__(self, *a, **k):
+        raise BackendError("TorchScript type expression is not callable")
+
+
+_ANYTYPE = _AnyType()
+
+
+class _NSNode:
+    """Lazy resolver for dotted `__torch__...` references."""
+
+    __slots__ = ("interp", "prefix")
+
+    def __init__(self, interp: "_Interp", prefix: str):
+        self.interp = interp
+        self.prefix = prefix
+
+
+class _TorchNS:
+    __slots__ = ("interp",)
+
+    def __init__(self, interp):
+        self.interp = interp
+
+
+class _OpsNS:
+    __slots__ = ("interp", "space")
+
+    def __init__(self, interp, space: str):
+        self.interp = interp
+        self.space = space
+
+
+class _ConstantsNS:
+    __slots__ = ("interp",)
+
+    def __init__(self, interp):
+        self.interp = interp
+
+
+def _is_tensor(v) -> bool:
+    import jax
+
+    return isinstance(v, (np.ndarray, jax.Array)) or hasattr(v, "aval")
+
+
+class _Interp:
+    """Trace-time evaluator for serialized TorchScript method bodies."""
+
+    def __init__(self, prog: TSProgram, params: Dict[str, Any],
+                 float_dtype):
+        self.prog = prog
+        self.params = params
+        self.fdt = float_dtype
+        self.ops = _make_torch_ops(self)
+        self.prims = _make_prim_ops(self)
+        import jax.numpy as jnp
+
+        self.jnp = jnp
+        self.globals: Dict[str, Any] = {
+            "torch": _TorchNS(self),
+            "ops": _OpsNS(self, "ops"),
+            "CONSTANTS": _ConstantsNS(self),
+            "annotate": lambda _t, v: v,
+            "unchecked_cast": lambda _t, v: v,
+            "uninitialized": lambda _t: None,
+            "int": int, "float": float, "bool": bool, "str": str,
+            "len": len, "range": range, "min": min, "max": max,
+            "abs": abs, "print": lambda *a, **k: None,
+            # traced Sequential containers address numeric submodule
+            # names via getattr(self, "0")
+            "getattr": lambda obj, name, *_d: self._getattr(obj, name),
+            "Optional": _ANYTYPE, "List": _ANYTYPE, "Tuple": _ANYTYPE,
+            "Dict": _ANYTYPE, "Final": _ANYTYPE, "Tensor": _ANYTYPE,
+            "NoneType": _ANYTYPE, "Any": _ANYTYPE, "number": _ANYTYPE,
+            "Module": _ANYTYPE,
+            "__torch__": _NSNode(self, "__torch__"),
+        }
+
+    # -- entry --------------------------------------------------------------
+    def call_method(self, mod: _TSModule, name: str, args: tuple):
+        ci = self.prog.classes.get(mod.qualname)
+        if ci is None or name not in ci.methods:
+            raise BackendError(
+                f"TorchScript method {mod.qualname}.{name} has no "
+                f"serialized code")
+        return self.call_function(ci.methods[name], (mod,) + tuple(args))
+
+    def call_function(self, fd: ast.FunctionDef, args: tuple):
+        env: Dict[str, Any] = {}
+        names = [a.arg for a in fd.args.args]
+        defaults = fd.args.defaults
+        required = len(names) - len(defaults)
+        if len(args) > len(names) or len(args) < required:
+            raise BackendError(
+                f"TorchScript call {fd.name}: got {len(args)} args, "
+                f"signature has {len(names)}")
+        for i, n in enumerate(names):
+            if i < len(args):
+                env[n] = args[i]
+            else:
+                env[n] = self.eval(defaults[i - required], env)
+        try:
+            for st in fd.body:
+                self.exec(st, env)
+        except _Return as r:
+            return r.value
+        return None
+
+    # -- statements ---------------------------------------------------------
+    def exec(self, node: ast.stmt, env: Dict[str, Any]) -> None:
+        k = type(node).__name__
+        if k == "Assign":
+            val = self.eval(node.value, env)
+            for tgt in node.targets:
+                self._bind(tgt, val, env)
+        elif k == "AnnAssign":
+            if node.value is not None:
+                self._bind(node.target, self.eval(node.value, env), env)
+        elif k == "AugAssign":
+            cur = self.eval(
+                ast.copy_location(
+                    ast.Name(id=node.target.id, ctx=ast.Load()), node)
+                if isinstance(node.target, ast.Name) else node.target, env)
+            val = self._binop(type(node.op).__name__, cur,
+                              self.eval(node.value, env))
+            self._bind(node.target, val, env)
+        elif k == "Return":
+            raise _Return(self.eval(node.value, env)
+                          if node.value is not None else None)
+        elif k == "If":
+            cond = self._host_bool(self.eval(node.test, env))
+            for st in (node.body if cond else node.orelse):
+                self.exec(st, env)
+        elif k == "For":
+            it = self.eval(node.iter, env)
+            if _is_tensor(it):
+                raise BackendError(
+                    "TorchScript data-dependent loop (iterating a "
+                    "tensor) is not supported under jit")
+            for v in it:
+                self._bind(node.target, v, env)
+                try:
+                    for st in node.body:
+                        self.exec(st, env)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif k == "While":
+            guard = 0
+            while self._host_bool(self.eval(node.test, env)):
+                guard += 1
+                if guard > 100000:
+                    raise BackendError(
+                        "TorchScript while-loop exceeded 100000 "
+                        "trace-time iterations")
+                try:
+                    for st in node.body:
+                        self.exec(st, env)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif k == "Expr":
+            self.eval(node.value, env)
+        elif k == "Pass":
+            pass
+        elif k == "Break":
+            raise _Break()
+        elif k == "Continue":
+            raise _Continue()
+        elif k == "Raise":
+            raise BackendError(
+                "TorchScript model raised an exception at trace time")
+        else:
+            raise BackendError(
+                f"TorchScript statement {k} is not supported")
+
+    def _bind(self, tgt: ast.expr, val, env) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = val
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            vals = list(val)
+            if len(vals) != len(tgt.elts):
+                raise BackendError(
+                    f"TorchScript tuple unpack arity mismatch "
+                    f"({len(tgt.elts)} targets, {len(vals)} values)")
+            for t, v in zip(tgt.elts, vals):
+                self._bind(t, v, env)
+        else:
+            raise BackendError(
+                f"TorchScript assignment target "
+                f"{type(tgt).__name__} is not supported")
+
+    def _host_bool(self, v) -> bool:
+        if _is_tensor(v) and getattr(v, "shape", None) not in ((), None):
+            raise BackendError(
+                "TorchScript data-dependent control flow (branching on "
+                "a tensor) is not supported under jit")
+        try:
+            return bool(v)
+        except Exception:
+            raise BackendError(
+                "TorchScript data-dependent control flow (branching on "
+                "a traced value) is not supported under jit") from None
+
+    # -- expressions --------------------------------------------------------
+    def eval(self, node: ast.expr, env: Dict[str, Any]):
+        k = type(node).__name__
+        m = getattr(self, f"_eval_{k}", None)
+        if m is None:
+            raise BackendError(
+                f"TorchScript expression {k} is not supported")
+        return m(node, env)
+
+    def _eval_Constant(self, node, env):
+        return node.value
+
+    def _eval_Name(self, node, env):
+        if node.id in env:
+            return env[node.id]
+        if node.id in self.globals:
+            return self.globals[node.id]
+        raise BackendError(
+            f"TorchScript name {node.id!r} is not defined")
+
+    def _eval_Tuple(self, node, env):
+        return tuple(self.eval(e, env) for e in node.elts)
+
+    def _eval_List(self, node, env):
+        return [self.eval(e, env) for e in node.elts]
+
+    def _eval_Dict(self, node, env):
+        return {self.eval(kn, env): self.eval(vn, env)
+                for kn, vn in zip(node.keys, node.values)}
+
+    def _eval_Attribute(self, node, env):
+        obj = self.eval(node.value, env)
+        return self._getattr(obj, node.attr)
+
+    def _getattr(self, obj, name: str):
+        if isinstance(obj, _TSModule):
+            if name in obj.attrs:
+                v = obj.attrs[name]
+                return self.params[v.path] if isinstance(v, _ParamSlot) \
+                    else v
+            ci = self.prog.classes.get(obj.qualname)
+            if ci and name in ci.consts:
+                return ci.consts[name]
+            if ci and name in ci.methods:
+                return _BoundMethod(self, obj, name)
+            raise BackendError(
+                f"TorchScript module {obj.qualname} has no attribute "
+                f"{name!r}")
+        if isinstance(obj, _ConstantsNS):
+            if name.startswith("c") and name[1:].isdigit():
+                return self.prog.constants[int(name[1:])]
+            raise BackendError(f"unknown CONSTANTS.{name}")
+        if isinstance(obj, _TorchNS):
+            op = self.ops.get(name)
+            if op is None:
+                raise BackendError(
+                    f"TorchScript op torch.{name} is not supported by "
+                    f"the jax lowering (file an op-table entry)")
+            return op
+        if isinstance(obj, _OpsNS):
+            if obj.space == "ops":
+                return _OpsNS(self, name)
+            if obj.space == "prim":
+                op = self.prims.get(name)
+                if op is None:
+                    raise BackendError(
+                        f"TorchScript op ops.prim.{name} is not "
+                        f"supported")
+                return op
+            raise BackendError(
+                f"TorchScript op namespace ops.{obj.space}.{name} is "
+                f"not supported (quantized/custom ops have no jax "
+                f"lowering)")
+        if isinstance(obj, _NSNode):
+            prefix = f"{obj.prefix}.{name}"
+            if prefix in self.prog.functions:
+                fd = self.prog.functions[prefix]
+                return lambda *a: self.call_function(fd, a)
+            return _NSNode(self, prefix)
+        if isinstance(obj, _AnyType):
+            return _ANYTYPE
+        raise BackendError(
+            f"TorchScript attribute {name!r} on "
+            f"{type(obj).__name__} is not supported")
+
+    def _eval_Call(self, node, env):
+        fn = self.eval(node.func, env)
+        args = []
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                args.extend(self.eval(a.value, env))
+            else:
+                args.append(self.eval(a, env))
+        kwargs = {kw.arg: self.eval(kw.value, env)
+                  for kw in node.keywords if kw.arg is not None}
+        if isinstance(fn, _BoundMethod):
+            return fn(*args, **kwargs)
+        if callable(fn):
+            return fn(*args, **kwargs)
+        raise BackendError(
+            f"TorchScript call target {type(fn).__name__} is not "
+            f"callable")
+
+    def _eval_Subscript(self, node, env):
+        obj = self.eval(node.value, env)
+        if isinstance(obj, _AnyType):
+            return _ANYTYPE
+        sl = node.slice
+        if isinstance(sl, ast.Slice):
+            lo = self.eval(sl.lower, env) if sl.lower else None
+            hi = self.eval(sl.upper, env) if sl.upper else None
+            st = self.eval(sl.step, env) if sl.step else None
+            return obj[slice(lo, hi, st)]
+        return obj[self.eval(sl, env)]
+
+    def _eval_UnaryOp(self, node, env):
+        v = self.eval(node.operand, env)
+        k = type(node.op).__name__
+        if k == "USub":
+            return -v
+        if k == "UAdd":
+            return +v
+        if k == "Not":
+            return not self._host_bool(v)
+        if k == "Invert":
+            return ~v
+        raise BackendError(f"TorchScript unary op {k} unsupported")
+
+    def _binop(self, k: str, a, b):
+        import operator as op
+
+        table = {"Add": op.add, "Sub": op.sub, "Mult": op.mul,
+                 "Div": op.truediv, "FloorDiv": op.floordiv,
+                 "Mod": op.mod, "Pow": op.pow, "MatMult": op.matmul,
+                 "BitAnd": op.and_, "BitOr": op.or_, "BitXor": op.xor,
+                 "LShift": op.lshift, "RShift": op.rshift}
+        if k not in table:
+            raise BackendError(f"TorchScript binary op {k} unsupported")
+        return table[k](a, b)
+
+    def _eval_BinOp(self, node, env):
+        return self._binop(type(node.op).__name__,
+                           self.eval(node.left, env),
+                           self.eval(node.right, env))
+
+    def _eval_Compare(self, node, env):
+        import operator as op
+
+        table = {"Eq": op.eq, "NotEq": op.ne, "Lt": op.lt, "LtE": op.le,
+                 "Gt": op.gt, "GtE": op.ge,
+                 "Is": lambda a, b: a is b,
+                 "IsNot": lambda a, b: a is not b,
+                 "In": lambda a, b: a in b,
+                 "NotIn": lambda a, b: a not in b}
+        left = self.eval(node.left, env)
+        result = True
+        for cmp_op, right_n in zip(node.ops, node.comparators):
+            right = self.eval(right_n, env)
+            k = type(cmp_op).__name__
+            if k not in table:
+                raise BackendError(
+                    f"TorchScript comparison {k} unsupported")
+            r = table[k](left, right)
+            if _is_tensor(r):
+                return r         # tensor comparison: no chaining
+            if not r:
+                return False
+            left = right
+        return result
+
+    def _eval_BoolOp(self, node, env):
+        is_and = isinstance(node.op, ast.And)
+        val = None
+        for v in node.values:
+            val = self.eval(v, env)
+            b = self._host_bool(val)
+            if is_and and not b:
+                return val
+            if not is_and and b:
+                return val
+        return val
+
+    def _eval_IfExp(self, node, env):
+        return self.eval(node.body, env) \
+            if self._host_bool(self.eval(node.test, env)) \
+            else self.eval(node.orelse, env)
+
+    def _eval_ListComp(self, node, env):
+        if len(node.generators) != 1:
+            raise BackendError(
+                "TorchScript nested comprehensions unsupported")
+        gen = node.generators[0]
+        it = self.eval(gen.iter, env)
+        out = []
+        sub = dict(env)
+        for v in it:
+            self._bind(gen.target, v, sub)
+            if all(self._host_bool(self.eval(c, sub)) for c in gen.ifs):
+                out.append(self.eval(node.elt, sub))
+        return out
+
+
+class _BoundMethod:
+    __slots__ = ("interp", "mod", "name")
+
+    def __init__(self, interp, mod, name):
+        self.interp = interp
+        self.mod = mod
+        self.name = name
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            raise BackendError(
+                f"TorchScript method {self.name} called with keyword "
+                f"args (unsupported)")
+        return self.interp.call_method(self.mod, self.name, args)
+
+
+# ---------------------------------------------------------------------------
+# op tables
+# ---------------------------------------------------------------------------
+
+def _norm_pair(v, nd: int) -> Tuple[int, ...]:
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * nd
+    v = tuple(int(x) for x in v)
+    return v * nd if len(v) == 1 else v
+
+
+def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    fdt = I.fdt
+
+    def asarr(x):
+        return x if _is_tensor(x) else jnp.asarray(x)
+
+    def both_host(a, b):
+        return not _is_tensor(a) and not _is_tensor(b)
+
+    # -- elementwise / scalar ------------------------------------------
+    def t_add(x, other, alpha=1):
+        if both_host(x, other):
+            return x + alpha * other
+        return asarr(x) + (alpha * asarr(other) if alpha != 1
+                           else asarr(other))
+
+    def t_sub(x, other, alpha=1):
+        if both_host(x, other):
+            return x - alpha * other
+        return asarr(x) - (alpha * asarr(other) if alpha != 1
+                           else asarr(other))
+
+    def t_rsub(x, other, alpha=1):
+        return t_sub(other, x, alpha)
+
+    def t_mul(x, other):
+        return x * other if both_host(x, other) else asarr(x) * asarr(other)
+
+    def t_div(x, other, rounding_mode=None):
+        if rounding_mode == "floor":
+            return jnp.floor_divide(asarr(x), asarr(other))
+        if rounding_mode == "trunc":
+            return jnp.trunc(asarr(x) / asarr(other)).astype(
+                jnp.result_type(x))
+        if both_host(x, other):
+            return x / other
+        a = asarr(x)
+        if not jnp.issubdtype(a.dtype, jnp.floating):
+            a = a.astype(fdt)
+        return a / asarr(other)
+
+    def _cast(np_dt):
+        def f(x, non_blocking=False):
+            return asarr(x).astype(np_dt)
+        return f
+
+    def t_to(x, *args, **kwargs):
+        # serialized overloads: to(x, dtype_code, non_blocking, copy[, fmt])
+        for a in args:
+            if isinstance(a, (int, np.integer)) and not isinstance(a, bool) \
+                    and int(a) in _TORCH_DTYPE_CODES:
+                return asarr(x).astype(_TORCH_DTYPE_CODES[int(a)])
+        return asarr(x)
+
+    def _cmp(jf, pf):
+        def f(a, b):
+            return pf(a, b) if both_host(a, b) else jf(asarr(a), asarr(b))
+        return f
+
+    import operator as pyop
+
+    # -- convolutions --------------------------------------------------
+    def conv_nd(x, w, bias, stride, padding, dilation, groups,
+                transposed=False, output_padding=None):
+        nd = w.ndim - 2
+        stride = _norm_pair(stride, nd)
+        padding = _norm_pair(padding, nd)
+        dilation = _norm_pair(dilation, nd)
+        spec = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+                3: ("NCDHW", "OIDHW", "NCDHW")}.get(nd)
+        if spec is None:
+            raise BackendError(f"conv{nd}d unsupported")
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
+        if transposed:
+            if groups != 1:
+                raise BackendError(
+                    "grouped transposed convolution has no jax lowering "
+                    "here")
+            op = _norm_pair(output_padding or 0, nd)
+            # torch convT weight is (Cin, Cout, *K): swap to OI, flip taps
+            w2 = jnp.swapaxes(w, 0, 1)[
+                (slice(None), slice(None))
+                + (slice(None, None, -1),) * nd]
+            k = w.shape[2:]
+            pad = [((k[i] - 1) * dilation[i] - padding[i],
+                    (k[i] - 1) * dilation[i] - padding[i] + op[i])
+                   for i in range(nd)]
+            return lax.conv_general_dilated(
+                x, w2, window_strides=(1,) * nd, padding=pad,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn)
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=[(p, p) for p in padding], rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=int(groups))
+        if bias is not None:
+            out = out + jnp.reshape(asarr(bias),
+                                    (1, -1) + (1,) * nd)
+        return out
+
+    def t_convolution(x, w, bias, stride, padding, dilation, transposed,
+                      output_padding, groups, *flags):
+        return conv_nd(asarr(x), asarr(w), bias, stride, padding,
+                       dilation, int(groups), bool(transposed),
+                       output_padding)
+
+    def t_conv2d(x, w, bias=None, stride=1, padding=0, dilation=1,
+                 groups=1):
+        return conv_nd(asarr(x), asarr(w), bias, stride, padding,
+                       dilation, int(groups))
+
+    def t_conv1d(x, w, bias=None, stride=1, padding=0, dilation=1,
+                 groups=1):
+        return conv_nd(asarr(x), asarr(w), bias, stride, padding,
+                       dilation, int(groups))
+
+    def t_conv_transpose2d(x, w, bias=None, stride=1, padding=0,
+                           output_padding=0, groups=1, dilation=1):
+        return conv_nd(asarr(x), asarr(w), bias, stride, padding,
+                       dilation, int(groups), transposed=True,
+                       output_padding=output_padding)
+
+    # -- pooling -------------------------------------------------------
+    def _pool_dims(x, kernel, stride, padding, ceil_mode, init):
+        nd = x.ndim - 2
+        k = _norm_pair(kernel, nd)
+        s = _norm_pair(stride, nd) if stride not in (None, [], ())  \
+            else k
+        p = _norm_pair(padding, nd)
+        pads = []
+        for i in range(nd):
+            size = x.shape[2 + i] + 2 * p[i]
+            hi = p[i]
+            if ceil_mode:
+                rem = (size - k[i]) % s[i]
+                if rem:
+                    extra = s[i] - rem
+                    # torch: the last window must start inside the
+                    # input or left padding
+                    if (size + extra - k[i]) // s[i] * s[i] \
+                            < x.shape[2 + i] + p[i]:
+                        hi += extra
+            pads.append((p[i], hi))
+        return k, s, pads
+
+    def t_max_pool2d(x, kernel, stride=None, padding=0, dilation=1,
+                     ceil_mode=False):
+        x = asarr(x)
+        d = _norm_pair(dilation, 2)
+        if d != (1, 1):
+            raise BackendError("dilated max_pool2d unsupported")
+        k, s, pads = _pool_dims(x, kernel, stride, padding,
+                                bool(ceil_mode), "max")
+        lo = (jnp.finfo(x.dtype).min
+              if jnp.issubdtype(x.dtype, jnp.floating)
+              else jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(
+            x, lo, lax.max, (1, 1) + k, (1, 1) + s,
+            ((0, 0), (0, 0)) + tuple(pads))
+
+    def t_max_pool2d_with_indices(x, kernel, stride=None, padding=0,
+                                  dilation=1, ceil_mode=False):
+        raise BackendError(
+            "torch.max_pool2d_with_indices: the indices output has no "
+            "jax lowering (use max_pool2d if the model does not need "
+            "unpooling indices)")
+
+    def t_avg_pool2d(x, kernel, stride=None, padding=0, ceil_mode=False,
+                     count_include_pad=True, divisor_override=None):
+        x = asarr(x)
+        k, s, pads = _pool_dims(x, kernel, stride, padding,
+                                bool(ceil_mode), "add")
+        xf = x.astype(fdt) if not jnp.issubdtype(x.dtype, jnp.floating) \
+            else x
+        acc = lax.reduce_window(
+            xf, np.array(0, xf.dtype), lax.add, (1, 1) + k, (1, 1) + s,
+            ((0, 0), (0, 0)) + tuple(pads))
+        if divisor_override:
+            return acc / divisor_override
+        if count_include_pad and not ceil_mode:
+            return acc / float(np.prod(k))
+        # torch divisor: count_include_pad counts *declared* padding
+        # but never the ceil_mode overhang; otherwise only real
+        # elements count
+        pd = _norm_pair(padding, 2)
+        ones = jnp.ones(x.shape[2:], xf.dtype)[None, None]
+        if count_include_pad:
+            ones = jnp.pad(ones, ((0, 0), (0, 0), (pd[0], pd[0]),
+                                  (pd[1], pd[1])), constant_values=1)
+            cpads = tuple((0, pads[i][1] - pd[i]) for i in range(2))
+        else:
+            cpads = tuple(pads)
+        cnt = lax.reduce_window(
+            ones, np.array(0, xf.dtype), lax.add, (1, 1) + k,
+            (1, 1) + s, ((0, 0), (0, 0)) + cpads)
+        return acc / cnt
+
+    def t_adaptive_avg_pool2d(x, out_size):
+        x = asarr(x)
+        oh, ow = _norm_pair(out_size, 2)
+        h, w = x.shape[-2], x.shape[-1]
+        if (oh, ow) == (1, 1):
+            return jnp.mean(x, axis=(-2, -1), keepdims=True)
+        if h % oh == 0 and w % ow == 0:
+            return t_avg_pool2d(x, (h // oh, w // ow),
+                                (h // oh, w // ow))
+        raise BackendError(
+            f"adaptive_avg_pool2d {h}x{w}->{oh}x{ow} (non-divisible) "
+            f"unsupported")
+
+    # -- linear algebra ------------------------------------------------
+    def t_linear(x, w, bias=None):
+        out = jnp.matmul(asarr(x), jnp.swapaxes(asarr(w), -1, -2))
+        return out if bias is None else out + asarr(bias)
+
+    def t_addmm(bias, m1, m2, beta=1, alpha=1):
+        out = jnp.matmul(asarr(m1), asarr(m2))
+        if alpha != 1:
+            out = out * alpha
+        if bias is not None:
+            out = out + (asarr(bias) if beta == 1
+                         else beta * asarr(bias))
+        return out
+
+    # -- normalization -------------------------------------------------
+    def t_batch_norm(x, weight, bias, running_mean, running_var,
+                     training, momentum, eps, cudnn_enabled=True):
+        if training:
+            raise BackendError(
+                "batch_norm in training mode unsupported (inference "
+                "lowering)")
+        x = asarr(x)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        inv = lax.rsqrt(asarr(running_var).astype(x.dtype)
+                        + np.asarray(eps, np.float32).astype(x.dtype))
+        out = (x - jnp.reshape(asarr(running_mean).astype(x.dtype),
+                               shape)) * jnp.reshape(inv, shape)
+        if weight is not None:
+            out = out * jnp.reshape(asarr(weight).astype(x.dtype), shape)
+        if bias is not None:
+            out = out + jnp.reshape(asarr(bias).astype(x.dtype), shape)
+        return out
+
+    def t_layer_norm(x, normalized_shape, weight=None, bias=None,
+                     eps=1e-5, cudnn_enable=True):
+        x = asarr(x)
+        nd = len(tuple(normalized_shape))
+        axes = tuple(range(x.ndim - nd, x.ndim))
+        mu = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=axes, keepdims=True)
+        out = (x - mu) * lax.rsqrt(var + eps)
+        if weight is not None:
+            out = out * asarr(weight)
+        if bias is not None:
+            out = out + asarr(bias)
+        return out
+
+    # -- shape ---------------------------------------------------------
+    def t_size(x, dim=None):
+        shape = [int(s) for s in asarr(x).shape]
+        return shape if dim is None else shape[dim]
+
+    def t_reshape(x, shape):
+        return jnp.reshape(asarr(x), [int(s) for s in shape])
+
+    def t_flatten(x, start_dim=0, end_dim=-1):
+        x = asarr(x)
+        nd = x.ndim
+        s = start_dim % nd
+        e = end_dim % nd
+        new = x.shape[:s] + (-1,) + x.shape[e + 1:]
+        return jnp.reshape(x, new)
+
+    def t_transpose(x, d0, d1):
+        return jnp.swapaxes(asarr(x), int(d0), int(d1))
+
+    def t_permute(x, dims):
+        return jnp.transpose(asarr(x), [int(d) for d in dims])
+
+    def t_cat(tensors, dim=0):
+        return jnp.concatenate([asarr(t) for t in tensors], axis=int(dim))
+
+    def t_stack(tensors, dim=0):
+        return jnp.stack([asarr(t) for t in tensors], axis=int(dim))
+
+    def t_chunk(x, chunks, dim=0):
+        # torch.chunk: ceil-sized chunks with a short last chunk —
+        # NOT numpy array_split's balanced sizes (7/3 → [3,3,1], not
+        # [3,2,2])
+        x = asarr(x)
+        d = int(dim) % x.ndim
+        n = x.shape[d]
+        step = -(-n // int(chunks))
+        idx = list(range(step, n, step))
+        return jnp.split(x, idx, axis=d)
+
+    def t_split(x, size, dim=0):
+        x = asarr(x)
+        if isinstance(size, (list, tuple)):
+            idx = np.cumsum([int(s) for s in size])[:-1].tolist()
+        else:
+            idx = list(range(int(size), x.shape[int(dim)], int(size)))
+        return jnp.split(x, idx, axis=int(dim))
+
+    def t_select(x, dim, index):
+        return jnp.take(asarr(x), int(index), axis=int(dim))
+
+    def t_slice(x, dim=0, start=None, end=None, step=1):
+        x = asarr(x)
+        sl = [slice(None)] * x.ndim
+        big = 2 ** 62
+        if end is not None and end >= big:
+            end = None
+        sl[int(dim)] = slice(None if start is None else int(start),
+                             None if end is None else int(end),
+                             int(step) if step else 1)
+        return x[tuple(sl)]
+
+    def t_narrow(x, dim, start, length):
+        return t_slice(x, dim, start, int(start) + int(length))
+
+    def t_unsqueeze(x, dim):
+        return jnp.expand_dims(asarr(x), int(dim))
+
+    def t_squeeze(x, dim=None):
+        x = asarr(x)
+        if dim is None:
+            return jnp.squeeze(x)
+        d = int(dim)
+        return jnp.squeeze(x, axis=d) if x.shape[d] == 1 else x
+
+    def t_expand(x, sizes, implicit=False):
+        x = asarr(x)
+        sizes = [int(s) for s in sizes]
+        # align ranks (new leading dims), -1 keeps the existing size
+        lead = len(sizes) - x.ndim
+        tgt = [x.shape[i - lead] if s == -1 else s
+               for i, s in enumerate(sizes)]
+        return jnp.broadcast_to(x, tgt)
+
+    def t_repeat(x, sizes):
+        return jnp.tile(asarr(x), [int(s) for s in sizes])
+
+    def t_pad(x, pad, mode="constant", value=0.0):
+        x = asarr(x)
+        pad = [int(p) for p in pad]
+        if mode != "constant":
+            raise BackendError(f"pad mode {mode!r} unsupported")
+        # torch pad list is (last dim first): [l, r, t, b, ...]
+        cfg = [(0, 0)] * x.ndim
+        for i in range(len(pad) // 2):
+            cfg[x.ndim - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+        return jnp.pad(x, cfg, constant_values=value or 0.0)
+
+    # -- reductions / indexing -----------------------------------------
+    def _axes(dim):
+        if dim is None:
+            return None
+        if isinstance(dim, (list, tuple)):
+            return tuple(int(d) for d in dim)
+        return int(dim)
+
+    def t_mean(x, dim=None, keepdim=False, dtype=None):
+        x = asarr(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(fdt)
+        out = jnp.mean(x, axis=_axes(dim), keepdims=bool(keepdim))
+        if dtype is not None and int(dtype) in _TORCH_DTYPE_CODES:
+            out = out.astype(_TORCH_DTYPE_CODES[int(dtype)])
+        return out
+
+    def t_sum(x, dim=None, keepdim=False, dtype=None):
+        out = jnp.sum(asarr(x), axis=_axes(dim), keepdims=bool(keepdim))
+        if dtype is not None and int(dtype) in _TORCH_DTYPE_CODES:
+            out = out.astype(_TORCH_DTYPE_CODES[int(dtype)])
+        return out
+
+    def t_max(x, other_or_dim=None, keepdim=False):
+        x = asarr(x)
+        if other_or_dim is None:
+            return jnp.max(x)
+        if _is_tensor(other_or_dim):
+            return jnp.maximum(x, other_or_dim)
+        d = int(other_or_dim)
+        return (jnp.max(x, axis=d, keepdims=bool(keepdim)),
+                jnp.argmax(x, axis=d, keepdims=bool(keepdim))
+                .astype(jnp.int32))
+
+    def t_min(x, other_or_dim=None, keepdim=False):
+        x = asarr(x)
+        if other_or_dim is None:
+            return jnp.min(x)
+        if _is_tensor(other_or_dim):
+            return jnp.minimum(x, other_or_dim)
+        d = int(other_or_dim)
+        return (jnp.min(x, axis=d, keepdims=bool(keepdim)),
+                jnp.argmin(x, axis=d, keepdims=bool(keepdim))
+                .astype(jnp.int32))
+
+    def t_topk(x, k, dim=-1, largest=True, sorted=True):
+        x = asarr(x)
+        d = int(dim) % x.ndim
+        xm = jnp.moveaxis(x, d, -1)
+        if not largest:
+            v, i = lax.top_k(-xm, int(k))
+            v = -v
+        else:
+            v, i = lax.top_k(xm, int(k))
+        return (jnp.moveaxis(v, -1, d),
+                jnp.moveaxis(i, -1, d).astype(jnp.int32))
+
+    def t_argmax(x, dim=None, keepdim=False):
+        x = asarr(x)
+        if dim is None:
+            return jnp.argmax(x).astype(jnp.int32)
+        return jnp.argmax(x, axis=int(dim),
+                          keepdims=bool(keepdim)).astype(jnp.int32)
+
+    def t_embedding(weight, indices, padding_idx=-1,
+                    scale_grad_by_freq=False, sparse=False):
+        return jnp.take(asarr(weight), asarr(indices).astype(jnp.int32),
+                        axis=0)
+
+    def t_index_select(x, dim, index):
+        return jnp.take(asarr(x), asarr(index).astype(jnp.int32),
+                        axis=int(dim))
+
+    def t_gather(x, dim, index, sparse_grad=False):
+        return jnp.take_along_axis(
+            asarr(x), asarr(index).astype(jnp.int32), axis=int(dim))
+
+    # -- activations ---------------------------------------------------
+    def t_softmax(x, dim, dtype=None):
+        out = jax.nn.softmax(asarr(x), axis=int(dim))
+        if dtype is not None and int(dtype) in _TORCH_DTYPE_CODES:
+            out = out.astype(_TORCH_DTYPE_CODES[int(dtype)])
+        return out
+
+    def t_log_softmax(x, dim, dtype=None):
+        out = jax.nn.log_softmax(asarr(x), axis=int(dim))
+        if dtype is not None and int(dtype) in _TORCH_DTYPE_CODES:
+            out = out.astype(_TORCH_DTYPE_CODES[int(dtype)])
+        return out
+
+    def t_upsample_nearest2d(x, output_size=None, *scales):
+        x = asarr(x)
+        if output_size:
+            oh, ow = int(output_size[0]), int(output_size[1])
+        else:
+            sc = [s for s in scales if s]
+            f = float(sc[0]) if sc else 2.0
+            oh, ow = int(x.shape[-2] * f), int(x.shape[-1] * f)
+        return jax.image.resize(x, x.shape[:-2] + (oh, ow), "nearest")
+
+    def t_upsample_bilinear2d(x, output_size, align_corners=False,
+                              *scales):
+        if align_corners:
+            raise BackendError(
+                "upsample_bilinear2d align_corners=True unsupported")
+        x = asarr(x)
+        oh, ow = int(output_size[0]), int(output_size[1])
+        return jax.image.resize(x, x.shape[:-2] + (oh, ow), "linear")
+
+    def t_clamp(x, min=None, max=None):
+        return jnp.clip(asarr(x), min, max)
+
+    def t_dropout(x, p=0.5, train=False):
+        if train:
+            raise BackendError("dropout train=True unsupported "
+                               "(inference lowering)")
+        return asarr(x)
+
+    def unary(jf):
+        return lambda x, *a, **k: jf(asarr(x))
+
+    ops: Dict[str, Callable] = {
+        # arithmetic
+        "add": t_add, "add_": t_add, "sub": t_sub, "sub_": t_sub,
+        "rsub": t_rsub, "mul": t_mul, "mul_": t_mul, "div": t_div,
+        "div_": t_div, "floor_divide": lambda a, b: a // b,
+        "remainder": lambda a, b: a % b,
+        "pow": lambda a, b: a ** b,
+        "matmul": lambda a, b: jnp.matmul(asarr(a), asarr(b)),
+        "mm": lambda a, b: jnp.matmul(asarr(a), asarr(b)),
+        "bmm": lambda a, b: jnp.matmul(asarr(a), asarr(b)),
+        "einsum": lambda eq, tensors: jnp.einsum(
+            eq, *[asarr(t) for t in tensors]),
+        "neg": unary(jnp.negative), "abs": unary(jnp.abs),
+        "exp": unary(jnp.exp), "log": unary(jnp.log),
+        "sqrt": unary(jnp.sqrt),
+        "rsqrt": lambda x: 1.0 / jnp.sqrt(asarr(x)),
+        "floor": unary(jnp.floor), "ceil": unary(jnp.ceil),
+        "round": unary(jnp.round), "erf": unary(lax.erf),
+        "sin": unary(jnp.sin), "cos": unary(jnp.cos),
+        "clamp": t_clamp, "clamp_": t_clamp,
+        "clamp_min": lambda x, v: jnp.maximum(asarr(x), v),
+        "clamp_max": lambda x, v: jnp.minimum(asarr(x), v),
+        "maximum": lambda a, b: jnp.maximum(asarr(a), asarr(b)),
+        "minimum": lambda a, b: jnp.minimum(asarr(a), asarr(b)),
+        # comparisons (host ints or tensors)
+        "eq": _cmp(jnp.equal, pyop.eq), "ne": _cmp(jnp.not_equal, pyop.ne),
+        "lt": _cmp(jnp.less, pyop.lt), "le": _cmp(jnp.less_equal, pyop.le),
+        "gt": _cmp(jnp.greater, pyop.gt),
+        "ge": _cmp(jnp.greater_equal, pyop.ge),
+        "__is__": lambda a, b: a is b,
+        "__isnot__": lambda a, b: a is not b,
+        "__not__": lambda a: not a,
+        "__and__": lambda a, b: a and b if both_host(a, b)
+        else jnp.logical_and(asarr(a), asarr(b)),
+        "__or__": lambda a, b: a or b if both_host(a, b)
+        else jnp.logical_or(asarr(a), asarr(b)),
+        # casts
+        "_cast_Float": _cast(fdt), "_cast_Double": _cast(np.float64),
+        "_cast_Half": _cast(np.float16), "_cast_Byte": _cast(np.uint8),
+        "_cast_Char": _cast(np.int8), "_cast_Short": _cast(np.int16),
+        "_cast_Int": _cast(np.int32), "_cast_Long": _cast(np.int64),
+        "_cast_Bool": _cast(np.bool_), "to": t_to,
+        "detach": lambda x: asarr(x), "clone": lambda x: asarr(x),
+        "contiguous": lambda x, *a, **k: asarr(x),
+        # creation
+        "zeros": lambda size, **k: jnp.zeros([int(s) for s in size], fdt),
+        "ones": lambda size, **k: jnp.ones([int(s) for s in size], fdt),
+        "zeros_like": lambda x, **k: jnp.zeros_like(asarr(x)),
+        "ones_like": lambda x, **k: jnp.ones_like(asarr(x)),
+        "full": lambda size, v, **k: jnp.full(
+            [int(s) for s in size], v, fdt),
+        "full_like": lambda x, v, **k: jnp.full_like(asarr(x), v),
+        "arange": lambda *a, **k: jnp.arange(
+            *[x for x in a if x is not None][:3]),
+        "tensor": lambda v, **k: jnp.asarray(v),
+        "scalar_tensor": lambda v, **k: jnp.asarray(v, fdt),
+        # nn
+        "_convolution": t_convolution, "conv2d": t_conv2d,
+        "conv1d": t_conv1d, "conv_transpose2d": t_conv_transpose2d,
+        "linear": t_linear, "addmm": t_addmm,
+        "max_pool2d": t_max_pool2d,
+        "max_pool2d_with_indices": t_max_pool2d_with_indices,
+        "avg_pool2d": t_avg_pool2d,
+        "adaptive_avg_pool2d": t_adaptive_avg_pool2d,
+        "batch_norm": t_batch_norm, "layer_norm": t_layer_norm,
+        "embedding": t_embedding,
+        "upsample_nearest2d": t_upsample_nearest2d,
+        "upsample_bilinear2d": t_upsample_bilinear2d,
+        "dropout": t_dropout, "dropout_": t_dropout,
+        "feature_dropout": t_dropout,
+        # activations
+        "relu": lambda x: jax.nn.relu(asarr(x)),
+        "relu_": lambda x: jax.nn.relu(asarr(x)),
+        "relu6": lambda x: jnp.clip(asarr(x), 0, 6),
+        "threshold": lambda x, t, v: jnp.where(asarr(x) > t, asarr(x), v),
+        "threshold_": lambda x, t, v: jnp.where(asarr(x) > t, asarr(x), v),
+        "leaky_relu": lambda x, s=0.01: jax.nn.leaky_relu(asarr(x), s),
+        "leaky_relu_": lambda x, s=0.01: jax.nn.leaky_relu(asarr(x), s),
+        "elu": lambda x, a=1.0, *r: jax.nn.elu(asarr(x), a),
+        "gelu": lambda x, approximate="none": jax.nn.gelu(
+            asarr(x), approximate=(approximate == "tanh")),
+        "silu": lambda x: jax.nn.silu(asarr(x)),
+        "sigmoid": lambda x: jax.nn.sigmoid(asarr(x)),
+        "tanh": unary(jnp.tanh),
+        "hardtanh": lambda x, lo=-1.0, hi=1.0: jnp.clip(asarr(x), lo, hi),
+        "hardtanh_": lambda x, lo=-1.0, hi=1.0: jnp.clip(asarr(x), lo, hi),
+        "hardswish": lambda x: asarr(x) * jnp.clip(
+            asarr(x) + 3, 0, 6) / 6,
+        "hardsigmoid": lambda x: jnp.clip(asarr(x) / 6 + 0.5, 0, 1),
+        "softmax": t_softmax, "log_softmax": t_log_softmax,
+        # shape
+        "size": t_size, "dim": lambda x: asarr(x).ndim,
+        "numel": lambda x: int(np.prod(asarr(x).shape)),
+        "reshape": t_reshape, "view": t_reshape, "flatten": t_flatten,
+        "transpose": t_transpose, "transpose_": t_transpose,
+        "t": lambda x: jnp.swapaxes(asarr(x), -1, -2),
+        "permute": t_permute, "cat": t_cat, "stack": t_stack,
+        "chunk": t_chunk, "split": t_split,
+        "unbind": lambda x, dim=0: [
+            jnp.take(asarr(x), i, axis=int(dim))
+            for i in range(asarr(x).shape[int(dim)])],
+        "select": t_select, "slice": t_slice, "narrow": t_narrow,
+        "unsqueeze": t_unsqueeze, "unsqueeze_": t_unsqueeze,
+        "squeeze": t_squeeze, "squeeze_": t_squeeze,
+        "expand": t_expand,
+        "expand_as": lambda x, o: jnp.broadcast_to(
+            asarr(x), asarr(o).shape),
+        "repeat": t_repeat, "pad": t_pad,
+        "constant_pad_nd": lambda x, pad, v=0.0: t_pad(
+            x, pad, "constant", v),
+        # reductions / indexing
+        "mean": t_mean, "sum": t_sum, "max": t_max, "min": t_min,
+        "topk": t_topk, "argmax": t_argmax,
+        "argmin": lambda x, dim=None, keepdim=False: jnp.argmin(
+            asarr(x), axis=None if dim is None else int(dim),
+            keepdims=bool(keepdim)).astype(jnp.int32),
+        "index_select": t_index_select, "gather": t_gather,
+        "where": lambda c, a, b: jnp.where(asarr(c), asarr(a), asarr(b)),
+        # misc
+        "warn": lambda *a, **k: None,
+        "format": lambda fmt, *a: str(fmt).format(*a),
+        "len": lambda x: len(x) if not _is_tensor(x)
+        else int(asarr(x).shape[0]),
+        "device": lambda x: "cpu",
+        "list": lambda x: list(x),
+        "append": lambda lst, v: (lst.append(v), lst)[1],
+    }
+    return ops
+
+
+def _make_prim_ops(I: "_Interp") -> Dict[str, Callable]:
+    def raise_exc(msg="", *a):
+        raise BackendError(
+            f"TorchScript model raised at trace time: {msg}")
+
+    def prim_dtype(x):
+        dt = np.dtype(getattr(x, "dtype", type(x)))
+        for code, np_dt in _TORCH_DTYPE_CODES.items():
+            if dt == np_dt:
+                return code
+        raise BackendError(
+            f"TorchScript prim::dtype: no torch dtype code for {dt}")
+
+    return {
+        "NumToTensor": lambda v: v,
+        "ImplicitTensorToNum": lambda v: v,
+        "unchecked_unwrap_optional": lambda v: v,
+        "unchecked_cast": lambda _t, v: v,
+        "RaiseException": raise_exc,
+        "min": min, "max": max,
+        "TupleConstruct": lambda *a: tuple(a),
+        "ListConstruct": lambda *a: list(a),
+        "dtype": prim_dtype,
+        "device": lambda x: "cpu",
+    }
+
+
+# ---------------------------------------------------------------------------
+# lowering entry point
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LoweredTS:
+    fn: Callable
+    params: Dict[str, Any]
+    name: str
+
+
+def _flatten_out(out) -> tuple:
+    if isinstance(out, (tuple, list)):
+        flat: List[Any] = []
+        for o in out:
+            flat.extend(_flatten_out(o))
+        return tuple(flat)
+    return (out,)
+
+
+def lower_torchscript(path: str,
+                      compute_dtype: str = "float32") -> LoweredTS:
+    """Load a ``.pt`` archive and lower it to ``fn(params, *inputs)``.
+
+    ``compute_dtype`` sets the float compute type; the default is
+    float32 for numeric fidelity with torch-exported weights (the
+    reference's pytorch filter also runs fp32 —
+    tensor_filter_pytorch.cc).  Pass ``bfloat16`` (``custom=dtype=
+    bfloat16``) to run the MXU-native type at ~2x the matmul rate.
+    """
+    import jax.numpy as jnp
+
+    prog = load_torchscript(path)
+    if "forward" not in prog.classes.get(prog.root.qualname,
+                                         _ClassInfo("")).methods:
+        raise BackendError(
+            f"{path!r}: no serialized forward() found for root module "
+            f"{prog.root.qualname}")
+    if compute_dtype in ("bfloat16", "bf16"):
+        fdt = jnp.bfloat16
+    elif compute_dtype in ("float32", "fp32", "float"):
+        fdt = jnp.float32
+    else:
+        raise BackendError(
+            f"torchscript compute dtype {compute_dtype!r} unsupported "
+            f"(float32 or bfloat16)")
+
+    params = {
+        k: (v.astype(np.dtype(fdt) if fdt != jnp.bfloat16 else
+            jnp.bfloat16) if np.issubdtype(v.dtype, np.floating) else v)
+        for k, v in prog.params.items()
+    }
+    consts = [
+        (a.astype(fdt) if isinstance(a, np.ndarray)
+         and np.issubdtype(a.dtype, np.floating) else a)
+        for a in prog.constants
+    ]
+
+    # the interpreter reads weights from the `p` passed into fn, never
+    # from TSProgram.params — keep the run program weight-free so the
+    # closure does not pin the uncast originals in host memory
+    run_prog = TSProgram(root=prog.root, classes=prog.classes,
+                         functions=prog.functions, params={},
+                         constants=consts, name=prog.name)
+
+    def fn(p, *inputs):
+        interp = _Interp(run_prog, p, fdt)
+        out = interp.call_method(run_prog.root, "forward",
+                                 tuple(inputs))
+        return _flatten_out(out)
+
+    return LoweredTS(fn=fn, params=params, name=run_prog.name)
