@@ -876,11 +876,20 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
         return f
 
     def t_to(x, *args, **kwargs):
-        # serialized overloads: to(x, dtype_code, non_blocking, copy[, fmt])
+        # serialized overloads: to(x, dtype_code, non_blocking, copy
+        # [, memory_format]) or to(x, other_tensor, ...)
         for a in args:
-            if isinstance(a, (int, np.integer)) and not isinstance(a, bool) \
-                    and int(a) in _TORCH_DTYPE_CODES:
-                return asarr(x).astype(_TORCH_DTYPE_CODES[int(a)])
+            if _is_tensor(a):
+                return asarr(x).astype(asarr(a).dtype)
+            if isinstance(a, (int, np.integer)) \
+                    and not isinstance(a, bool):
+                code = int(a)
+                if code in _TORCH_DTYPE_CODES:
+                    return asarr(x).astype(_TORCH_DTYPE_CODES[code])
+                if code == 15:           # torch.bfloat16
+                    return asarr(x).astype(jnp.bfloat16)
+                raise BackendError(
+                    f"torch.to: dtype code {code} has no jax lowering")
         return asarr(x)
 
     def _cmp(jf, pf):
@@ -916,14 +925,15 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
             pad = [((k[i] - 1) * dilation[i] - padding[i],
                     (k[i] - 1) * dilation[i] - padding[i] + op[i])
                    for i in range(nd)]
-            return lax.conv_general_dilated(
+            out = lax.conv_general_dilated(
                 x, w2, window_strides=(1,) * nd, padding=pad,
                 lhs_dilation=stride, rhs_dilation=dilation,
                 dimension_numbers=dn)
-        out = lax.conv_general_dilated(
-            x, w, window_strides=stride,
-            padding=[(p, p) for p in padding], rhs_dilation=dilation,
-            dimension_numbers=dn, feature_group_count=int(groups))
+        else:
+            out = lax.conv_general_dilated(
+                x, w, window_strides=stride,
+                padding=[(p, p) for p in padding], rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=int(groups))
         if bias is not None:
             out = out + jnp.reshape(asarr(bias),
                                     (1, -1) + (1,) * nd)
@@ -1278,9 +1288,23 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
         if output_size:
             oh, ow = int(output_size[0]), int(output_size[1])
         else:
-            sc = [s for s in scales if s]
-            f = float(sc[0]) if sc else 2.0
-            oh, ow = int(x.shape[-2] * f), int(x.shape[-1] * f)
+            # serialized trailing args are (scales_h, scales_w) — or,
+            # in newer serializations, one [scales_h, scales_w] list
+            sc = []
+            for s in scales:
+                if isinstance(s, (list, tuple)):
+                    sc.extend(v for v in s if v is not None)
+                elif s is not None:
+                    sc.append(s)
+            if len(sc) >= 2:
+                fh, fw = float(sc[0]), float(sc[1])
+            elif len(sc) == 1:
+                fh = fw = float(sc[0])
+            else:
+                raise BackendError(
+                    "upsample_nearest2d without output_size or scale "
+                    "factors")
+            oh, ow = int(x.shape[-2] * fh), int(x.shape[-1] * fw)
         return jax.image.resize(x, x.shape[:-2] + (oh, ow), "nearest")
 
     def t_upsample_bilinear2d(x, output_size, align_corners=False,
@@ -1336,6 +1360,8 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
         "__is__": lambda a, b: a is b,
         "__isnot__": lambda a, b: a is not b,
         "__not__": lambda a: not a,
+        "__contains__": lambda c, item: item in c,
+        "__getitem__": lambda c, i: c[i],
         "__and__": lambda a, b: a and b if both_host(a, b)
         else jnp.logical_and(asarr(a), asarr(b)),
         "__or__": lambda a, b: a or b if both_host(a, b)

@@ -916,3 +916,241 @@ def test_pipeline_classifies_reference_orange_sample():
     assert len(res) == 1
     assert res[0].meta["label"] == "orange"
     assert res[0].meta["label_index"] == 951
+
+
+# -- real-weights pose golden (VERDICT r3 missing #3) ------------------------
+
+@pytest.fixture(scope="module")
+def pose_model(tmp_path_factory):
+    """Tiny converter-built PoseNet-style head: image → conv backbone →
+    (1,8,8,K) sigmoid heatmaps + (1,8,8,2K) linear offsets."""
+    tf = pytest.importorskip("tensorflow")
+    d = tmp_path_factory.mktemp("pose_tflite")
+    K = 5
+    rng_init = tf.keras.initializers.RandomNormal(stddev=0.15, seed=11)
+    inp = tf.keras.Input((64, 64, 3), batch_size=1)
+    x = tf.keras.layers.Conv2D(8, 3, strides=4, padding="same",
+                               activation="relu",
+                               kernel_initializer=rng_init)(inp)
+    x = tf.keras.layers.Conv2D(16, 3, strides=2, padding="same",
+                               activation="relu",
+                               kernel_initializer=rng_init)(x)
+    hm = tf.keras.layers.Conv2D(K, 1, activation="sigmoid",
+                                kernel_initializer=rng_init,
+                                name="heatmaps")(x)
+    off = tf.keras.layers.Conv2D(2 * K, 1,
+                                 kernel_initializer=rng_init,
+                                 name="offsets")(x)
+    model = tf.keras.Model(inp, [hm, off])
+    path = str(d / "pose.tflite")
+    open(path, "wb").write(_convert_frozen(tf, model, (1, 64, 64, 3)))
+    return {"path": path, "K": K}
+
+
+def _convert_frozen(tf, model, in_shape):
+    """keras → frozen-consts concrete function → tflite (the conversion
+    path whose blobs the stock interpreter executes correctly; plain
+    from_keras_model leaves resource-variable captures that the
+    interpreter resolves to zeros in this TF build)."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    f = tf.function(lambda x: model(x),
+                    input_signature=[tf.TensorSpec(in_shape, tf.float32)])
+    frozen = convert_variables_to_constants_v2(f.get_concrete_function())
+    conv = tf.lite.TFLiteConverter.from_concrete_functions([frozen], model)
+    return conv.convert()
+
+
+def _pose_reference_decode(hm, off, in_px=64, out_px=64):
+    """Independent numpy PoseNet decode (tensordec-pose.c:845 rule):
+    per-channel heatmap argmax + short-range offset refinement, written
+    from the spec — NOT the decoder under test."""
+    h, w, k = hm.shape
+    flat = hm.reshape(-1, k)
+    idx = flat.argmax(0)
+    ys, xs = np.unravel_index(idx, (h, w))
+    score = flat[idx, np.arange(k)]
+    fy = (ys + 0.5) / h + off[ys, xs, np.arange(k)] / in_px
+    fx = (xs + 0.5) / w + off[ys, xs, k + np.arange(k)] / in_px
+    return np.stack([fx * out_px, fy * out_px, score], axis=1)
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_pose_pipeline_real_weights_golden(pose_model, device):
+    """Real-weights pose golden: the converter-built model runs through
+    tensor_filter → tensor_decoder mode=pose_estimation (host AND
+    device variants) and the keypoints match an independent decode of
+    the tf.lite.Interpreter's own outputs."""
+    tf = pytest.importorskip("tensorflow")
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    x = np.random.default_rng(21).normal(
+        0, 1, (1, 64, 64, 3)).astype(np.float32)
+
+    # BUILTIN_WITHOUT_DEFAULT_DELEGATES: this TF build's XNNPACK
+    # delegate miscomputes the strided-conv chain (returns bias-only
+    # outputs); the plain builtin kernels match keras execution
+    interp = tf.lite.Interpreter(
+        model_path=pose_model["path"],
+        experimental_op_resolver_type=tf.lite.experimental
+        .OpResolverType.BUILTIN_WITHOUT_DEFAULT_DELEGATES)
+    interp.allocate_tensors()
+    interp.set_tensor(interp.get_input_details()[0]["index"], x)
+    interp.invoke()
+    outs = {tuple(o["shape"]): interp.get_tensor(o["index"])
+            for o in interp.get_output_details()}
+    K = pose_model["K"]
+    hm = outs[(1, 8, 8, K)][0]
+    off = outs[(1, 8, 8, 2 * K)][0]
+    exp = _pose_reference_decode(hm, off)
+
+    # the converter serializes its own output order; the decoder wants
+    # (heatmaps, offsets) — reorder with the reference's
+    # output-combination property when needed
+    first = tuple(interp.get_output_details()[0]["shape"])
+    combo = "" if first == (1, 8, 8, K) else "output_combination=o1,o0 "
+    dev = "device=true " if device else ""
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=3:64:64:1 types=float32 ! "
+        f"tensor_filter model={pose_model['path']} "
+        f"custom=dtype=float32 {combo}! "
+        f"tensor_decoder mode=pose_estimation {dev}option1=64:64 "
+        f"option2=64:64 option4=0.0 ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    pipe.get("src").push(TensorBuffer.of(x))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    got = (np.asarray(res[0].tensors[0]) if device
+           else res[0].meta["keypoints"])
+    assert got.shape == (K, 3)
+    np.testing.assert_allclose(got, exp, atol=0.05)
+
+
+# -- mobilenet-ssd anchors-scheme golden (VERDICT r3 missing/weak #6) --------
+
+@pytest.fixture(scope="module")
+def raw_ssd_model(tmp_path_factory):
+    """Converter-built raw-grid SSD head: image → conv → dense →
+    (1,1917,4) box deltas + (1,1917,5) class logits — the layout the
+    `mobilenet-ssd` scheme decodes with in-code anchors + NMS."""
+    tf = pytest.importorskip("tensorflow")
+    from nnstreamer_tpu.models.ssd_mobilenet import generate_anchors
+
+    A = int(generate_anchors().shape[0])       # 1917
+    C = 5
+    d = tmp_path_factory.mktemp("rawssd_tflite")
+    init = tf.keras.initializers.RandomNormal(stddev=0.05, seed=13)
+    inp = tf.keras.Input((8, 8, 3), batch_size=1)
+    x = tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu",
+                               kernel_initializer=init)(inp)
+    x = tf.keras.layers.Flatten()(x)
+    loc = tf.keras.layers.Reshape((A, 4))(
+        tf.keras.layers.Dense(A * 4, kernel_initializer=init)(x))
+    logits = tf.keras.layers.Reshape((A, C))(
+        tf.keras.layers.Dense(A * C, kernel_initializer=init)(x))
+    model = tf.keras.Model(inp, [loc, logits])
+    path = str(d / "raw_ssd.tflite")
+    open(path, "wb").write(_convert_frozen(tf, model, (1, 8, 8, 3)))
+    return {"path": path, "A": A, "C": C}
+
+
+def _ssd_reference_decode(loc, logits, anchors, score_thresh, iou_thresh,
+                          out_px):
+    """Independent numpy mobilenet-ssd decode, written from the
+    reference's box-prior spec (tensordec-boundingbox.c:143-158):
+    sigmoid scores, skip background class 0, box-coder (10,10,5,5)
+    decode against [cy,cx,h,w] priors, global greedy NMS."""
+    sc = 1.0 / (1.0 + np.exp(-logits))
+    cls = sc[:, 1:].argmax(-1) + 1
+    score = sc[np.arange(len(cls)), cls]
+    keep = score >= score_thresh
+    loc, cls, score, anchors = (loc[keep], cls[keep], score[keep],
+                                anchors[keep])
+    cy = loc[:, 0] / 10.0 * anchors[:, 2] + anchors[:, 0]
+    cx = loc[:, 1] / 10.0 * anchors[:, 3] + anchors[:, 1]
+    h = anchors[:, 2] * np.exp(loc[:, 2] / 5.0)
+    w = anchors[:, 3] * np.exp(loc[:, 3] / 5.0)
+    boxes = np.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2], 1)
+    # greedy NMS, independent re-implementation
+    order = np.argsort(-score)
+    chosen = []
+    for i in order:
+        ok = True
+        for j in chosen:
+            y0 = max(boxes[i, 0], boxes[j, 0])
+            x0 = max(boxes[i, 1], boxes[j, 1])
+            y1 = min(boxes[i, 2], boxes[j, 2])
+            x1 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0.0, y1 - y0) * max(0.0, x1 - x0)
+            ai = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            aj = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            union = ai + aj - inter
+            if union > 0 and inter / union > iou_thresh:
+                ok = False
+                break
+        if ok:
+            chosen.append(i)
+    det = np.concatenate(
+        [boxes[chosen], score[chosen, None],
+         cls[chosen, None].astype(np.float32)], axis=1)
+    det[:, [0, 2]] *= out_px
+    det[:, [1, 3]] *= out_px
+    return det
+
+
+@pytest.mark.parametrize("compact", [False, True])
+def test_raw_ssd_anchors_scheme_golden(raw_ssd_model, compact):
+    """The anchors path of scheme=mobilenet-ssd (raw loc+score grids +
+    generated priors + decoder NMS) against an independent numpy decode
+    of the interpreter's outputs — round 3 only goldened the
+    postprocess scheme. Also checks device=compact parity."""
+    tf = pytest.importorskip("tensorflow")
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.models.ssd_mobilenet import generate_anchors
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    x = np.random.default_rng(31).normal(
+        0, 1, (1, 8, 8, 3)).astype(np.float32)
+    interp = tf.lite.Interpreter(
+        model_path=raw_ssd_model["path"],
+        experimental_op_resolver_type=tf.lite.experimental
+        .OpResolverType.BUILTIN_WITHOUT_DEFAULT_DELEGATES)
+    interp.allocate_tensors()
+    interp.set_tensor(interp.get_input_details()[0]["index"], x)
+    interp.invoke()
+    A, C = raw_ssd_model["A"], raw_ssd_model["C"]
+    outs = {tuple(o["shape"]): interp.get_tensor(o["index"])
+            for o in interp.get_output_details()}
+    loc = outs[(1, A, 4)][0]
+    logits = outs[(1, A, C)][0]
+    exp = _ssd_reference_decode(loc, logits, generate_anchors(),
+                                score_thresh=0.6, iou_thresh=0.5,
+                                out_px=300)
+    assert len(exp) >= 3          # the golden must actually exercise NMS
+
+    first = tuple(interp.get_output_details()[0]["shape"])
+    combo = "" if first == (1, A, 4) else "output_combination=o1,o0 "
+    dev = "device=compact " if compact else ""
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=3:8:8:1 types=float32 ! "
+        f"tensor_filter model={raw_ssd_model['path']} "
+        f"custom=dtype=float32 {combo}! "
+        f"tensor_decoder mode=bounding_boxes {dev}option1=mobilenet-ssd "
+        f"option3=0.6:0.5 option4=300:300 ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    pipe.get("src").push(TensorBuffer.of(x))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    got = res[0].meta["boxes"]
+    assert got.shape == exp.shape
+    order_g = np.argsort(-got[:, 4])
+    order_e = np.argsort(-exp[:, 4])
+    np.testing.assert_allclose(got[order_g], exp[order_e], atol=0.1)

@@ -282,3 +282,30 @@ def test_chunk_and_ceil_avgpool_match_torch(tmp_path):
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(outs[1]), r2.numpy(),
                                rtol=1e-6)
+
+
+@needs_torch
+def test_conv_transpose_and_upsample_match_torch(tmp_path):
+    """ConvTranspose2d WITH bias (decoder/upsampling heads) and
+    anisotropic nearest upsampling, vs torch."""
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.up = tnn.ConvTranspose2d(6, 3, 4, stride=2, padding=1,
+                                          bias=True)
+
+        def forward(self, x):
+            y = torch.relu(self.up(x))
+            return F.interpolate(y, scale_factor=(2.0, 3.0),
+                                 mode="nearest")
+
+    net = Net().eval()
+    b = _script_and_load(tmp_path, net)
+    x = np.random.RandomState(5).randn(1, 6, 5, 7).astype(np.float32)
+    ours = np.asarray(_run_bundle(b, x)[0])
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)

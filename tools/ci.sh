@@ -14,6 +14,9 @@ echo "== static gate (lint + bytecode compile) =="
 python tools/lint.py
 python -m compileall -q nnstreamer_tpu tests tools bench.py __graft_entry__.py
 
+echo "== generated docs up to date =="
+JAX_PLATFORMS=cpu python tools/gen_docs.py --check
+
 echo "== single-chip compile check (__graft_entry__.entry) =="
 python - <<'EOF'
 import __graft_entry__ as g

@@ -1,0 +1,110 @@
+"""Generate docs/elements.md from the element/decoder registries.
+
+The reference ships per-element docs (gst/nnstreamer/elements/
+gsttensor_*.md + Documentation/component-description.md); here the
+single source of truth is the registry itself — every PropDef and class
+docstring (which carry the reference file:line citations) renders into
+one browsable page.  CI regenerates and diffs, so the page cannot drift
+from the code.
+
+Usage:
+    python tools/gen_docs.py          # writes docs/elements.md
+    python tools/gen_docs.py --check  # exit 1 if the file is stale
+"""
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "docs", "elements.md")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _doc(obj) -> str:
+    d = obj.__doc__ or ""
+    return textwrap.dedent("    " + d.strip()).strip() if d.strip() else ""
+
+
+def _props_table(cls) -> str:
+    rows = ["| property | default | description |",
+            "|---|---|---|"]
+    for prop, pd in cls.PROPS.items():
+        doc = (pd.doc or "").replace("|", "\\|")
+        rows.append(f"| `{prop.replace('_', '-')}` | `{pd.default!r}` "
+                    f"| {doc} |")
+    return "\n".join(rows) if len(rows) > 2 else ""
+
+
+def render() -> str:
+    import nnstreamer_tpu.decoders  # noqa: F401 (register)
+    import nnstreamer_tpu.elements  # noqa: F401 (register)
+    from nnstreamer_tpu.core.registry import PluginKind, registry
+
+    parts = [
+        "# Element reference",
+        "",
+        "Generated from the element registry by `tools/gen_docs.py` — "
+        "do not edit by hand (`python tools/gen_docs.py` regenerates; "
+        "CI diffs it).  The same information is available at the CLI "
+        "via `python -m nnstreamer_tpu --inspect [element]`.",
+        "",
+        "Docstrings cite the reference implementation "
+        "(`file.c:line`) each element is parity-matched against.",
+        "",
+    ]
+    names = sorted(registry.names(PluginKind.ELEMENT))
+    parts.append("## Elements")
+    parts.append("")
+    for n in names:
+        # GitHub heading slugs preserve underscores
+        parts.append(f"- [`{n}`](#{n})")
+    parts.append("")
+    for n in names:
+        cls = registry.get(PluginKind.ELEMENT, n)
+        parts.append(f"### {n}")
+        parts.append("")
+        parts.append(f"*class `{cls.__module__}.{cls.__name__}`*")
+        parts.append("")
+        doc = _doc(cls)
+        if doc:
+            parts.append(doc)
+            parts.append("")
+        table = _props_table(cls)
+        if table:
+            parts.append(table)
+            parts.append("")
+    parts.append("## Decoder modes (`tensor_decoder mode=`)")
+    parts.append("")
+    for n in sorted(registry.names(PluginKind.DECODER)):
+        cls = registry.get(PluginKind.DECODER, n)
+        parts.append(f"### mode={n}")
+        parts.append("")
+        doc = _doc(cls) or _doc(sys.modules.get(cls.__module__))
+        if doc:
+            parts.append(doc)
+            parts.append("")
+    return "\n".join(parts).rstrip() + "\n"
+
+
+def main() -> int:
+    text = render()
+    if "--check" in sys.argv:
+        on_disk = open(OUT).read() if os.path.exists(OUT) else ""
+        if on_disk != text:
+            print("docs/elements.md is stale — run python "
+                  "tools/gen_docs.py", file=sys.stderr)
+            return 1
+        print("docs/elements.md up to date")
+        return 0
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        f.write(text)
+    print(f"wrote {OUT} ({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
