@@ -11,6 +11,10 @@ Formats:
 - `.pt` — TorchScript archives (legacy model.json AND modern data.pkl
   generations), parsed from scratch and AST-lowered to one XLA
   computation (torchscript.py) — no torch needed at load time.
+- `.uff` — NVIDIA/TensorRT UFF MetaGraph, protowire-decoded and lowered
+  to one XLA program (uff.py).
+- `.caffemodel` — Caffe NetParameter snapshots (graph + blobs in one
+  file), protowire-decoded (caffe.py).
 
 `load_model_file(path, **opts)` dispatches on extension and returns a
 `backends.xla.ModelBundle`.
@@ -29,7 +33,7 @@ import nnstreamer_tpu.modelio.tflite_custom  # noqa: F401 (registers ops)
 
 #: extensions this package can ingest → default backend
 MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla", "pb": "xla",
-                    "pt": "xla"}
+                    "pt": "xla", "uff": "xla", "caffemodel": "xla"}
 
 
 def load_model_file(path: str, batch: Optional[int] = None,
@@ -76,11 +80,11 @@ def load_model_file(path: str, batch: Optional[int] = None,
             f"{sorted(MODEL_EXTENSIONS)}")
     ext = path.rsplit(".", 1)[-1].lower() if "." in path else ""
 
-    if ext != "pb" and (input_names or output_names):
+    if ext not in ("pb", "uff") and (input_names or output_names):
         # fail loudly rather than silently ignoring a binding request
         raise BackendError(
-            f"inputname/outputname bind GraphDef/NetDef nodes and apply "
-            f"to .pb models only (got a .{ext} file)")
+            f"inputname/outputname bind graph nodes and apply to "
+            f".pb/.uff models only (got a .{ext} file)")
     if side is not None:
         raise BackendError(
             f"custom=side= declares a caffe2 NetDef input resolution "
@@ -155,6 +159,28 @@ def load_model_file(path: str, batch: Optional[int] = None,
         return ModelBundle(fn=lowered.fn, params=lowered.params,
                            in_spec=None, out_spec=None,
                            name=os.path.basename(path))
+
+    if ext == "uff":
+        from nnstreamer_tpu.modelio.uff import lower_uff, parse_uff
+
+        lowered = lower_uff(parse_uff(path), input_names=input_names,
+                            output_names=output_names)
+        # UFF carries no input shape (reference: pipeline-declared
+        # dims); fn is NHWC shape-polymorphic, specs negotiate from caps
+        return ModelBundle(fn=lowered.fn, params=lowered.params,
+                           in_spec=None, out_spec=None,
+                           name=os.path.basename(path))
+
+    if ext == "caffemodel":
+        from nnstreamer_tpu.modelio.caffe import (
+            lower_caffe, parse_caffemodel)
+
+        lowered = lower_caffe(parse_caffemodel(path), batch=batch)
+        return ModelBundle(
+            fn=lowered.fn, params=lowered.params,
+            in_spec=mk(lowered.in_shapes, lowered.in_dtypes),
+            out_spec=mk(lowered.out_shapes, lowered.out_dtypes),
+            name=os.path.basename(path))
 
     if ext == "npz":
         arch, params = load_params(path)

@@ -1,0 +1,176 @@
+"""Caffe (.caffemodel) and TensorRT-UFF (.uff) ingestion goldens.
+
+Both use the reference's own checked-in lenet weights and the
+reference's own test semantics:
+
+* ``lenet_iter_9000.caffemodel`` + ``9.raw`` with (x-127.5)/127.5
+  normalization → argmax 9 (the armnn suite's golden,
+  unittest_filter_armnn.cc:580).
+* ``lenet5.uff`` + ``{1,9}.pgm`` with 1 - x/255 normalization →
+  argmax {1,9} (the tensorrt suite's golden, runTest.sh:68 — the same
+  ``div:-255.0,add:1`` transform option string, even).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.modelio import load_model_file
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+MODELS = "/root/reference/tests/test_models/models"
+DATA = "/root/reference/tests/test_models/data"
+CAFFE_LENET = os.path.join(MODELS, "lenet_iter_9000.caffemodel")
+UFF_LENET = os.path.join(MODELS, "lenet5.uff")
+
+needs_models = pytest.mark.skipif(
+    not all(os.path.exists(p) for p in
+            (CAFFE_LENET, UFF_LENET,
+             os.path.join(DATA, "9.raw"), os.path.join(DATA, "1.pgm"),
+             os.path.join(DATA, "9.pgm"))),
+    reason="reference test models/data absent")
+
+
+def _pgm_digit(name):
+    raw = open(os.path.join(DATA, name), "rb").read()
+    return np.frombuffer(raw[-784:], np.uint8).reshape(28, 28)
+
+
+def _run_bundle(bundle, *inputs):
+    import jax
+
+    return jax.jit(lambda p, *xs: bundle.fn(p, *xs))(
+        bundle.params, *inputs)
+
+
+# -- caffe -------------------------------------------------------------------
+
+@needs_models
+def test_caffemodel_lenet_classifies_nine():
+    """armnn-suite golden: 9.raw, (x-127.5)/127.5, prob argmax 9."""
+    b = load_model_file(CAFFE_LENET)
+    x = np.fromfile(os.path.join(DATA, "9.raw"), np.uint8)
+    x = ((x.astype(np.float32) - 127.5) / 127.5).reshape(1, 1, 28, 28)
+    y = np.asarray(_run_bundle(b, x)[0])
+    assert y.shape == (1, 10)
+    assert int(y.argmax()) == 9
+    assert y[0, 9] > 0.99           # softmax probability, decisive
+    np.testing.assert_allclose(y.sum(), 1.0, atol=1e-4)
+
+
+@needs_models
+def test_caffemodel_full_pipeline():
+    """End-to-end with the reference normalization as a fused
+    tensor_transform (extension auto-detect, declared Input shape)."""
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=28:28:1:1 types=uint8 ! "
+        f"tensor_transform mode=arithmetic "
+        f"option=typecast:float32,add:-127.5,div:127.5 ! "
+        f"tensor_filter model={CAFFE_LENET} ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    x = np.fromfile(os.path.join(DATA, "9.raw"), np.uint8)
+    pipe.get("src").push(TensorBuffer.of(x.reshape(1, 1, 28, 28)))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    assert int(np.asarray(res[0].tensors[0]).argmax()) == 9
+
+
+@needs_models
+def test_caffemodel_unknown_layer_fails_loud(tmp_path):
+    from nnstreamer_tpu.modelio.caffe import lower_caffe, parse_caffemodel
+
+    net = parse_caffemodel(CAFFE_LENET)
+    net.layers[1].type = "FancyNewLayer"
+    # the shape probe inside lower_caffe already walks the graph
+    with pytest.raises(BackendError, match="FancyNewLayer"):
+        lower_caffe(net)
+
+
+def test_caffemodel_not_a_model_fails_loud(tmp_path):
+    p = tmp_path / "junk.caffemodel"
+    p.write_bytes(b"\x00\x01nope")
+    with pytest.raises(Exception):
+        load_model_file(str(p))
+
+
+# -- uff ---------------------------------------------------------------------
+
+@needs_models
+@pytest.mark.parametrize("digit", [1, 9])
+def test_uff_lenet_classifies_reference_digits(digit):
+    """tensorrt-suite golden: {1,9}.pgm, 1 - x/255, argmax {1,9}."""
+    b = load_model_file(UFF_LENET)
+    img = _pgm_digit(f"{digit}.pgm").astype(np.float32)
+    x = (1.0 - img / 255.0).reshape(1, 28, 28, 1)
+    y = np.asarray(_run_bundle(b, x)[0])
+    assert y.shape == (1, 10)
+    assert int(y.argmax()) == digit
+    assert y[0, digit] > 5.0        # logits, decisive
+
+
+@needs_models
+def test_uff_full_pipeline_reference_transform():
+    """End-to-end with the reference's exact transform option string
+    (runTest.sh: typecast:float32,div:-255.0,add:1)."""
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=1:28:28:1 types=uint8 ! "
+        f"tensor_transform mode=arithmetic "
+        f"option=typecast:float32,div:-255.0,add:1 ! "
+        f"tensor_filter model={UFF_LENET} ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    pipe.get("src").push(
+        TensorBuffer.of(_pgm_digit("9.pgm").reshape(1, 28, 28, 1)))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    assert int(np.asarray(res[0].tensors[0]).argmax()) == 9
+
+
+@needs_models
+def test_uff_structure():
+    from nnstreamer_tpu.modelio.uff import parse_uff
+
+    g = parse_uff(UFF_LENET)
+    assert g.outputs == ["out"]
+    assert "in" in g.nodes and g.nodes["in"].op == "Input"
+    ops = {n.op for n in g.nodes.values()}
+    assert {"Conv", "Pool", "FullyConnected", "Binary",
+            "Activation"} <= ops
+
+
+@needs_models
+def test_uff_unknown_op_fails_loud():
+    import jax
+
+    from nnstreamer_tpu.modelio.uff import lower_uff, parse_uff
+
+    g = parse_uff(UFF_LENET)
+    g.nodes["relu"].op = "MysteryOp"
+    m = lower_uff(g)
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    with pytest.raises(BackendError, match="MysteryOp"):
+        jax.jit(m.fn)(m.params, x)
+
+
+@needs_models
+def test_uff_inputname_outputname_binding():
+    """The reference's exact tensorrt invocation uses inputname=in
+    outputname=out (runTest.sh:68) — binding must validate and select."""
+    b = load_model_file(UFF_LENET, input_names=["in"],
+                        output_names=["out"])
+    img = _pgm_digit("9.pgm").astype(np.float32)
+    y = np.asarray(_run_bundle(b, (1.0 - img / 255.0)
+                               .reshape(1, 28, 28, 1))[0])
+    assert int(y.argmax()) == 9
+    with pytest.raises(BackendError, match="no-such-node"):
+        load_model_file(UFF_LENET, output_names=["no-such-node"])
+    with pytest.raises(BackendError, match="Input node"):
+        load_model_file(UFF_LENET, input_names=["wrong"])
