@@ -25,14 +25,19 @@ FLOPs vs the chip's bf16 peak).
 Environment note: this driver reaches the chip through a network tunnel
 whose D2H reads are expensive (~10ms RTT, ~20MB/s) AND degrade
 subsequent dispatch in-process (measured: label_device drops 2846 →
-~12 FPS once any readback has happened; slow recovery). Local TPU hosts
-do the same D2H in microseconds. The bench therefore (a) runs the fully
-device-resident configs FIRST (label_device/composite/ssd_device/
-posenet_device — no D2H at all), (b) then the readback-barrier
-measurements (batch sweep / int8 / pallas, whose differencing method is
-immune to the degradation it causes), (c) then the honest host-path
-configs, and (d) probes the tunnel (`env`) so numbers can be
-interpreted.
+~12 FPS once any readback has happened; slow recovery that in round 3
+made the in-process flash numbers land ~3x above quiet-chip). Local TPU
+hosts do the same D2H in microseconds. The bench therefore:
+(a) runs every differencing-method measurement family (pallas/flash,
+    transformer_prefill, batch_sweep, int8) AND each point of the
+    offload batching-delay sweep in its OWN SUBPROCESS with a fresh TPU
+    client — each sees a quiet chip, and no family's readbacks poison
+    another's dispatch (`python bench.py --family X`);
+(b) after all subprocesses exit, runs the remaining pipeline configs
+    in-process: fully device-resident configs FIRST (label_device/
+    composite/ssd_device/posenet_device — no D2H at all), then the
+    honest host-path configs;
+(c) probes the tunnel (`env`) so numbers can be interpreted.
 
 Prints ONE JSON line; headline metric stays mobilenet FPS/chip
 vs the 30 FPS driver target (BASELINE.json).
@@ -404,7 +409,46 @@ def _build_composite():
     return pipe, src, sink, (x, x.copy())
 
 
-def offload_bench(n_frames=None, n_lat=None):
+#: MeshDispatcher coalescing windows swept for BASELINE row 5 — each
+#: point runs as its own subprocess family (a fresh chip per point: one
+#: point's closed-loop readbacks must not poison the next's dispatch)
+OFFLOAD_DELAYS = (0.0, 3.0, 8.0, 32.0)
+
+
+def _offload_point(delay_ms: float):
+    # full round-3 sizing: shorter runs under-amortize the client
+    # pipelining ramp (measured: n_frames=32 under-reports ~2x)
+    sizes = dict(n_frames=48, n_lat=16) if _on_tpu() else {}
+    return offload_bench(max_delay_ms=delay_ms, **sizes)
+
+
+def _assemble_offload(curve: dict):
+    """BASELINE row 5 asks for p50 *reported* — round 3 bought 249 FPS
+    with p50 139.8ms via batching and no knob was measured. From the
+    per-delay subprocess results, pick the default operating point: the
+    lowest-latency delay that still clears ~200 FPS aggregate with
+    p50 <= 60ms. The chosen point's numbers are the headline `offload`
+    result; the full curve ships alongside so the tradeoff is
+    driver-visible."""
+    ok = {float(k): v for k, v in curve.items()
+          if isinstance(v, dict) and "fps" in v}
+    if not ok:
+        return {"sweep": curve}
+    good = {d: v for d, v in ok.items()
+            if v["fps"] >= 200.0 and v["p50_ms"] <= 60.0}
+    if good:
+        chosen = min(good, key=lambda d: good[d]["p50_ms"])
+    else:   # fall back: best throughput among sub-60ms, else best fps
+        sub60 = {d: v for d, v in ok.items() if v["p50_ms"] <= 60.0}
+        pick_from = sub60 or ok
+        chosen = max(pick_from, key=lambda d: pick_from[d]["fps"])
+    out = dict(ok[chosen])
+    out["chosen_delay_ms"] = chosen
+    out["sweep"] = curve
+    return out
+
+
+def offload_bench(n_frames=None, n_lat=None, max_delay_ms=3.0):
     """BASELINE row 5: edge offload. Frames from FOUR concurrent client
     pipelines ship to one loopback BatchedQueryServer (MeshDispatcher
     coalesces all clients' frames into dp-sharded batches — SURVEY §3.4
@@ -433,8 +477,8 @@ def offload_bench(n_frames=None, n_lat=None):
     from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
 
     bqs = BatchedQueryServer(
-        "zoo://mobilenet_v2", sid=9, port=0, bucket=8, max_delay_ms=3.0,
-        pre=normalize,
+        "zoo://mobilenet_v2", sid=9, port=0, bucket=8,
+        max_delay_ms=max_delay_ms, pre=normalize,
         in_spec=TensorsSpec.of(TensorInfo((1, 224, 224, 3), DType.UINT8)))
     port = bqs.port
     frame = np.random.default_rng(0).integers(0, 256, (1, 224, 224, 3),
@@ -574,6 +618,12 @@ def _step_ms(f, *args, n1=20, n2=100):
     return max((t_b - t_a) / (n2 - n1) * 1e3, 1e-6)
 
 
+def _med3(f, *a, n1=20, n2=80):
+    """Median of three differencing samples: tunnel jitter can make one
+    sample implausible (even negative)."""
+    return sorted(_step_ms(f, *a, n1=n1, n2=n2) for _ in range(3))[1]
+
+
 def batch_sweep(batches=None):
     """Fused-forward MobileNetV2 throughput per batch.
 
@@ -614,9 +664,11 @@ def batch_sweep(batches=None):
             x = ((x.astype(np.float32) - 127.5) / 127.5)
         compiled = fn.lower(params, x).compile()
         flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
-        # pure compute, input resident on device
+        # pure compute, input resident on device (median of three
+        # differencing samples: single samples can be off by 2-8x
+        # under tunnel jitter — measured b=8/b=32 inversions)
         xd = jax.device_put(x)
-        ms = _step_ms(fn, params, xd)
+        ms = _med3(fn, params, xd, n1=10, n2=50)
         fps = b / ms * 1e3
         tflops = flops / (ms / 1e3) / 1e12 if flops else 0.0
         # pipelined host→device staging (double-buffered feeder); the
@@ -725,14 +777,8 @@ def pallas_check():
             ff(q, k, v).astype(jnp.float32)
             - fr(q, k, v).astype(jnp.float32))))
 
-        def med3(f, *a):
-            # tunnel jitter can make one differencing sample implausible
-            # (even negative); the median of three is stable
-            return sorted(_step_ms(f, *a, n1=20, n2=80)
-                          for _ in range(3))[1]
-
-        ours = med3(ff, q, k, v)
-        xla = med3(fr, q, k, v)
+        ours = _med3(ff, q, k, v)
+        xla = _med3(fr, q, k, v)
         flops = 4 * B * H * S * S * D / 2          # causal
         out["flash_attention"] = {
             "s2048_ms": round(ours, 3),
@@ -742,17 +788,196 @@ def pallas_check():
                 100 * flops / (ours / 1e3) / 1e12 / PEAK_BF16_TFLOPS, 1),
             "max_abs_err": round(err, 4),
         }
+        out["flash_long_s"] = _flash_long_s()
     return out
 
 
+def _flash_long_s():
+    """Long-sequence flash rows (§5.7 long-context): S=8192 on the plain
+    q-block grid (vs the XLA softmax, which still fits), and S=32768
+    where the kernel auto-switches to the K-blocked streaming grid
+    (per-head K/V = 16MB, past the 8MB VMEM budget; XLA comparison is
+    omitted there — the materialized (H,S,S) score tensor is the thing
+    the kernel exists to avoid)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.backends import pallas_ops
+    from nnstreamer_tpu.parallel.ring_attention import reference_attention
+
+    H, D = 8, 128
+    out = {}
+    # S=32768: per-head K/V = 2*S*D*2B = 16MB, past the 8MB VMEM budget
+    # (S=16384 is exactly AT the budget and still takes the plain grid)
+    for S, vs_xla in ((8192, True), (32768, False)):
+        key = jax.random.PRNGKey(S)
+        q, k, v = (jax.random.normal(kk, (1, S, H, D), jnp.bfloat16)
+                   for kk in jax.random.split(key, 3))
+        ff = jax.jit(lambda q, k, v: pallas_ops.flash_attention(
+            q, k, v, causal=True))
+        # loop counts sized so the differencing delta clears the ~17ms
+        # readback jitter: s8192 steps are ~1ms (needs many), s32768
+        # ~35ms (few suffice)
+        n1, n2 = (20, 100) if S <= 8192 else (5, 20)
+        ms = _med3(ff, q, k, v, n1=n1, n2=n2)
+        flops = 4 * 1 * H * S * S * D / 2          # causal
+        row = {
+            "ms": round(ms, 3),
+            "mfu_pct": round(
+                100 * flops / (ms / 1e3) / 1e12 / PEAK_BF16_TFLOPS, 1),
+        }
+        if vs_xla:
+            fr = jax.jit(lambda q, k, v: reference_attention(
+                q, k, v, causal=True))
+            err = float(jnp.max(jnp.abs(
+                ff(q, k, v).astype(jnp.float32)
+                - fr(q, k, v).astype(jnp.float32))))
+            xla = _med3(fr, q, k, v, n1=2, n2=8)
+            row["xla_attn_ms"] = round(xla, 3)
+            row["speedup_vs_xla"] = round(xla / ms, 2)
+            row["max_abs_err"] = round(err, 4)
+        out[f"s{S}"] = row
+    return out
+
+
+def transformer_prefill():
+    """Compute-bound MFU demonstration (VERDICT r3 missing #2): a
+    bf16 transformer prefill sized so the MXU matmuls dominate
+    (arithmetic intensity ~B*S — far past the HBM roofline knee where
+    MobileNet lives). FLOPs are XLA-counted on the all-XLA variant and
+    applied to both timings (identical math); `mfu_pct` at top level is
+    the best variant, the driver-visible compute-utilization number."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nnstreamer_tpu.models import transformer as T
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        d_model, n_heads, n_layers, B, S, vocab = 1024, 8, 4, 8, 2048, 512
+    else:   # CI smoke: same code path, toy size
+        d_model, n_heads, n_layers, B, S, vocab = 128, 2, 2, 1, 256, 64
+    params = T.init_params(d_model=d_model, n_heads=n_heads,
+                           n_layers=n_layers, vocab=vocab)
+    params = jax.device_put(jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if a.dtype == jnp.float32 else a, params))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, vocab, (B, S), np.int32))
+
+    def make(attn):
+        return jax.jit(lambda p, i: T.apply_seq(
+            p, i, n_heads=n_heads, dtype=jnp.bfloat16, attn=attn))
+
+    fx = make("xla")
+    compiled = fx.lower(params, ids).compile()
+    flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
+    out = {"config": {"d_model": d_model, "n_layers": n_layers,
+                      "n_heads": n_heads, "batch": B, "seq": S},
+           "flops_per_step": flops}
+    best = 0.0
+    for name, f in (("xla_attn", fx), ("pallas_attn", make("pallas"))):
+        ms = _med3(f, params, ids, n1=5, n2=20)
+        tfl = flops / (ms / 1e3) / 1e12 if flops else 0.0
+        mfu = round(100 * tfl / PEAK_BF16_TFLOPS, 1) if on_tpu else 0.0
+        out[name] = {"ms": round(ms, 3), "tflops": round(tfl, 2),
+                     "mfu_pct": mfu,
+                     "tokens_per_s": round(B * S / ms * 1e3)}
+        best = max(best, mfu)
+    out["mfu_pct"] = best
+    return out
+
+
+#: differencing-method measurement families, each run in its own
+#: subprocess with a fresh TPU client (quiet chip per family; no
+#: cross-family dispatch poisoning — round-3 lesson)
+_FAMILIES = {
+    "pallas": lambda: pallas_check(),
+    "transformer_prefill": lambda: transformer_prefill(),
+    "batch_sweep": lambda: batch_sweep(),
+    "int8_native": lambda: int8_native_check(),
+}
+for _d in OFFLOAD_DELAYS:
+    _FAMILIES[f"offload_{_d}"] = (
+        lambda _d=_d: _offload_point(_d))
+
+_FAMILY_SENTINEL = "BENCHJSON:"
+
+
+def _run_family_subprocess(name: str, errors: dict):
+    """Run one measurement family in a child process; the parent has not
+    touched jax yet, so the child owns the chip alone."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--family", name],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=1800, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        errors[name] = "family subprocess timed out (1800s)"
+        return {}
+    for line in proc.stdout.decode(errors="replace").splitlines():
+        if not line.startswith(_FAMILY_SENTINEL):
+            continue
+        try:
+            payload = json.loads(line[len(_FAMILY_SENTINEL):])
+        except json.JSONDecodeError as e:
+            errors[name] = f"family emitted corrupt result: {e}"
+            return {}
+        if "error" in payload:
+            errors[name] = payload["error"]
+            return {}
+        return payload["result"]
+    stderr_tail = proc.stderr.decode(errors="replace").strip() \
+        .splitlines()[-3:]
+    errors[name] = (f"family subprocess exited {proc.returncode} "
+                    f"without a result"
+                    + (f"; stderr: {' | '.join(stderr_tail)}"
+                       if stderr_tail else ""))
+    return {}
+
+
+def _family_main(name: str) -> int:
+    try:
+        result = _FAMILIES[name]()
+        print(_FAMILY_SENTINEL + json.dumps({"result": result}))
+        return 0
+    except Exception as e:
+        print(_FAMILY_SENTINEL + json.dumps(
+            {"error": f"{type(e).__name__}: {e}"}))
+        return 1
+
+
 def main() -> int:
+    if "--family" in sys.argv:
+        idx = sys.argv.index("--family") + 1
+        if idx >= len(sys.argv) or sys.argv[idx] not in _FAMILIES:
+            print(f"usage: bench.py --family "
+                  f"{{{','.join(sorted(_FAMILIES))}}}", file=sys.stderr)
+            return 2
+        return _family_main(sys.argv[idx])
     results = {}
     errors = {}
-    # ORDER MATTERS on the tunneled dev chip: ANY host readback (even the
-    # 4-byte differencing barriers) degrades subsequent dispatch with slow
-    # recovery. Fully device-resident configs therefore run FIRST, then
-    # the readback-barrier measurements (whose differencing is immune to
-    # the degradation they cause), then the honest host-path configs.
+    # Phase 1 — differencing-method families, one subprocess each with a
+    # fresh client (the parent must not import jax before these finish:
+    # only one process can own the chip).
+    family_out = {name: _run_family_subprocess(name, errors)
+                  for name in _FAMILIES}
+    sweep = family_out["batch_sweep"]
+    int8_native = family_out["int8_native"]
+    pallas = family_out["pallas"]
+    prefill = family_out["transformer_prefill"]
+    offload_curve = {
+        str(d): family_out.get(f"offload_{d}")
+        or {"error": errors.get(f"offload_{d}", "no result")}
+        for d in OFFLOAD_DELAYS}
+    results["offload"] = _assemble_offload(offload_curve)
+    # Phase 2 — pipeline configs in-process. ORDER STILL MATTERS within
+    # the process: ANY host readback (even 4-byte barriers) degrades
+    # subsequent dispatch with slow recovery, so fully device-resident
+    # configs run FIRST, then the honest host-path configs.
     try:
         results["label_device"] = _Bench(_build_label_device).run()
     except Exception as e:
@@ -779,22 +1004,6 @@ def main() -> int:
             results[name] = _Bench(build).run()
         except Exception as e:
             errors[name] = f"{type(e).__name__}: {e}"
-    # readback-barrier measurements (differencing method)
-    try:
-        sweep = batch_sweep()
-    except Exception as e:
-        sweep = {}
-        errors["batch_sweep"] = f"{type(e).__name__}: {e}"
-    try:
-        int8_native = int8_native_check()
-    except Exception as e:
-        int8_native = {}
-        errors["int8_native"] = f"{type(e).__name__}: {e}"
-    try:
-        pallas = pallas_check()
-    except Exception as e:
-        pallas = {}
-        errors["pallas"] = f"{type(e).__name__}: {e}"
     try:
         env = _probe_env()
     except Exception as e:
@@ -819,11 +1028,6 @@ def main() -> int:
                                    lag=lag).run(**kw)
         except Exception as e:
             errors[name] = f"{type(e).__name__}: {e}"
-    # BASELINE row 5: edge offload over the loopback query server
-    try:
-        results["offload"] = offload_bench()
-    except Exception as e:
-        errors["offload"] = f"{type(e).__name__}: {e}"
 
     headline = results.get("label_device", {}).get("fps", 0.0)
     out = {
@@ -835,6 +1039,7 @@ def main() -> int:
         "batch_sweep": sweep,
         "int8_native": int8_native,
         "pallas": pallas,
+        "transformer_prefill": prefill,
         "env": env,
     }
     if errors:

@@ -243,8 +243,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
     """Fused attention for (B, S, H, D) tensors — the transformer hot op
     as a Pallas kernel (flash-attention online softmax; S×S scores never
     leave VMEM). Block sizes auto-tune to the largest dividing powers of
-    two ≤ (512, 1024) — measured 24.7% MFU at S=2048 causal on v5e,
-    5.6× XLA's fused attention and 3.9× the stock
+    two ≤ (512, 1024) — driver-measured (BENCH_r04.json, quiet chip)
+    ~30% MFU / ~7× XLA's fused attention at S=2048 causal on v5e,
+    ~49% MFU / ~158× at S=8192, and ~4× the stock
     jax.experimental.pallas TPU kernel (whose defaults undersize the
     MXU work per step). Requires S % block == 0 (pad upstream); falls
     back to interpret mode off-TPU like every kernel here.

@@ -134,17 +134,22 @@ def _hw(d, f_single, f_h, f_w, default) -> Tuple[int, int]:
 
 
 def _pool2d(jnp_mod, x, kind: str, k, s, p):
-    """Caffe pooling: output size uses CEIL — pad high as needed, with
-    the identity value so the overhang never wins."""
+    """Caffe pooling: output size uses CEIL, then the clip rule — the
+    last window must start inside the image + left pad
+    (pooling_layer.cpp: decrement when (out-1)*stride >= size+pad).
+    Padding-high is derived from the exact output count, with the
+    identity value so the overhang never wins."""
     import jax.numpy as jnp
     from jax import lax
 
     pads = []
     for i in range(2):
-        size = x.shape[2 + i] + 2 * p[i]
-        rem = (size - k[i]) % s[i]
-        extra = (s[i] - rem) if rem else 0
-        pads.append((p[i], p[i] + extra))
+        size = x.shape[2 + i]
+        out = -(-(size + 2 * p[i] - k[i]) // s[i]) + 1   # ceil
+        if p[i] and (out - 1) * s[i] >= size + p[i]:
+            out -= 1
+        hi = max((out - 1) * s[i] + k[i] - size - p[i], 0)
+        pads.append((p[i], hi))
     if kind == "max":
         lo = (jnp.finfo(x.dtype).min
               if jnp.issubdtype(x.dtype, jnp.floating)
@@ -246,12 +251,24 @@ def lower_caffe(net: CaffeNet, batch: Optional[int] = None,
                     k = _hw(pd, _P_KERNEL, _P_KERNEL_H, _P_KERNEL_W, 1)
                     s = _hw(pd, _P_STRIDE, _P_STRIDE_H, _P_STRIDE_W, 1)
                     pad = _hw(pd, _P_PAD, _P_PAD_H, _P_PAD_W, 0)
-                kind = "max" if _rep_int(pd, _P_POOL, 0) == 0 else "ave"
+                pool_enum = _rep_int(pd, _P_POOL, 0)
+                if pool_enum not in (0, 1):
+                    raise BackendError(
+                        f"caffe Pooling method enum {pool_enum} "
+                        f"({layer.name}) has no jax lowering (MAX/AVE "
+                        f"only; STOCHASTIC is train-time sampling)")
+                kind = "max" if pool_enum == 0 else "ave"
                 out = _pool2d(jnp, x_in, kind, k, s, pad)
             elif t == "InnerProduct":
                 x_in = get(layer.bottoms[0])
-                flat = x_in.reshape(x_in.shape[0], -1)
-                out = flat @ jnp.asarray(w[0]).T
+                ipd = pw.fields_dict(layer.params[_L_IP][0]) \
+                    if _L_IP in layer.params else {}
+                axis = _rep_int(ipd, _IP_AXIS, 1)
+                transpose = bool(pw.first(ipd, _IP_TRANSPOSE, 0))
+                lead = int(np.prod(x_in.shape[:axis])) if axis else 1
+                flat = x_in.reshape(lead, -1)
+                wm = jnp.asarray(w[0])
+                out = flat @ (wm if transpose else wm.T)
                 if len(w) > 1:
                     out = out + jnp.asarray(w[1]).reshape(1, -1)
             elif t == "ReLU":
@@ -273,16 +290,40 @@ def lower_caffe(net: CaffeNet, batch: Optional[int] = None,
             elif t == "Eltwise":
                 xs = [get(b) for b in layer.bottoms]
                 op = 1     # default SUM
+                coeffs = None
                 ep = layer.params.get(_L_ELTWISE)
                 if ep:
-                    op = _rep_int(pw.fields_dict(ep[0]), 1, 1)
-                out = xs[0]
-                for other in xs[1:]:
-                    out = (out * other if op == 0 else
-                           out + other if op == 1 else
-                           jnp.maximum(out, other))
+                    ed = pw.fields_dict(ep[0])
+                    op = _rep_int(ed, 1, 1)
+                    if 2 in ed:   # coeff (repeated float, SUM only)
+                        coeffs = [pw.fixed32_to_float(v)
+                                  for v in ed[2]]
+                if coeffs is not None:
+                    if op != 1:
+                        raise BackendError(
+                            f"caffe Eltwise ({layer.name}): coeff with "
+                            f"non-SUM operation is invalid")
+                    if len(coeffs) != len(xs):
+                        raise BackendError(
+                            f"caffe Eltwise ({layer.name}): "
+                            f"{len(coeffs)} coeffs for {len(xs)} "
+                            f"bottoms")
+                    out = coeffs[0] * xs[0]
+                    for c, other in zip(coeffs[1:], xs[1:]):
+                        out = out + c * other
+                else:
+                    out = xs[0]
+                    for other in xs[1:]:
+                        out = (out * other if op == 0 else
+                               out + other if op == 1 else
+                               jnp.maximum(out, other))
             elif t == "LRN":
                 ld = pw.fields_dict(layer.params[_L_LRN][0])
+                if _rep_int(ld, 4, 0) != 0:    # norm_region
+                    raise BackendError(
+                        f"caffe LRN ({layer.name}): WITHIN_CHANNEL "
+                        f"norm_region has no jax lowering "
+                        f"(ACROSS_CHANNELS only)")
                 size = _rep_int(ld, _LRN_SIZE, 5)
                 alpha = pw.fixed32_to_float(
                     pw.first(ld, _LRN_ALPHA, 0)) or 1.0

@@ -459,8 +459,9 @@ class _Interp:
             "len": len, "range": range, "min": min, "max": max,
             "abs": abs, "print": lambda *a, **k: None,
             # traced Sequential containers address numeric submodule
-            # names via getattr(self, "0")
-            "getattr": lambda obj, name, *_d: self._getattr(obj, name),
+            # names via getattr(self, "0"); honor an explicit default
+            "getattr": lambda obj, name, *d: self._try_getattr(
+                obj, name, d),
             "Optional": _ANYTYPE, "List": _ANYTYPE, "Tuple": _ANYTYPE,
             "Dict": _ANYTYPE, "Final": _ANYTYPE, "Tensor": _ANYTYPE,
             "NoneType": _ANYTYPE, "Any": _ANYTYPE, "number": _ANYTYPE,
@@ -629,6 +630,14 @@ class _Interp:
     def _eval_Attribute(self, node, env):
         obj = self.eval(node.value, env)
         return self._getattr(obj, node.attr)
+
+    def _try_getattr(self, obj, name: str, default: tuple):
+        try:
+            return self._getattr(obj, name)
+        except BackendError:
+            if default:
+                return default[0]
+            raise
 
     def _getattr(self, obj, name: str):
         if isinstance(obj, _TSModule):

@@ -59,7 +59,7 @@ class UffGraph:
     blobs: Dict[str, bytes]
 
 
-def _decode_data(buf: bytes, blobs: Dict[str, bytes]):
+def _decode_data(buf: bytes):
     d = pw.fields_dict(buf)
     if 1 in d:
         return d[1][0].decode()
@@ -114,7 +114,7 @@ def parse_uff(path: str) -> UffGraph:
         for fb in nd.get(4, []):
             fd = pw.fields_dict(fb)
             key = pw.first(fd, 1, b"").decode()
-            node.fields[key] = _decode_data(pw.first(fd, 2, b""), blobs)
+            node.fields[key] = _decode_data(pw.first(fd, 2, b""))
         nodes[node.id] = node
         order.append(node.id)
         if node.op == "MarkOutput":

@@ -116,8 +116,9 @@ def apply_seq(params, ids, *, n_heads=4, dtype=jnp.float32,
 
     With a mesh, attention runs ring-parallel over `sp_axis` (sequence
     sharded, K/V rotating over ICI). Without, `attn` picks the kernel:
-    "pallas" = the flash-attention Pallas kernel (1.6-21x over the XLA
-    softmax at S=2k-8k on v5e, measured), "xla" = plain causal softmax,
+    "pallas" = the flash-attention Pallas kernel (~7x over the XLA
+    softmax at S=2048 on v5e, driver-measured in BENCH_r04.json —
+    growing with S), "xla" = plain causal softmax,
     "auto" = pallas when the sequence divides its 128-blocks, else xla.
     """
     from nnstreamer_tpu.parallel.ring_attention import (

@@ -174,3 +174,25 @@ def test_uff_inputname_outputname_binding():
         load_model_file(UFF_LENET, output_names=["no-such-node"])
     with pytest.raises(BackendError, match="Input node"):
         load_model_file(UFF_LENET, input_names=["wrong"])
+
+
+def test_caffe_pool_ceil_and_clip_rule():
+    """Caffe pooling output sizing: CEIL, then the clip rule — the last
+    window must start inside image+pad (pooling_layer.cpp). H=3,k=2,
+    s=2,p=1: ceil gives 3 but the clip drops to 2."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.modelio.caffe import _pool2d
+
+    x = jnp.arange(9, dtype=jnp.float32).reshape(1, 1, 3, 3)
+    out = _pool2d(jnp, x, "max", (2, 2), (2, 2), (1, 1))
+    assert out.shape == (1, 1, 2, 2)
+    # windows: [-1..0]x[-1..0] -> 0 ; [-1..0]x[1..2] -> 2 ;
+    #          [1..2]x[-1..0]  -> 6 ; [1..2]x[1..2]  -> 8
+    np.testing.assert_array_equal(
+        np.asarray(out)[0, 0], [[0, 2], [6, 8]])
+    # no padding, divisible: plain 2x2/2 pooling unchanged
+    out2 = _pool2d(jnp, jnp.ones((1, 1, 4, 4)), "ave", (2, 2), (2, 2),
+                   (0, 0))
+    assert out2.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(np.asarray(out2), 1.0)
