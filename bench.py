@@ -929,10 +929,11 @@ def transformer_prefill():
     # streaming decode (§5.7): one token per step through the ring
     # KV cache — the HBM-bound half of the serving story (params are
     # re-read every step; prefill above is the MXU-bound half)
-    # cache dtype is the apply_step contract (float32 accumulators)
+    # bf16 cache STORAGE (decode is HBM-bound by the cache sweep;
+    # softmax/accumulators stay f32 on read — parity-tested)
     kc, vc, pos = T.init_cache(batch=B, max_len=min(S, 2048),
                                d_model=d_model, n_heads=n_heads,
-                               n_layers=n_layers)
+                               n_layers=n_layers, dtype=jnp.bfloat16)
     kc, vc = jax.device_put(kc), jax.device_put(vc)
     step_ids = jnp.zeros((B, 1), jnp.int32)
 

@@ -157,14 +157,19 @@ def apply_seq(params, ids, *, n_heads=4, dtype=jnp.float32,
 
 
 def init_cache(*, batch=1, max_len=128, d_model=64, n_heads=4, n_layers=2,
-               n_kv_heads=None):
+               n_kv_heads=None, dtype=jnp.float32):
     """KV cache as TWO stacked tensors (pipeline-friendly state):
     k/v: (L, B, max_len, n_kv, D) — GQA narrows it by the group factor.
-    Position rides a (1,) int32 tensor."""
+    Position rides a (1,) int32 tensor.
+
+    `dtype` is the cache STORAGE type; attention math upcasts to f32 on
+    read regardless. Decode is HBM-bound by the cache sweep, so bf16
+    storage ~doubles tokens/s at max_len where the cache dominates
+    (the softmax/accumulator precision is unchanged)."""
     hd = d_model // n_heads
     n_kv = n_kv_heads or n_heads
     shape = (n_layers, batch, max_len, n_kv, hd)
-    return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32),
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
             jnp.zeros((1,), jnp.int32))
 
 
@@ -188,23 +193,24 @@ def apply_step(params, ids, k_cache, v_cache, pos, *, n_heads=4,
         q, k, v = _qkv(blk, h, n_heads, dtype)
         q, k = rope(q, pvec), rope(k, pvec)
         kc = jax.lax.dynamic_update_slice(
-            k_cache[li], k.astype(jnp.float32), (0, slot, 0, 0))
+            k_cache[li], k.astype(k_cache.dtype), (0, slot, 0, 0))
         vc = jax.lax.dynamic_update_slice(
-            v_cache[li], v.astype(jnp.float32), (0, slot, 0, 0))
+            v_cache[li], v.astype(v_cache.dtype), (0, slot, 0, 0))
         new_k.append(kc)
         new_v.append(vc)
         # attend over the populated window (all slots once wrapped)
         scale = q.shape[-1] ** -0.5
         # cache layout is (B, max_len, n_kv, D): expand KV groups to
-        # full heads for the attention einsum
-        kcx = _expand_kv(kc, n_heads)
+        # full heads for the attention einsum; scores/softmax in f32
+        # regardless of the cache storage dtype
+        kcx = _expand_kv(kc, n_heads).astype(jnp.float32)
         s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                        kcx) * scale                 # (B,H,1,max_len)
         mask = (jnp.arange(max_len) <=
                 jnp.minimum(p, max_len - 1))[None, None, None, :]
         s = jnp.where(mask, s, -1e30)
         pattn = jax.nn.softmax(s, axis=-1)
-        vcx = _expand_kv(vc, n_heads)
+        vcx = _expand_kv(vc, n_heads).astype(jnp.float32)
         attn = jnp.einsum("bhqk,bkhd->bqhd", pattn, vcx).astype(dtype)
         x = x + attn.reshape(b, 1, -1) @ blk["wo"].astype(dtype)
         h = rmsnorm(x, blk["ln2"].astype(dtype))

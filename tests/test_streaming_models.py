@@ -458,3 +458,36 @@ def test_generate_sampling_distribution_and_top_k():
         out = T.generate(params, prompt, 1, n_heads=2, max_len=8,
                          temperature=1.0, top_k=2, seed=s)
         assert int(np.asarray(out)[0, -1]) in top2
+
+
+def test_transformer_bf16_cache_matches_f32_cache():
+    """Cache storage dtype is configurable (decode is HBM-bound by the
+    cache sweep; bf16 storage ~halves the bytes). bf16-cache decode
+    must track the f32-cache decode closely — the softmax/accumulator
+    math stays f32 on read."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models import transformer as T
+
+    d, H, L, V, S = 32, 4, 2, 64, 9
+    params = T.init_params(d_model=d, n_heads=H, n_layers=L, vocab=V)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, V, (1, S)).astype(np.int32)
+
+    outs = {}
+    for dt in (jnp.float32, jnp.bfloat16):
+        kc, vc, pos = T.init_cache(batch=1, max_len=16, d_model=d,
+                                   n_heads=H, n_layers=L, dtype=dt)
+        assert kc.dtype == dt and vc.dtype == dt
+        logits = []
+        for t in range(S):
+            lg, kc, vc, pos = T.apply_step(
+                params, jnp.asarray(ids[:, t:t + 1]), kc, vc, pos,
+                n_heads=H)
+            assert kc.dtype == dt      # storage dtype survives the step
+            logits.append(np.asarray(lg))
+        outs[dt] = np.stack(logits, axis=1)
+    f32, bf16 = outs[jnp.float32], outs[jnp.bfloat16]
+    np.testing.assert_allclose(bf16, f32, rtol=0.05, atol=0.05)
+    # same argmax trajectory — bf16 storage must not flip decisions
+    np.testing.assert_array_equal(bf16.argmax(-1), f32.argmax(-1))
