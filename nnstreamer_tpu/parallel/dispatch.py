@@ -49,6 +49,12 @@ class MeshDispatcher:
             )
         self.mesh = mesh
         self.bucket = bucket
+        # compiled batch sizes: a partial flush pads only up to the
+        # SMALLEST bucket that fits it — a lone closed-loop frame rides
+        # the dp-sized program (1 on a single chip) instead of paying
+        # the full bucket's H2D/compute/D2H (jit compiles each size
+        # lazily on first use; at most these two shapes exist)
+        self.buckets = sorted({mesh.shape[batch_axis], bucket})
         self.max_delay = max_delay_ms / 1e3
         x_sharding = NamedSharding(mesh, P(batch_axis))
 
@@ -140,8 +146,9 @@ class MeshDispatcher:
         n = len(frames)
         try:
             batch = np.stack(frames, axis=0)
-            if n < self.bucket:  # pad to the compiled bucket size
-                pad = np.zeros((self.bucket - n,) + batch.shape[1:], batch.dtype)
+            tgt = next(b for b in self.buckets if b >= n)
+            if n < tgt:          # pad to the chosen compiled size
+                pad = np.zeros((tgt - n,) + batch.shape[1:], batch.dtype)
                 batch = np.concatenate([batch, pad], axis=0)
             out = self._fn(self._params, jnp.asarray(batch))
             outs = out if isinstance(out, (tuple, list)) else (out,)
