@@ -440,10 +440,15 @@ def _assemble_offload(curve: dict):
             if v["fps"] >= 200.0 and v["p50_ms"] <= 60.0}
     if good:
         chosen = min(good, key=lambda d: good[d]["p50_ms"])
-    else:   # fall back: best throughput among sub-60ms, else best fps
+    else:
+        # fall back: among points within 5% of the best throughput,
+        # take the lowest p50 (prefer sub-60ms points when any exist)
         sub60 = {d: v for d, v in ok.items() if v["p50_ms"] <= 60.0}
-        pick_from = sub60 or ok
-        chosen = max(pick_from, key=lambda d: pick_from[d]["fps"])
+        pool = sub60 or ok
+        best_fps = max(v["fps"] for v in pool.values())
+        near = {d: v for d, v in pool.items()
+                if v["fps"] >= 0.95 * best_fps}
+        chosen = min(near, key=lambda d: near[d]["p50_ms"])
     out = dict(ok[chosen])
     out["chosen_delay_ms"] = chosen
     out["sweep"] = curve
