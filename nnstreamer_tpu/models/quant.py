@@ -1,0 +1,124 @@
+"""W8A8 quantized matmul path for the transformer (MXU int8).
+
+On v5e the MXU runs int8×int8→int32 at ~2× the bf16 rate (measured
+376–496 TOP/s vs the 197 TFLOP/s bf16 peak — bench `mxu_peak`), but
+int8 NHWC *convolutions* lose to relayout costs on this backend, so the
+int8 story here targets what actually wins: the transformer's large
+matmuls. Weights are quantized per-output-channel (symmetric int8),
+activations per-token at runtime (dynamic symmetric int8 — one amax +
+scale per row, fused by XLA into the surrounding elementwise work), and
+the int32 accumulator is rescaled in f32. Attention stays in bf16
+(the flash kernel path); RMSNorm/softmax/rope stay f32/bf16 — only the
+MXU-bound projections change.
+
+This mirrors the role of the reference's quantized execution providers
+(`tensor_filter_tensorrt.cc` int8 calibration, `tensor_filter_snpe`
+quantized DLCs): quantization as an execution feature with the accuracy
+contract checked against the float path (tests).
+
+**Measured perf reality on v5e (documented, not hidden)**: the int8
+dot itself runs ~3× the bf16 rate at transformer shapes
+(16384×1024×3072: 0.13 ms vs 0.45 ms), but ONE dynamic activation
+quantization pass costs 0.62 ms — more than the matmul it feeds — so
+W8A8 measures 0.74× bf16 end-to-end at d_model=1024 (quant is O(d)
+HBM passes, matmul is O(d²) MXU work; the crossover is at larger d).
+bf16 therefore stays the transformer perf path on this backend, the
+same conclusion as the int8-native conv path (tflite_quant.py); this
+module is the accuracy-verified quantized-execution capability.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantize_weight(w, axis: int = 1):
+    """Symmetric per-output-channel int8 quantization of a 2-D weight.
+
+    `axis` is the OUTPUT dim (scales broadcast over it on dequant)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=1 - axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_transformer(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Float transformer params → W8A8 params: every large matmul
+    weight (wqkv/wo/wi/wd/head) becomes (int8, per-col scale); norms,
+    embeddings and everything small stay float."""
+    out: Dict[str, Any] = {"embed": params["embed"],
+                           "ln_f": params["ln_f"], "blocks": []}
+    for blk in params["blocks"]:
+        qblk = {"ln1": blk["ln1"], "ln2": blk["ln2"]}
+        for name in ("wqkv", "wo", "wi", "wd"):
+            q, s = quantize_weight(blk[name])
+            qblk[name] = q
+            qblk[f"{name}_scale"] = s
+        out["blocks"].append(qblk)
+    q, s = quantize_weight(params["head"])
+    out["head"], out["head_scale"] = q, s
+    return out
+
+
+def w8a8_matmul(x, w_q, w_scale):
+    """(…, K) f32/bf16 × int8 (K, N) → (…, N) f32.
+
+    Dynamic per-row activation quantization; int8×int8→int32 on the
+    MXU; one fused rescale. The quant/dequant is elementwise VPU work
+    XLA fuses around the dot."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    x_scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    x_q = jnp.clip(jnp.round(xf / x_scale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * x_scale * w_scale.reshape(
+        (1,) * (acc.ndim - 1) + (-1,))
+
+
+def apply_seq_w8a8(params_q, ids, *, n_heads=4, attn: str = "auto"):
+    """Full-sequence forward with W8A8 projections — the quantized twin
+    of transformer.apply_seq (same block structure, same attention
+    kernels; only the big matmuls run int8)."""
+    from nnstreamer_tpu.models import transformer as T
+    from nnstreamer_tpu.parallel.ring_attention import reference_attention
+
+    b, s = ids.shape
+    x = params_q["embed"][ids].astype(jnp.float32)
+    pos = jnp.arange(s)
+    use_pallas = attn == "pallas" or (attn == "auto" and s % 128 == 0)
+    for blk in params_q["blocks"]:
+        h = T.rmsnorm(x, blk["ln1"].astype(jnp.float32))
+        qkv = w8a8_matmul(h, blk["wqkv"], blk["wqkv_scale"])
+        d = x.shape[-1]
+        hd = d // n_heads
+        kv_dim = (qkv.shape[-1] - d) // 2
+        n_kv = kv_dim // hd
+        q = qkv[..., :d].reshape(b, s, n_heads, hd)
+        k = qkv[..., d:d + kv_dim].reshape(b, s, n_kv, hd)
+        v = qkv[..., d + kv_dim:].reshape(b, s, n_kv, hd)
+        q, k = T.rope(q, pos), T.rope(k, pos)
+        k, v = T._expand_kv(k, n_heads), T._expand_kv(v, n_heads)
+        if use_pallas:
+            from nnstreamer_tpu.backends.pallas_ops import flash_attention
+
+            attn_out = flash_attention(q.astype(jnp.bfloat16),
+                                       k.astype(jnp.bfloat16),
+                                       v.astype(jnp.bfloat16),
+                                       causal=True)
+        else:
+            attn_out = reference_attention(q, k, v, causal=True)
+        attn_out = attn_out.reshape(b, s, -1).astype(jnp.float32)
+        x = x + w8a8_matmul(attn_out, blk["wo"], blk["wo_scale"])
+        h = T.rmsnorm(x, blk["ln2"].astype(jnp.float32))
+        gate_up = w8a8_matmul(h, blk["wi"], blk["wi_scale"])
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+        x = x + w8a8_matmul(jax.nn.silu(gate) * up, blk["wd"],
+                            blk["wd_scale"])
+    x = T.rmsnorm(x, params_q["ln_f"].astype(jnp.float32))
+    return w8a8_matmul(x, params_q["head"], params_q["head_scale"])
