@@ -80,7 +80,11 @@ def _key(data: bytes, addr: int) -> str:
     end = data.find(b"\x00", addr)
     if end < 0:
         raise FlexDecodeError(f"unterminated key at {addr}")
-    return data[addr:end].decode("utf-8")
+    try:
+        return data[addr:end].decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise FlexDecodeError(f"key at {addr} is not utf-8: {e}") \
+            from None
 
 
 def _types_start(data: bytes, addr: int, w: int, n: int) -> int:
@@ -135,7 +139,13 @@ def _ref(data: bytes, off: int, parent_w: int, packed: int) -> Any:
                 f"{'string' if t == _STRING else 'blob'} length {n} at "
                 f"{addr} exceeds buffer")
         raw = data[addr:addr + n]
-        return raw.decode("utf-8") if t == _STRING else bytes(raw)
+        if t == _BLOB:
+            return bytes(raw)
+        try:
+            return raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise FlexDecodeError(
+                f"string at {addr} is not utf-8: {e}") from None
     if t == _INDIRECT_INT:
         return _i(data, addr, child_w)
     if t == _INDIRECT_UINT:

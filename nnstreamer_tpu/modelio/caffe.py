@@ -96,6 +96,11 @@ def _shape_dims(shape_msg: bytes) -> List[int]:
 def parse_caffemodel(path: str) -> CaffeNet:
     with open(path, "rb") as f:
         raw = f.read()
+    with pw.wire_context(f"caffemodel {path!r}", BackendError):
+        return _parse_caffemodel(raw, path)
+
+
+def _parse_caffemodel(raw: bytes, path: str) -> CaffeNet:
     d = pw.fields_dict(raw)
     if _NP_LAYER_V2 not in d:
         raise BackendError(

@@ -208,7 +208,8 @@ def parse_graphdef(path: str) -> List[NodeDef]:
                 inputs=[v.decode() for v in nd.get(_ND_INPUT, [])],
                 attrs=attrs))
         return nodes
-    except (ValueError, IndexError, struct.error) as e:
+    except (ValueError, IndexError, struct.error,
+            UnicodeDecodeError) as e:
         raise BackendError(
             f"{path!r} is not a frozen TF GraphDef: {e}") from None
 

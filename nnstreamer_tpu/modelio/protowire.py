@@ -19,10 +19,16 @@ Value = Union[int, bytes]
 
 
 def read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
-    """→ (value, new_pos). Unsigned; callers reinterpret as needed."""
+    """→ (value, new_pos). Unsigned; callers reinterpret as needed.
+
+    Raises ValueError on truncation/corruption — the module's single
+    error type (never IndexError/struct.error)."""
     result = 0
     shift = 0
+    n = len(buf)
     while True:
+        if pos >= n:
+            raise ValueError("truncated varint (corrupt protobuf)")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -53,14 +59,22 @@ def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, Value]]:
             v, pos = read_varint(buf, pos)
             yield field, wt, v
         elif wt == 1:
+            if pos + 8 > n:
+                raise ValueError(f"truncated fixed64 at field {field}")
             v = struct.unpack_from("<Q", buf, pos)[0]
             pos += 8
             yield field, wt, v
         elif wt == 2:
             ln, pos = read_varint(buf, pos)
+            if pos + ln > n:
+                raise ValueError(
+                    f"length-delimited field {field} claims {ln} bytes "
+                    f"past the end (corrupt protobuf)")
             yield field, wt, bytes(buf[pos:pos + ln])
             pos += ln
         elif wt == 5:
+            if pos + 4 > n:
+                raise ValueError(f"truncated fixed32 at field {field}")
             v = struct.unpack_from("<I", buf, pos)[0]
             pos += 4
             yield field, wt, v
@@ -68,6 +82,23 @@ def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, Value]]:
             raise ValueError(f"unsupported protobuf group at field {field}")
         else:
             raise ValueError(f"bad wire type {wt} for field {field}")
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def wire_context(what: str, exc_type):
+    """Translate any parse-time failure into the caller's typed error.
+
+    A corrupt file must fail as the loader's documented error type
+    (e.g. BackendError naming the file), never escape as IndexError/
+    struct.error/UnicodeDecodeError from the wire internals."""
+    try:
+        yield
+    except (ValueError, IndexError, KeyError, OverflowError,
+            struct.error, UnicodeDecodeError) as e:
+        raise exc_type(f"{what}: malformed file: {e}") from None
 
 
 def fields_dict(buf: bytes) -> Dict[int, List[Value]]:

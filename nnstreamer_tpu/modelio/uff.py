@@ -91,6 +91,11 @@ def _decode_data(buf: bytes):
 def parse_uff(path: str) -> UffGraph:
     with open(path, "rb") as f:
         raw = f.read()
+    with pw.wire_context(f"uff {path!r}", BackendError):
+        return _parse_uff(raw, path)
+
+
+def _parse_uff(raw: bytes, path: str) -> UffGraph:
     d = pw.fields_dict(raw)
     if 4 not in d:
         raise BackendError(f"{path!r}: no graphs in UFF MetaGraph")

@@ -1,0 +1,132 @@
+"""Adversarial bytes against the from-scratch wire readers.
+
+The importers promise typed, loud failures on corrupt input (BackendError
+naming the file; FlexDecodeError for flexbuffers; ValueError for the raw
+protowire layer) — never raw IndexError/struct.error/UnicodeDecodeError
+escaping from parser internals, and never a hang. Random buffers and
+bit-flipped valid files pin that contract.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.errors import BackendError
+
+MODELS = "/root/reference/tests/test_models/models"
+N_RANDOM = 400
+N_MUTATED = 400
+
+
+def _random_bufs(seed, n, max_len=96):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        ln = int(rng.integers(3, max_len))
+        yield bytes(rng.integers(0, 256, ln, dtype=np.uint8))
+
+
+def _mutations(seed, valid, n):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        m = bytearray(valid)
+        for _ in range(int(rng.integers(1, 5))):
+            m[int(rng.integers(0, len(m)))] = int(rng.integers(0, 256))
+        yield bytes(m)
+
+
+def test_flexbuf_reader_contract():
+    from flatbuffers import flexbuffers
+
+    from nnstreamer_tpu.interop.flexbuf_read import (
+        FlexDecodeError,
+        flexbuf_loads,
+    )
+
+    for buf in _random_bufs(0, N_RANDOM * 4):
+        try:
+            flexbuf_loads(buf)      # decoding garbage MAY succeed...
+        except FlexDecodeError:
+            pass                    # ...or fail with the typed error
+    fbb = flexbuffers.Builder()
+    with fbb.Map():
+        fbb.Key("a")
+        fbb.Int(1)
+        fbb.Key("s")
+        fbb.String("hello")
+        fbb.Key("v")
+        fbb.TypedVectorFromElements([1, 2, 3])
+    valid = bytes(fbb.Finish())
+    for buf in _mutations(1, valid, N_MUTATED * 4):
+        try:
+            flexbuf_loads(buf)
+        except FlexDecodeError:
+            pass
+
+
+def test_protowire_contract():
+    from nnstreamer_tpu.modelio import protowire as pw
+
+    for buf in _random_bufs(2, N_RANDOM * 4):
+        try:
+            pw.fields_dict(buf)
+        except ValueError:          # the module's single error type
+            pass
+
+
+def _file_parser_contract(parse_from_path, valid_path, seed, tmp_path,
+                          suffix):
+    valid = open(valid_path, "rb").read()[:4096] if valid_path else None
+    cases = list(_random_bufs(seed, N_RANDOM))
+    if valid:
+        cases += list(_mutations(seed + 1, valid, N_MUTATED))
+    target = tmp_path / f"fuzz{suffix}"
+    for buf in cases:
+        target.write_bytes(buf)
+        try:
+            parse_from_path(str(target))
+        except BackendError:
+            pass                    # the loader's documented error
+
+
+@pytest.mark.skipif(not os.path.exists(MODELS),
+                    reason="reference models absent")
+def test_caffemodel_parser_contract(tmp_path):
+    from nnstreamer_tpu.modelio.caffe import parse_caffemodel
+
+    _file_parser_contract(
+        parse_caffemodel,
+        os.path.join(MODELS, "lenet_iter_9000.caffemodel"),
+        3, tmp_path, ".caffemodel")
+
+
+@pytest.mark.skipif(not os.path.exists(MODELS),
+                    reason="reference models absent")
+def test_uff_parser_contract(tmp_path):
+    from nnstreamer_tpu.modelio.uff import parse_uff
+
+    _file_parser_contract(
+        parse_uff, os.path.join(MODELS, "lenet5.uff"), 4, tmp_path,
+        ".uff")
+
+
+@pytest.mark.skipif(not os.path.exists(MODELS),
+                    reason="reference models absent")
+def test_graphdef_parser_contract(tmp_path):
+    from nnstreamer_tpu.modelio.graphdef import parse_graphdef
+
+    _file_parser_contract(
+        parse_graphdef, os.path.join(MODELS, "mnist.pb"), 5, tmp_path,
+        ".pb")
+
+
+def test_torchscript_loader_contract(tmp_path):
+    from nnstreamer_tpu.modelio.torchscript import load_torchscript
+
+    for buf in _random_bufs(6, N_RANDOM // 4):
+        target = tmp_path / "fuzz.pt"
+        target.write_bytes(buf)
+        try:
+            load_torchscript(str(target))
+        except BackendError:
+            pass
