@@ -1082,11 +1082,28 @@ def main() -> int:
         return _family_main(sys.argv[idx])
     results = {}
     errors = {}
-    # Phase 1 — differencing-method families, one subprocess each with a
-    # fresh client (the parent must not import jax before these finish:
-    # only one process can own the chip).
-    family_out = {name: _run_family_subprocess(name, errors)
-                  for name in _FAMILIES}
+    # Phase 1 — one subprocess per family with a fresh client (the
+    # parent must not import jax before these finish: only one process
+    # can own the chip). Order = importance under the soft time budget:
+    # the BASELINE-table configs first, then the VERDICT-critical
+    # kernel/MFU families, then sweeps; if the budget runs out the tail
+    # is skipped loudly and the JSON still ships with everything that
+    # ran (a killed bench ships nothing).
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "3000"))
+    t0 = time.monotonic()
+    ordered = (
+        [f"cfg_{n}" for n in _CONFIGS]
+        + ["pallas", "transformer_prefill", "mxu_peak"]
+        + [f"offload_{d}" for d in OFFLOAD_DELAYS]
+        + ["batch_sweep", "int8_native"])
+    family_out = {}
+    for name in ordered:
+        if time.monotonic() - t0 > budget_s:
+            errors[name] = (f"skipped: bench time budget "
+                            f"({budget_s:.0f}s) exhausted")
+            family_out[name] = {}
+            continue
+        family_out[name] = _run_family_subprocess(name, errors)
     sweep = family_out["batch_sweep"]
     int8_native = family_out["int8_native"]
     pallas = family_out["pallas"]
