@@ -520,12 +520,13 @@ def offload_bench(n_frames=None, n_lat=None, max_delay_ms=3.0):
         d.infer(direct)                  # warms the min-bucket program
         full = [d.submit(direct) for _ in range(d.bucket)]
         for f in full:                   # warms the full-bucket program
-            f.result(120)
+            f.result(300)                # compile can stall on the
+                                         # tunnel's remote-compile hop
         nd = 96 if on_tpu else 8
         t0 = time.perf_counter()
         futs = [d.submit(direct) for _ in range(nd)]
         for f in futs:
-            f.result(120)
+            f.result(300)
         dispatch_fps = nd / (time.perf_counter() - t0)
         st0 = bqs.stats()              # snapshot: isolate the 4-client
                                        # phase's coalescing statistics
@@ -1104,6 +1105,16 @@ def main() -> int:
             family_out[name] = {}
             continue
         family_out[name] = _run_family_subprocess(name, errors)
+        if not family_out[name] and name in errors \
+                and "budget" not in errors[name] \
+                and time.monotonic() - t0 <= budget_s:
+            # transient failures happen (the tunnel's remote-compile
+            # hop stalls intermittently) — one retry on a fresh client
+            first_err = errors.pop(name)
+            family_out[name] = _run_family_subprocess(name, errors)
+            if name in errors:
+                errors[name] = (f"{errors[name]} (first attempt: "
+                                f"{first_err})")
     sweep = family_out["batch_sweep"]
     int8_native = family_out["int8_native"]
     pallas = family_out["pallas"]
