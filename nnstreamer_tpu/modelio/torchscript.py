@@ -288,10 +288,18 @@ def load_torchscript(path: str) -> TSProgram:
 
     params: Dict[str, np.ndarray] = {}
 
+    seen_arrays: Dict[int, _ParamSlot] = {}
+
     def convert(node, prefix: str) -> Any:
         if isinstance(node, np.ndarray):
-            params[prefix] = node
-            return _ParamSlot(prefix)
+            # the pickler memoizes: e.g. nn.LSTM's weight_ih_l0 and
+            # _flat_weights[0] unpickle to the SAME array — one slot,
+            # not two copies in the params dict
+            slot = seen_arrays.get(id(node))
+            if slot is None:
+                params[prefix] = node
+                slot = seen_arrays[id(node)] = _ParamSlot(prefix)
+            return slot
         qual = getattr(type(node), "_ts_qual", None)
         if qual is not None:
             mod = _TSModule(qual)
@@ -631,6 +639,15 @@ class _Interp:
         obj = self.eval(node.value, env)
         return self._getattr(obj, node.attr)
 
+    def _resolve_slots(self, v):
+        """Param slots hide anywhere attributes nest (an nn.LSTM's
+        _flat_weights is a LIST of parameters)."""
+        if isinstance(v, _ParamSlot):
+            return self.params[v.path]
+        if isinstance(v, (list, tuple)):
+            return type(v)(self._resolve_slots(e) for e in v)
+        return v
+
     def _try_getattr(self, obj, name: str, default: tuple):
         try:
             return self._getattr(obj, name)
@@ -642,9 +659,7 @@ class _Interp:
     def _getattr(self, obj, name: str):
         if isinstance(obj, _TSModule):
             if name in obj.attrs:
-                v = obj.attrs[name]
-                return self.params[v.path] if isinstance(v, _ParamSlot) \
-                    else v
+                return self._resolve_slots(obj.attrs[name])
             ci = self.prog.classes.get(obj.qualname)
             if ci and name in ci.consts:
                 return ci.consts[name]
@@ -1328,6 +1343,96 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
     def t_clamp(x, min=None, max=None):
         return jnp.clip(asarr(x), min, max)
 
+    # -- recurrent layers ----------------------------------------------
+    def _rnn_common(x, hx_list, params_list, has_biases, num_layers,
+                    dropout, train, bidirectional, batch_first):
+        if train:
+            raise BackendError(
+                "lstm/gru in training mode unsupported (inference "
+                "lowering)")
+        x = asarr(x)
+        if batch_first:
+            x = jnp.swapaxes(x, 0, 1)            # (T, B, I)
+        nd = 2 if bidirectional else 1
+        per = 4 if has_biases else 2
+        return x, [asarr(h) for h in hx_list], \
+            [asarr(p) for p in params_list], int(num_layers), nd, per
+
+    def _run_rnn(x, h_states, params, num_layers, nd, per, has_biases,
+                 batch_first, step_fn, n_state):
+        # torch flat-weights layout: per layer, per direction:
+        # [w_ih, w_hh (, b_ih, b_hh)]; gate blocks stacked on dim 0
+        outs = x
+        finals = [[] for _ in range(n_state)]
+        for layer in range(num_layers):
+            layer_ys = []
+            for d in range(nd):
+                idx = (layer * nd + d) * per
+                w_ih, w_hh = params[idx], params[idx + 1]
+                b_ih = params[idx + 2] if has_biases else None
+                b_hh = params[idx + 3] if has_biases else None
+                seq = outs if d == 0 else outs[::-1]
+                init = tuple(s[layer * nd + d] for s in h_states)
+                carry, ys = jax.lax.scan(
+                    lambda c, xt: step_fn(c, xt, w_ih, w_hh, b_ih,
+                                          b_hh), init, seq)
+                if d == 1:
+                    ys = ys[::-1]
+                layer_ys.append(ys)
+                for s, v in zip(finals, carry):
+                    s.append(v)
+            outs = (jnp.concatenate(layer_ys, axis=-1) if nd == 2
+                    else layer_ys[0])
+        if batch_first:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return (outs,) + tuple(jnp.stack(s) for s in finals)
+
+    def t_torch_lstm(x, hx, params_list, has_biases, num_layers,
+                     dropout, train, bidirectional, batch_first):
+        x, hs, ps, num_layers, nd, per = _rnn_common(
+            x, hx, params_list, has_biases, num_layers, dropout, train,
+            bidirectional, batch_first)
+
+        def step(carry, xt, w_ih, w_hh, b_ih, b_hh):
+            h, c = carry
+            gates = xt @ w_ih.T + h @ w_hh.T
+            if b_ih is not None:
+                gates = gates + b_ih + b_hh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                       jax.nn.sigmoid(o))
+            c = f * c + i * jnp.tanh(g)
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        return _run_rnn(x, hs, ps, num_layers, nd, per, has_biases,
+                        batch_first, step, 2)
+
+    def t_torch_gru(x, hx, params_list, has_biases, num_layers,
+                    dropout, train, bidirectional, batch_first):
+        # torch.gru passes h0 as a Tensor (not a list like lstm)
+        x, hs, ps, num_layers, nd, per = _rnn_common(
+            x, [hx], params_list, has_biases, num_layers, dropout,
+            train, bidirectional, batch_first)
+
+        def step(carry, xt, w_ih, w_hh, b_ih, b_hh):
+            (h,) = carry
+            gi = xt @ w_ih.T
+            gh = h @ w_hh.T
+            if b_ih is not None:
+                gi = gi + b_ih
+                gh = gh + b_hh
+            ir, iz, infld = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            nval = jnp.tanh(infld + r * hn)
+            h = (1 - z) * nval + z * h
+            return (h,), h
+
+        return _run_rnn(x, hs, ps, num_layers, nd, per, has_biases,
+                        batch_first, step, 1)
+
     def t_dropout(x, p=0.5, train=False):
         if train:
             raise BackendError("dropout train=True unsupported "
@@ -1409,6 +1514,7 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
         "upsample_bilinear2d": t_upsample_bilinear2d,
         "dropout": t_dropout, "dropout_": t_dropout,
         "feature_dropout": t_dropout,
+        "lstm": t_torch_lstm, "gru": t_torch_gru,
         # activations
         "relu": lambda x: jax.nn.relu(asarr(x)),
         "relu_": lambda x: jax.nn.relu(asarr(x)),

@@ -309,3 +309,58 @@ def test_conv_transpose_and_upsample_match_torch(tmp_path):
     with torch.no_grad():
         ref = net(torch.from_numpy(x)).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
+@pytest.mark.parametrize("bidir", [False, True])
+def test_scripted_lstm_matches_torch(tmp_path, bidir):
+    """Scripted nn.LSTM (torch.lstm op): output + final states match
+    torch, incl. two layers and bidirectional."""
+    import torch.nn as tnn
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.rnn = tnn.LSTM(6, 5, num_layers=2,
+                                batch_first=True,
+                                bidirectional=bidir)
+            self.fc = tnn.Linear(5 * (2 if bidir else 1), 3)
+
+        def forward(self, x):
+            y, (h, c) = self.rnn(x)
+            return self.fc(y[:, -1]), h, c
+
+    net = Net().eval()
+    b = _script_and_load(tmp_path, net, name=f"lstm{bidir}.pt")
+    x = np.random.RandomState(8).randn(2, 7, 6).astype(np.float32)
+    outs = _run_bundle(b, x)
+    with torch.no_grad():
+        refs = net(torch.from_numpy(x))
+    assert len(outs) == 3
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o), r.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
+def test_scripted_gru_matches_torch(tmp_path):
+    import torch.nn as tnn
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.rnn = tnn.GRU(4, 8, batch_first=True)
+
+        def forward(self, x):
+            y, h = self.rnn(x)
+            return y, h
+
+    net = Net().eval()
+    b = _script_and_load(tmp_path, net, name="gru.pt")
+    x = np.random.RandomState(9).randn(3, 5, 4).astype(np.float32)
+    outs = _run_bundle(b, x)
+    with torch.no_grad():
+        refs = net(torch.from_numpy(x))
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o), r.numpy(),
+                                   rtol=1e-4, atol=1e-5)
