@@ -215,6 +215,13 @@ def parse_tflite(path: str) -> TFLiteGraph:
     if len(buf) < 8 or buf[4:8] != b"TFL3":
         raise BackendError(
             f"{path!r} is not a TFLite flatbuffer (missing TFL3 identifier)")
+    from nnstreamer_tpu.modelio.protowire import wire_context
+
+    with wire_context(f"tflite {path!r}", BackendError):
+        return _parse_tflite_buf(buf, path)
+
+
+def _parse_tflite_buf(buf: bytes, path: str) -> TFLiteGraph:
     r = Reader(buf)
     model = r.root()
 

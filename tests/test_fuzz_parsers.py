@@ -130,3 +130,13 @@ def test_torchscript_loader_contract(tmp_path):
             load_torchscript(str(target))
         except BackendError:
             pass
+
+
+@pytest.mark.skipif(not os.path.exists(MODELS),
+                    reason="reference models absent")
+def test_tflite_parser_contract(tmp_path):
+    from nnstreamer_tpu.modelio import parse_tflite
+
+    _file_parser_contract(
+        parse_tflite, os.path.join(MODELS, "add.tflite"), 7, tmp_path,
+        ".tflite")
