@@ -196,3 +196,24 @@ def test_caffe_pool_ceil_and_clip_rule():
                    (0, 0))
     assert out2.shape == (1, 1, 2, 2)
     np.testing.assert_allclose(np.asarray(out2), 1.0)
+
+
+@needs_models
+def test_singleshot_runs_all_new_formats():
+    """The pipeline-less SingleShot API (tensor_filter_single parity)
+    accepts every round-4 format, including the shape-less bundles
+    that negotiate from the first invoke's input."""
+    from nnstreamer_tpu.single import SingleShot
+
+    nine = np.fromfile(os.path.join(DATA, "9.raw"), np.uint8)
+    pgm9 = _pgm_digit("9.pgm").astype(np.float32)
+    cases = (
+        (CAFFE_LENET,
+         ((nine.astype(np.float32) - 127.5) / 127.5).reshape(1, 1, 28, 28)),
+        (os.path.join(MODELS, "pytorch_lenet5.pt"),
+         nine.reshape(1, 28, 28, 1)),
+        (UFF_LENET, (1.0 - pgm9 / 255.0).reshape(1, 28, 28, 1)),
+    )
+    for path, x in cases:
+        out = SingleShot(path).invoke(x)
+        assert int(np.asarray(out[0]).argmax()) == 9, path
