@@ -364,3 +364,31 @@ def test_scripted_gru_matches_torch(tmp_path):
     for o, r in zip(outs, refs):
         np.testing.assert_allclose(np.asarray(o), r.numpy(),
                                    rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
+def test_scripted_text_classifier_matches_torch(tmp_path):
+    """Embedding + LSTM + Linear over int32 token ids — the text-model
+    shape (integer pipeline inputs end-to-end)."""
+    import torch.nn as tnn
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = tnn.Embedding(50, 12)
+            self.rnn = tnn.LSTM(12, 9, batch_first=True)
+            self.fc = tnn.Linear(9, 4)
+
+        def forward(self, ids):
+            x = self.emb(ids)
+            y, _ = self.rnn(x)
+            return torch.softmax(self.fc(y[:, -1]), dim=1)
+
+    net = Net().eval()
+    b = _script_and_load(tmp_path, net, name="text.pt")
+    ids = np.random.RandomState(10).randint(
+        0, 50, (3, 11)).astype(np.int32)
+    ours = np.asarray(_run_bundle(b, ids)[0])
+    with torch.no_grad():
+        ref = net(torch.from_numpy(ids.astype(np.int64))).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
