@@ -1343,6 +1343,37 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
     def t_clamp(x, min=None, max=None):
         return jnp.clip(asarr(x), min, max)
 
+    def t_sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+               scale=None, enable_gqa=False):
+        """torch.scaled_dot_product_attention — the modern exported
+        attention op. torch layout is (..., H, S, D); softmax in f32."""
+        if dropout_p:
+            raise BackendError(
+                "scaled_dot_product_attention with dropout_p>0 "
+                "unsupported (inference lowering)")
+        q, k, v = asarr(q), asarr(k), asarr(v)
+        if enable_gqa and k.shape[-3] != q.shape[-3]:
+            rep = q.shape[-3] // k.shape[-3]
+            k = jnp.repeat(k, rep, axis=-3)
+            v = jnp.repeat(v, rep, axis=-3)
+        s = q.shape[-1] ** -0.5 if scale is None else float(scale)
+        logits = jnp.einsum(
+            "...qd,...kd->...qk", q.astype(jnp.float32),
+            k.astype(jnp.float32)) * s
+        if is_causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(mask, logits, -jnp.inf)
+        if attn_mask is not None:
+            m = asarr(attn_mask)
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, -jnp.inf)
+            else:
+                logits = logits + m.astype(jnp.float32)
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("...qk,...kd->...qd", w,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
     # -- recurrent layers ----------------------------------------------
     def _rnn_common(x, hx_list, params_list, has_biases, num_layers,
                     dropout, train, bidirectional, batch_first):
@@ -1515,6 +1546,7 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
         "dropout": t_dropout, "dropout_": t_dropout,
         "feature_dropout": t_dropout,
         "lstm": t_torch_lstm, "gru": t_torch_gru,
+        "scaled_dot_product_attention": t_sdpa,
         # activations
         "relu": lambda x: jax.nn.relu(asarr(x)),
         "relu_": lambda x: jax.nn.relu(asarr(x)),

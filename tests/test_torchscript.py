@@ -392,3 +392,39 @@ def test_scripted_text_classifier_matches_torch(tmp_path):
     with torch.no_grad():
         ref = net(torch.from_numpy(ids.astype(np.int64))).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
+@pytest.mark.parametrize("causal", [False, True])
+def test_scripted_attention_block_matches_torch(tmp_path, causal):
+    """A scripted self-attention block using
+    F.scaled_dot_product_attention — the modern exported attention op
+    (torch 2.x) — matches torch, causal and full."""
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.qkv = tnn.Linear(32, 96)
+            self.out = tnn.Linear(32, 32)
+            self.causal = causal
+
+        def forward(self, x):
+            B, S, D = x.shape[0], x.shape[1], x.shape[2]
+            qkv = self.qkv(x).reshape(B, S, 3, 4, 8)
+            q = qkv[:, :, 0].transpose(1, 2)
+            k = qkv[:, :, 1].transpose(1, 2)
+            v = qkv[:, :, 2].transpose(1, 2)
+            a = F.scaled_dot_product_attention(q, k, v,
+                                               is_causal=self.causal)
+            a = a.transpose(1, 2).reshape(B, S, D)
+            return self.out(a)
+
+    net = Net().eval()
+    b = _script_and_load(tmp_path, net, name=f"attn{causal}.pt")
+    x = np.random.RandomState(11).randn(2, 10, 32).astype(np.float32)
+    ours = np.asarray(_run_bundle(b, x)[0])
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
