@@ -1470,6 +1470,50 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
                                "(inference lowering)")
         return asarr(x)
 
+    def _torch_assert(cond, msg=None):
+        # host-evaluable asserts enforce; traced (data-dependent)
+        # conditions cannot be checked at trace time — skip, matching
+        # torch's behavior under tracing
+        if _is_tensor(cond):
+            return None
+        if not cond:
+            raise BackendError(f"TorchScript assertion failed: {msg}")
+        return None
+
+    def t_native_mha(q, k, v, embed_dim, num_heads, qkv_w, qkv_b,
+                     proj_w, proj_b, mask=None, need_weights=True,
+                     average_attn_weights=True, mask_type=None):
+        """torch._native_multi_head_attention — the fused fast path
+        nn.MultiheadAttention takes on CPU-like devices. Packed-QKV
+        self-attention: (B, S, E) in, (B, S, E) out."""
+        x = asarr(q)
+        B, S, E = x.shape
+        H = int(num_heads)
+        hd = E // H
+        qkv = x @ asarr(qkv_w).T + asarr(qkv_b)
+        qq, kk, vv = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+
+        am = None
+        if mask is not None:
+            m = asarr(mask)
+            if m.ndim == 2 and int(mask_type or 0) == 1:
+                # key-padding mask (B, S): True = ignore that key
+                am = jnp.where(m[:, None, None, :].astype(bool),
+                               -jnp.inf, 0.0).astype(jnp.float32)
+            else:
+                am = m
+        a = t_sdpa(heads(qq), heads(kk), heads(vv), attn_mask=am)
+        out = a.transpose(0, 2, 1, 3).reshape(B, S, E)
+        out = out @ asarr(proj_w).T + asarr(proj_b)
+        if not need_weights:
+            return out, None
+        raise BackendError(
+            "_native_multi_head_attention with need_weights=True "
+            "unsupported (use need_weights=False)")
+
     def unary(jf):
         return lambda x, *a, **k: jf(asarr(x))
 
@@ -1547,6 +1591,7 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
         "feature_dropout": t_dropout,
         "lstm": t_torch_lstm, "gru": t_torch_gru,
         "scaled_dot_product_attention": t_sdpa,
+        "_native_multi_head_attention": t_native_mha,
         # activations
         "relu": lambda x: jax.nn.relu(asarr(x)),
         "relu_": lambda x: jax.nn.relu(asarr(x)),
@@ -1595,8 +1640,26 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
             keepdims=bool(keepdim)).astype(jnp.int32),
         "index_select": t_index_select, "gather": t_gather,
         "where": lambda c, a, b: jnp.where(asarr(c), asarr(a), asarr(b)),
+        # List[bool] overloads stay host-side (fast-path eligibility
+        # checks in nn.MultiheadAttention build bool lists)
+        "all": lambda x, dim=None, keepdim=False: (
+            all(x) if isinstance(x, (list, tuple))
+            and not any(_is_tensor(e) for e in x)
+            else jnp.all(asarr(x), axis=None if dim is None
+                         else int(dim), keepdims=bool(keepdim))),
+        "any": lambda x, dim=None, keepdim=False: (
+            any(x) if isinstance(x, (list, tuple))
+            and not any(_is_tensor(e) for e in x)
+            else jnp.any(asarr(x), axis=None if dim is None
+                         else int(dim), keepdims=bool(keepdim))),
+        "isnan": lambda x: jnp.isnan(asarr(x)),
+        "isinf": lambda x: jnp.isinf(asarr(x)),
+        "logical_not": lambda x: jnp.logical_not(asarr(x)),
         # misc
         "warn": lambda *a, **k: None,
+        "is_autocast_enabled": lambda *a: False,
+        "is_grad_enabled": lambda: False,
+        "_assert": _torch_assert,
         "format": lambda fmt, *a: str(fmt).format(*a),
         "len": lambda x: len(x) if not _is_tensor(x)
         else int(asarr(x).shape[0]),
@@ -1631,6 +1694,11 @@ def _make_prim_ops(I: "_Interp") -> Dict[str, Callable]:
         "ListConstruct": lambda *a: list(a),
         "dtype": prim_dtype,
         "device": lambda x: "cpu",
+        # nested tensors never occur on this path (inputs are dense)
+        "is_nested": lambda x: False,
+        "requires_grad": lambda x: False,
+        "layout": lambda x: 0,      # torch.strided
+        "type": lambda x: "cpu",    # device-type string in branch checks
     }
 
 

@@ -428,3 +428,29 @@ def test_scripted_attention_block_matches_torch(tmp_path, causal):
     with torch.no_grad():
         ref = net(torch.from_numpy(x)).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
+def test_scripted_multihead_attention_matches_torch(tmp_path):
+    """nn.MultiheadAttention scripts through its fused fast path
+    (_native_multi_head_attention) — packed-QKV self-attention must
+    match torch."""
+    import torch.nn as tnn
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.mha = tnn.MultiheadAttention(32, 4, batch_first=True)
+            self.ln = tnn.LayerNorm(32)
+
+        def forward(self, x):
+            y, _ = self.mha(x, x, x, need_weights=False)
+            return self.ln(x + y)
+
+    net = Net().eval()
+    b = _script_and_load(tmp_path, net, name="mha.pt")
+    x = np.random.RandomState(12).randn(2, 9, 32).astype(np.float32)
+    ours = np.asarray(_run_bundle(b, x)[0])
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
