@@ -1514,6 +1514,37 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
             "_native_multi_head_attention with need_weights=True "
             "unsupported (use need_weights=False)")
 
+    def t_encoder_layer_fwd(src, embed_dim, num_heads, qkv_w, qkv_b,
+                            proj_w, proj_b, use_gelu, norm_first, eps,
+                            ln1_w, ln1_b, ln2_w, ln2_b, ffn_w1, ffn_b1,
+                            ffn_w2, ffn_b2, mask=None, mask_type=None):
+        """torch._transformer_encoder_layer_fwd — the fused
+        TransformerEncoderLayer fast path: MHA + residual + LayerNorm +
+        FFN + residual + LayerNorm, pre- or post-norm."""
+        x = asarr(src)
+
+        def ln(t, w, b):
+            return t_layer_norm(t, (t.shape[-1],), w, b, eps)
+
+        def attn(t):
+            out, _ = t_native_mha(t, t, t, embed_dim, num_heads,
+                                  qkv_w, qkv_b, proj_w, proj_b,
+                                  mask=mask, need_weights=False,
+                                  mask_type=mask_type)
+            return out
+
+        def ffn(t):
+            h = t @ asarr(ffn_w1).T + asarr(ffn_b1)
+            h = jax.nn.gelu(h, approximate=False) if use_gelu \
+                else jax.nn.relu(h)
+            return h @ asarr(ffn_w2).T + asarr(ffn_b2)
+
+        if norm_first:
+            x = x + attn(ln(x, ln1_w, ln1_b))
+            return x + ffn(ln(x, ln2_w, ln2_b))
+        x = ln(x + attn(x), ln1_w, ln1_b)
+        return ln(x + ffn(x), ln2_w, ln2_b)
+
     def unary(jf):
         return lambda x, *a, **k: jf(asarr(x))
 
@@ -1592,6 +1623,7 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
         "lstm": t_torch_lstm, "gru": t_torch_gru,
         "scaled_dot_product_attention": t_sdpa,
         "_native_multi_head_attention": t_native_mha,
+        "_transformer_encoder_layer_fwd": t_encoder_layer_fwd,
         # activations
         "relu": lambda x: jax.nn.relu(asarr(x)),
         "relu_": lambda x: jax.nn.relu(asarr(x)),

@@ -454,3 +454,27 @@ def test_scripted_multihead_attention_matches_torch(tmp_path):
     with torch.no_grad():
         ref = net(torch.from_numpy(x)).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
+@pytest.mark.parametrize("norm_first,act", [(False, "gelu"),
+                                            (True, "relu")])
+def test_scripted_transformer_encoder_matches_torch(tmp_path,
+                                                    norm_first, act):
+    """nn.TransformerEncoder scripts through the fused
+    _transformer_encoder_layer_fwd fast path — both norm orders and
+    activations must match torch."""
+    import torch.nn as tnn
+
+    layer = tnn.TransformerEncoderLayer(
+        d_model=32, nhead=4, dim_feedforward=64, batch_first=True,
+        activation=act, norm_first=norm_first)
+    net = tnn.TransformerEncoder(layer, num_layers=2).eval()
+    path = str(tmp_path / f"enc{norm_first}{act}.pt")
+    torch.jit.save(torch.jit.script(net), path)
+    b = load_model_file(path)
+    x = np.random.RandomState(13).randn(2, 9, 32).astype(np.float32)
+    ours = np.asarray(_run_bundle(b, x)[0])
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
