@@ -1184,6 +1184,13 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
     def t_narrow(x, dim, start, length):
         return t_slice(x, dim, start, int(start) + int(length))
 
+    def t_unflatten(x, dim, sizes):
+        x = asarr(x)
+        d = int(dim) % x.ndim
+        new = (x.shape[:d] + tuple(int(s) for s in sizes)
+               + x.shape[d + 1:])
+        return jnp.reshape(x, new)
+
     def t_unsqueeze(x, dim):
         return jnp.expand_dims(asarr(x), int(dim))
 
@@ -1553,6 +1560,7 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
         "add": t_add, "add_": t_add, "sub": t_sub, "sub_": t_sub,
         "rsub": t_rsub, "mul": t_mul, "mul_": t_mul, "div": t_div,
         "div_": t_div, "floor_divide": lambda a, b: a // b,
+        "floordiv": lambda a, b: a // b,
         "remainder": lambda a, b: a % b,
         "pow": lambda a, b: a ** b,
         "matmul": lambda a, b: jnp.matmul(asarr(a), asarr(b)),
@@ -1657,6 +1665,7 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
             for i in range(asarr(x).shape[int(dim)])],
         "select": t_select, "slice": t_slice, "narrow": t_narrow,
         "unsqueeze": t_unsqueeze, "unsqueeze_": t_unsqueeze,
+        "unflatten": t_unflatten,
         "squeeze": t_squeeze, "squeeze_": t_squeeze,
         "expand": t_expand,
         "expand_as": lambda x, o: jnp.broadcast_to(

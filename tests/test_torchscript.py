@@ -478,3 +478,23 @@ def test_scripted_transformer_encoder_matches_torch(tmp_path,
     with torch.no_grad():
         ref = net(torch.from_numpy(x)).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
+def test_scripted_transformer_decoder_matches_torch(tmp_path):
+    """nn.TransformerDecoder (self + cross attention through SDPA,
+    two-input forward) matches torch."""
+    import torch.nn as tnn
+
+    layer = tnn.TransformerDecoderLayer(
+        d_model=32, nhead=4, dim_feedforward=64, batch_first=True)
+    net = tnn.TransformerDecoder(layer, num_layers=2).eval()
+    path = str(tmp_path / "dec.pt")
+    torch.jit.save(torch.jit.script(net), path)
+    b = load_model_file(path)
+    tgt = np.random.RandomState(14).randn(2, 7, 32).astype(np.float32)
+    mem = np.random.RandomState(15).randn(2, 9, 32).astype(np.float32)
+    ours = np.asarray(_run_bundle(b, tgt, mem)[0])
+    with torch.no_grad():
+        ref = net(torch.from_numpy(tgt), torch.from_numpy(mem)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
