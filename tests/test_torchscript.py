@@ -498,3 +498,50 @@ def test_scripted_transformer_decoder_matches_torch(tmp_path):
     with torch.no_grad():
         ref = net(torch.from_numpy(tgt), torch.from_numpy(mem)).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
+def test_scripted_residual_cnn_matches_torch(tmp_path):
+    """ResNet-pattern residual blocks (conv+bn chains, strided
+    downsample shortcut, adaptive pool head) — the deep-CNN shape,
+    hand-built since torchvision is absent."""
+    import torch.nn as tnn
+
+    class Block(tnn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.c1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.b1 = tnn.BatchNorm2d(cout)
+            self.c2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.b2 = tnn.BatchNorm2d(cout)
+            self.down = (tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+                if stride != 1 or cin != cout else tnn.Identity())
+
+        def forward(self, x):
+            h = torch.relu(self.b1(self.c1(x)))
+            h = self.b2(self.c2(h))
+            return torch.relu(h + self.down(x))
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem = tnn.Conv2d(3, 8, 3, 1, 1)
+            self.b1 = Block(8, 8, 1)
+            self.b2 = Block(8, 16, 2)
+            self.pool = tnn.AdaptiveAvgPool2d((1, 1))
+            self.fc = tnn.Linear(16, 5)
+
+        def forward(self, x):
+            h = torch.relu(self.stem(x))
+            h = self.b2(self.b1(h))
+            return self.fc(self.pool(h).flatten(1))
+
+    net = Net().eval()
+    b = _script_and_load(tmp_path, net, name="resnet.pt")
+    x = np.random.RandomState(16).randn(2, 3, 16, 16).astype(np.float32)
+    ours = np.asarray(_run_bundle(b, x)[0])
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
