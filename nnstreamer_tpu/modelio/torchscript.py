@@ -1368,8 +1368,11 @@ def _make_torch_ops(I: "_Interp") -> Dict[str, Callable]:
             "...qd,...kd->...qk", q.astype(jnp.float32),
             k.astype(jnp.float32)) * s
         if is_causal:
+            # torch defines is_causal as ones(L, S).tril(diagonal=0) —
+            # top-left aligned even when Lq != Lk (KV-cached decode
+            # exports hit that shape)
             sq, sk = logits.shape[-2], logits.shape[-1]
-            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=0)
             logits = jnp.where(mask, logits, -jnp.inf)
         if attn_mask is not None:
             m = asarr(attn_mask)

@@ -431,6 +431,31 @@ def test_scripted_attention_block_matches_torch(tmp_path, causal):
 
 
 @needs_torch
+def test_scripted_sdpa_causal_cross_length_matches_torch(tmp_path):
+    """is_causal with Lq != Lk (KV-cached decode export shape): torch
+    defines the mask as ones(L, S).tril(diagonal=0) — top-left aligned,
+    not bottom-right (round-4 ADVICE)."""
+    import torch.nn.functional as F
+
+    class Net(torch.nn.Module):
+        def forward(self, q, k, v):
+            return F.scaled_dot_product_attention(q, k, v,
+                                                  is_causal=True)
+
+    net = Net().eval()
+    b = _script_and_load(tmp_path, net, name="sdpa_cross.pt")
+    rs = np.random.RandomState(13)
+    q = rs.randn(2, 4, 6, 8).astype(np.float32)
+    k = rs.randn(2, 4, 10, 8).astype(np.float32)
+    v = rs.randn(2, 4, 10, 8).astype(np.float32)
+    ours = np.asarray(_run_bundle(b, q, k, v)[0])
+    with torch.no_grad():
+        ref = net(torch.from_numpy(q), torch.from_numpy(k),
+                  torch.from_numpy(v)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@needs_torch
 def test_scripted_multihead_attention_matches_torch(tmp_path):
     """nn.MultiheadAttention scripts through its fused fast path
     (_native_multi_head_attention) — packed-QKV self-attention must
