@@ -15,6 +15,8 @@ Formats:
   to one XLA program (uff.py).
 - `.caffemodel` — Caffe NetParameter snapshots (graph + blobs in one
   file), protowire-decoded (caffe.py).
+- `.dlc` — SNPE Deep Learning Container (zip of NETD/NETP
+  flatbuffers), read without the SNPE SDK (dlc.py).
 
 `load_model_file(path, **opts)` dispatches on extension and returns a
 `backends.xla.ModelBundle`.
@@ -33,7 +35,8 @@ import nnstreamer_tpu.modelio.tflite_custom  # noqa: F401 (registers ops)
 
 #: extensions this package can ingest → default backend
 MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla", "pb": "xla",
-                    "pt": "xla", "uff": "xla", "caffemodel": "xla"}
+                    "pt": "xla", "uff": "xla", "caffemodel": "xla",
+                    "dlc": "xla"}
 
 
 def load_model_file(path: str, batch: Optional[int] = None,
@@ -62,6 +65,11 @@ def load_model_file(path: str, batch: Optional[int] = None,
         for p in parts:
             if not os.path.exists(p):
                 raise BackendError(f"model file {p!r} does not exist")
+        if compute_dtype is not None:
+            raise BackendError(
+                "custom=dtype= is not consumed by caffe2 init,predict "
+                "pairs (they run in the NetDef's declared dtypes); "
+                "supported for .tflite and .pt")
         from nnstreamer_tpu.modelio.caffe2 import lower_caffe2
 
         lowered = lower_caffe2(parts[0], parts[1],
@@ -89,6 +97,18 @@ def load_model_file(path: str, batch: Optional[int] = None,
         raise BackendError(
             f"custom=side= declares a caffe2 NetDef input resolution "
             f"and applies to init,predict pairs only (got {path!r})")
+    if compute_dtype is not None and ext not in ("tflite", "pt"):
+        # only the tflite/.pt lowerings consume a compute dtype; the
+        # rest run in the graph's own dtypes (.npz archs take dtype via
+        # the arch query string instead). An allowlist so a future
+        # format can't silently swallow dtype= the way round 4's
+        # .uff/.caffemodel/.pb did — the loader's fail-loud convention
+        # (like inputname/outputname and side above).
+        raise BackendError(
+            f"custom=dtype= is not consumed by .{ext} models (they run "
+            f"in the graph's declared dtypes; .npz archs take "
+            f"?dtype=... in the arch string); supported for .tflite "
+            f"and .pt")
 
     if ext == "tflite":
         # per-format compute default: tflite runs bf16 (MXU-native,
@@ -170,6 +190,16 @@ def load_model_file(path: str, batch: Optional[int] = None,
         return ModelBundle(fn=lowered.fn, params=lowered.params,
                            in_spec=None, out_spec=None,
                            name=os.path.basename(path))
+
+    if ext == "dlc":
+        from nnstreamer_tpu.modelio.dlc import lower_dlc, parse_dlc
+
+        lowered = lower_dlc(parse_dlc(path), batch=batch)
+        return ModelBundle(
+            fn=lowered.fn, params=lowered.params,
+            in_spec=mk(lowered.in_shapes, lowered.in_dtypes),
+            out_spec=mk(lowered.out_shapes, lowered.out_dtypes),
+            name=os.path.basename(path))
 
     if ext == "caffemodel":
         from nnstreamer_tpu.modelio.caffe import (

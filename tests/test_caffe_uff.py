@@ -99,6 +99,16 @@ def test_caffemodel_not_a_model_fails_loud(tmp_path):
         load_model_file(str(p))
 
 
+@needs_models
+@pytest.mark.parametrize("path", [CAFFE_LENET, UFF_LENET])
+def test_compute_dtype_rejected_for_fixed_dtype_formats(path):
+    """custom=dtype= is not consumed by .caffemodel/.uff/.pb lowerings;
+    silently ignoring it would break the loader's fail-loud convention
+    (round-4 ADVICE)."""
+    with pytest.raises(BackendError, match="dtype"):
+        load_model_file(path, compute_dtype="bfloat16")
+
+
 # -- uff ---------------------------------------------------------------------
 
 @needs_models

@@ -1,0 +1,137 @@
+"""SNPE `.dlc` ingestion goldens.
+
+Uses the reference's own checked-in add2 containers and the reference's
+own test semantics (`tests/nnstreamer_filter_snpe/unittest_filter_snpe
+.cc:167-258`): y = x + 2 exact — input 0 → 2, 10 → 12, 1 → 3 — with
+float32 I/O for add2_float.dlc and uint8 I/O for add2_uint8.dlc (the
+reference passes custom "InputType:uint8,OutputType:uint8"; the
+container itself marks the input as image-typed, which this loader
+honors without the custom property)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.modelio import load_model_file
+from nnstreamer_tpu.modelio.dlc import lower_dlc, parse_dlc
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+MODELS = "/root/reference/tests/test_models/models"
+DLC_FLOAT = os.path.join(MODELS, "add2_float.dlc")
+DLC_UINT8 = os.path.join(MODELS, "add2_uint8.dlc")
+
+needs_models = pytest.mark.skipif(
+    not (os.path.exists(DLC_FLOAT) and os.path.exists(DLC_UINT8)),
+    reason="reference test models absent")
+
+
+def _run(bundle, x):
+    import jax
+
+    return np.asarray(jax.jit(
+        lambda p, a: bundle.fn(p, a))(bundle.params, x)[0])
+
+
+@needs_models
+def test_parse_dlc_structure():
+    g = parse_dlc(DLC_FLOAT)
+    assert [(l.name, l.type) for l in g.layers] == [
+        ("X_input", "Input"),
+        ("elementwise_sum_0_const", "Const"),
+        ("elementwise_sum_0", "ElementwiseBinaryOp")]
+    assert g.buffer_dims["X_input"] == (1,)
+    assert g.buffer_dims["ADD_TOP"] == (1,)
+    w = g.params["elementwise_sum_0_const"]
+    np.testing.assert_array_equal(w, np.asarray([2.0], np.float32))
+    assert "snpe-tflite-to-dlc" in g.metadata
+
+
+@needs_models
+def test_dlc_float_add2_golden():
+    """Reference invoke00: 0→2, 10→12, 1→3, float32 exact."""
+    b = load_model_file(DLC_FLOAT)
+    assert b.in_spec.tensors[0].dtype.np_dtype == np.float32
+    assert b.out_spec.tensors[0].dtype.np_dtype == np.float32
+    for xin, want in ((0.0, 2.0), (10.0, 12.0), (1.0, 3.0)):
+        y = _run(b, np.asarray([xin], np.float32))
+        assert y.shape == (1,)
+        assert y[0] == want
+
+
+@needs_models
+def test_dlc_uint8_add2_golden():
+    """Reference invoke01: uint8 I/O, 0→2, 10→12, 1→3 exact."""
+    b = load_model_file(DLC_UINT8)
+    assert b.in_spec.tensors[0].dtype.np_dtype == np.uint8
+    assert b.out_spec.tensors[0].dtype.np_dtype == np.uint8
+    for xin, want in ((0, 2), (10, 12), (1, 3)):
+        y = _run(b, np.asarray([xin], np.uint8))
+        assert y.dtype == np.uint8
+        assert int(y[0]) == want
+
+
+@needs_models
+def test_dlc_pipeline_end_to_end():
+    """tensor_filter auto-detects .dlc by extension and runs it."""
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=1 types=float32 ! "
+        f"tensor_filter model={DLC_FLOAT} ! tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    pipe.get("src").push(TensorBuffer.of(np.asarray([10.0], np.float32)))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    assert float(np.asarray(res[0].tensors[0])[0]) == 12.0
+
+
+@needs_models
+def test_dlc_unknown_layer_fails_loud():
+    """Unsupported layer types surface at load (the output-shape probe
+    traces the whole graph), not at first invoke."""
+    g = parse_dlc(DLC_FLOAT)
+    g.layers[2].type = "FancyNewLayer"
+    with pytest.raises(BackendError, match="FancyNewLayer"):
+        lower_dlc(g)
+
+
+@needs_models
+def test_dlc_input_without_dims_fails_loud():
+    g = parse_dlc(DLC_FLOAT)
+    g.buffer_dims.pop("X_input")
+    g.layers[0].attrs.pop("OutputDims", None)
+    with pytest.raises(BackendError, match="dims"):
+        lower_dlc(g)
+
+
+@needs_models
+def test_dlc_batch_override_on_rank1_fails_loud():
+    with pytest.raises(BackendError, match="rank"):
+        lower_dlc(parse_dlc(DLC_FLOAT), batch=4)
+
+
+def test_dlc_not_a_zip_fails_loud(tmp_path):
+    p = tmp_path / "junk.dlc"
+    p.write_bytes(b"\x00\x01nope")
+    with pytest.raises(BackendError, match="zip"):
+        parse_dlc(str(p))
+
+
+def test_dlc_zip_without_model_fails_loud(tmp_path):
+    import zipfile
+
+    p = tmp_path / "empty.dlc"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("other", b"x")
+    with pytest.raises(BackendError, match="model"):
+        parse_dlc(str(p))
+
+
+@needs_models
+def test_dlc_rejects_compute_dtype():
+    with pytest.raises(BackendError, match="dtype"):
+        load_model_file(DLC_FLOAT, compute_dtype="bfloat16")

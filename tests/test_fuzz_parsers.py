@@ -120,6 +120,16 @@ def test_graphdef_parser_contract(tmp_path):
         ".pb")
 
 
+@pytest.mark.skipif(not os.path.exists(MODELS),
+                    reason="reference models absent")
+def test_dlc_parser_contract(tmp_path):
+    from nnstreamer_tpu.modelio.dlc import parse_dlc
+
+    _file_parser_contract(
+        parse_dlc, os.path.join(MODELS, "add2_float.dlc"), 7, tmp_path,
+        ".dlc")
+
+
 def test_torchscript_loader_contract(tmp_path):
     from nnstreamer_tpu.modelio.torchscript import load_torchscript
 

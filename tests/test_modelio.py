@@ -809,6 +809,12 @@ def test_singleshot_runs_all_ingestion_formats():
     assert np.asarray(y3).shape == (1, 1001)
     s3.close()
 
+    # SNPE DLC (add2 golden: y = x + 2)
+    s4 = SingleShot(model=os.path.join(MODELS, "add2_float.dlc"))
+    (y4,) = s4.invoke(np.asarray([10.0], np.float32))
+    assert float(np.asarray(y4)[0]) == 12.0
+    s4.close()
+
 
 # -- converter-built op-breadth goldens --------------------------------------
 
