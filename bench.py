@@ -37,8 +37,17 @@ hosts do the same D2H in microseconds. The bench therefore:
 (b) probes the tunnel (`env`) in-process last, so numbers can be
     interpreted.
 
-Prints ONE JSON line; headline metric stays mobilenet FPS/chip
-vs the 30 FPS driver target (BASELINE.json).
+Kill-resilience contract (round-5): the bench must ship data no matter
+when the driver kills it. After EVERY family completes, the full
+cumulative result JSON is printed as one flushed line — the driver
+keeps the last parseable line, so a kill at any point loses at most the
+in-flight family. SIGTERM additionally triggers a final snapshot before
+exit. Family subprocesses are bounded by BENCH_FAMILY_TIMEOUT_S
+(default 300s) and the whole run by BENCH_BUDGET_S (default 1500s);
+long families (batch_sweep, pallas) stream per-step partial results so
+even a timed-out family contributes what it measured. The LAST printed
+line is the most complete result; intermediate lines carry
+"partial": true.
 """
 
 from __future__ import annotations
@@ -54,6 +63,7 @@ MOBILENET_TFLITE = ("/root/reference/tests/test_models/models/"
 LABELS = "/root/reference/tests/test_models/labels/labels.txt"
 BASELINE_FPS = 30.0          # BASELINE.json driver target, FPS/chip
 PEAK_BF16_TFLOPS = 197.0     # TPU v5e public peak, bf16
+PEAK_HBM_GBPS = 819.0        # TPU v5e public HBM bandwidth
 
 
 def _percentile(sorted_vals, p):
@@ -413,8 +423,11 @@ def _build_composite():
 
 #: MeshDispatcher coalescing windows swept for BASELINE row 5 — each
 #: point runs as its own subprocess family (a fresh chip per point: one
-#: point's closed-loop readbacks must not poison the next's dispatch)
-OFFLOAD_DELAYS = (0.0, 3.0, 8.0, 32.0)
+#: point's closed-loop readbacks must not poison the next's dispatch).
+#: Two points (round-5: the sweep is variance-dominated on the tunnel;
+#: median-of-3 runs per point with spread beats more points), chosen
+#: from the round-3/4 curves: 0 = latency floor, 3 = throughput knee.
+OFFLOAD_DELAYS = (0.0, 3.0)
 
 
 def _offload_point(delay_ms: float):
@@ -646,6 +659,12 @@ def batch_sweep(batches=None):
       double-buffered `prefetch_to_device` input pipeline (H2D overlaps
       compute — the deployable number; on the tunneled dev chip this is
       transfer-bound, on a local TPU host it approaches `fps`).
+    - `hbm_gbps` / `hbm_util_pct` / `ai_flops_per_byte`: achieved HBM
+      bandwidth (XLA-counted bytes accessed over the measured step) vs
+      the chip's 819 GB/s peak, plus arithmetic intensity — the
+      roofline evidence for WHY MobileNet's MFU tops out where it does
+      (depthwise-separable convs are byte-bound, not FLOP-bound; the
+      claim is only honest if the knee runs near the bandwidth peak).
     Knee = batch with best MFU.
     """
     import jax
@@ -674,7 +693,9 @@ def batch_sweep(batches=None):
                 bundle.in_spec.tensors[0].dtype.np_dtype == np.float32:
             x = ((x.astype(np.float32) - 127.5) / 127.5)
         compiled = fn.lower(params, x).compile()
-        flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
+        cost = compiled.cost_analysis() or {}
+        flops = float(cost.get("flops", 0.0))
+        hbm_bytes = float(cost.get("bytes accessed", 0.0))
         # pure compute, input resident on device (median of three
         # differencing samples: single samples can be off by 2-8x
         # under tunnel jitter — measured b=8/b=32 inversions)
@@ -696,6 +717,7 @@ def batch_sweep(batches=None):
             got += 1
         _sync(y)
         piped_fps = (got - 1) * b / max(time.perf_counter() - t0, 1e-9)
+        gbps = hbm_bytes / (ms / 1e3) / 1e9 if hbm_bytes else 0.0
         out[str(b)] = {
             "ms": round(ms, 3),
             "fps": round(fps, 1),
@@ -703,7 +725,14 @@ def batch_sweep(batches=None):
             "tflops": round(tflops, 3),
             "mfu_pct": round(100 * tflops / PEAK_BF16_TFLOPS, 2)
             if on_tpu and tflops else 0.0,
+            "hbm_bytes_per_step": hbm_bytes,
+            "hbm_gbps": round(gbps, 1),
+            "hbm_util_pct": round(100 * gbps / PEAK_HBM_GBPS, 1)
+            if on_tpu and gbps else 0.0,
+            "ai_flops_per_byte": round(flops / hbm_bytes, 2)
+            if hbm_bytes else 0.0,
         }
+        _family_partial(out)     # a timed-out sweep still ships batches
     # knee = best-MFU batch on TPU; off-TPU (mfu is 0) best raw FPS
     key = "mfu_pct" if on_tpu else "fps"
     out["knee_batch"] = max(
@@ -799,11 +828,12 @@ def pallas_check():
                 100 * flops / (ours / 1e3) / 1e12 / PEAK_BF16_TFLOPS, 1),
             "max_abs_err": round(err, 4),
         }
-        out["flash_long_s"] = _flash_long_s()
+        _family_partial(out)     # s2048 survives a long-S timeout
+        _flash_long_s(out)
     return out
 
 
-def _flash_long_s():
+def _flash_long_s(base_out):
     """Long-sequence flash rows (§5.7 long-context): S=8192 on the plain
     q-block grid (vs the XLA softmax, which still fits), and S=32768
     where the kernel auto-switches to the K-blocked streaming grid
@@ -818,6 +848,7 @@ def _flash_long_s():
 
     H, D = 8, 128
     out = {}
+    base_out["flash_long_s"] = out
     # S=32768: per-head K/V = 2*S*D*2B = 16MB, past the 8MB VMEM budget
     # (S=16384 is exactly AT the budget and still takes the plain grid)
     for S, vs_xla in ((8192, True), (32768, False)):
@@ -848,6 +879,7 @@ def _flash_long_s():
             row["speedup_vs_xla"] = round(xla / ms, 2)
             row["max_abs_err"] = round(err, 4)
         out[f"s{S}"] = row
+        _family_partial(base_out)
     return out
 
 
@@ -882,6 +914,7 @@ def mxu_peak():
         ms = _med3(f, *args, n1=50, n2=200)
         tops = flops / (ms / 1e3) / 1e12
         out[name] = {"ms": round(ms, 3), "tflops": round(tops, 1)}
+        _family_partial(out)
     out["bf16"]["mfu_pct"] = round(
         100 * out["bf16"]["tflops"] / PEAK_BF16_TFLOPS, 1)
     out["int8_vs_bf16_peak"] = round(
@@ -934,7 +967,8 @@ def transformer_prefill():
                      "mfu_pct": mfu,
                      "tokens_per_s": round(B * S / ms * 1e3)}
         best = max(best, mfu)
-    out["mfu_pct"] = best
+        out["mfu_pct"] = best
+        _family_partial(out)     # prefill rows survive a decode stall
     # streaming decode (§5.7): one token per step through the ring
     # KV cache — the HBM-bound half of the serving story (params are
     # re-read every step; prefill above is the MXU-bound half)
@@ -1027,50 +1061,166 @@ for _name, _fn in _CONFIGS.items():
 
 _FAMILY_SENTINEL = "BENCHJSON:"
 
+#: handle of the currently-running family subprocess, so the SIGTERM
+#: handler can reap it before the parent exits
+_CHILD = None
 
-def _run_family_subprocess(name: str, errors: dict):
+
+def _family_partial(result) -> None:
+    """Stream a family's partial result to the parent (flushed sentinel
+    line). A family subprocess killed mid-run still contributes its
+    last streamed state; outside --family mode this is a no-op print
+    the parent never sees."""
+    try:
+        print(_FAMILY_SENTINEL + json.dumps({"partial": result}),
+              flush=True)
+    except (TypeError, ValueError):
+        pass                     # never let telemetry kill measurement
+
+
+def _run_family_subprocess(name: str, errors: dict, timeout_s: float):
     """Run one measurement family in a child process; the parent has not
-    touched jax yet, so the child owns the chip alone."""
+    touched jax yet, so the child owns the chip alone. On timeout the
+    child is killed and its last streamed partial result (if any) is
+    kept."""
     import subprocess
 
+    global _CHILD
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--family", name],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    _CHILD = proc
+    timed_out = False
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--family", name],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            timeout=1800, cwd=os.path.dirname(os.path.abspath(__file__)))
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        errors[name] = "family subprocess timed out (1800s)"
-        return {}
-    for line in proc.stdout.decode(errors="replace").splitlines():
+        timed_out = True
+        proc.kill()
+        stdout, stderr = proc.communicate()
+    finally:
+        _CHILD = None
+    final = partial = None
+    for line in stdout.decode(errors="replace").splitlines():
         if not line.startswith(_FAMILY_SENTINEL):
             continue
         try:
             payload = json.loads(line[len(_FAMILY_SENTINEL):])
-        except json.JSONDecodeError as e:
-            errors[name] = f"family emitted corrupt result: {e}"
-            return {}
-        if "error" in payload:
-            errors[name] = payload["error"]
-            return {}
-        return payload["result"]
-    stderr_tail = proc.stderr.decode(errors="replace").strip() \
+        except json.JSONDecodeError:
+            continue             # killed mid-write: keep prior state
+        if "result" in payload or "error" in payload:
+            final = payload
+        elif "partial" in payload:
+            partial = payload["partial"]
+    if timed_out:
+        errors[name] = (f"family subprocess timed out "
+                        f"({timeout_s:.0f}s)"
+                        + ("; partial result kept" if partial else ""))
+        return partial or {}
+    if final is not None:
+        if "error" in final:
+            errors[name] = final["error"]
+            return partial or {}
+        return final["result"]
+    stderr_tail = stderr.decode(errors="replace").strip() \
         .splitlines()[-3:]
     errors[name] = (f"family subprocess exited {proc.returncode} "
                     f"without a result"
                     + (f"; stderr: {' | '.join(stderr_tail)}"
                        if stderr_tail else ""))
-    return {}
+    return partial or {}
 
 
 def _family_main(name: str) -> int:
     try:
         result = _FAMILIES[name]()
-        print(_FAMILY_SENTINEL + json.dumps({"result": result}))
+        print(_FAMILY_SENTINEL + json.dumps({"result": result}),
+              flush=True)
         return 0
     except Exception as e:
         print(_FAMILY_SENTINEL + json.dumps(
-            {"error": f"{type(e).__name__}: {e}"}))
+            {"error": f"{type(e).__name__}: {e}"}), flush=True)
         return 1
+
+
+def _offload_median(runs: list) -> dict:
+    """Median-of-N offload point (by fps) with the run-to-run spread in
+    the artifact — the tunnel makes single offload runs vary up to 3×
+    (round-4: 86-285 FPS across identical quiet runs), so one sample is
+    a claim, not a result."""
+    ok = [r for r in runs if isinstance(r, dict) and "fps" in r]
+    if not ok:
+        return {}
+    # lower-middle on even counts: a budget-truncated 2-run point must
+    # not report its best run as "the median" of a 3x-variance metric
+    med = dict(sorted(ok, key=lambda r: r["fps"])[(len(ok) - 1) // 2])
+    med["runs"] = len(ok)
+    med["fps_spread"] = [min(r["fps"] for r in ok),
+                         max(r["fps"] for r in ok)]
+    med["p50_spread_ms"] = [min(r["p50_ms"] for r in ok),
+                            max(r["p50_ms"] for r in ok)]
+    return med
+
+
+def _ordered_families() -> list:
+    """Importance order under the soft budget: the headline config
+    first (any kill after ~2 min still ships it), then the
+    VERDICT-critical kernel/MFU/roofline families, then the remaining
+    BASELINE configs, then the offload sweep and int8 check."""
+    if os.environ.get("BENCH_SELFTEST") == "fake":
+        return list(_FAMILIES)
+    return (["cfg_label_device", "pallas", "transformer_prefill",
+             "mxu_peak", "batch_sweep"]
+            + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
+            + [f"offload_{d}" for d in OFFLOAD_DELAYS]
+            + ["int8_native"])
+
+
+def _assemble(family_out: dict, errors: dict, env: dict,
+              elapsed_s: float, partial: bool) -> dict:
+    """Build the full cumulative result JSON from whatever has finished
+    so far — called after EVERY family so the last printed line is
+    always the most complete record."""
+    results = {}
+    for name in _CONFIGS:
+        r = family_out.get(f"cfg_{name}")
+        if r:
+            results[name] = r
+    offload_curve = {}
+    for d in OFFLOAD_DELAYS:
+        med = _offload_median(family_out.get(f"offload_{d}") or [])
+        offload_curve[str(d)] = med or {
+            "error": errors.get(f"offload_{d}", "no result")}
+    if any("fps" in v for v in offload_curve.values()):
+        results["offload"] = _assemble_offload(offload_curve)
+    headline = results.get("label_device", {}).get("fps", 0.0)
+    out = {
+        "metric": "mobilenet_v2_224_fps_per_chip",
+        "value": headline,
+        "unit": "frames/s",
+        "vs_baseline": round(headline / BASELINE_FPS, 3),
+        "configs": results,
+        "batch_sweep": family_out.get("batch_sweep", {}),
+        "int8_native": family_out.get("int8_native", {}),
+        "pallas": family_out.get("pallas", {}),
+        "transformer_prefill": family_out.get("transformer_prefill", {}),
+        "mxu_peak": family_out.get("mxu_peak", {}),
+        "env": env,
+        "elapsed_s": round(elapsed_s, 1),
+        "families_done": sorted(k for k, v in family_out.items() if v),
+    }
+    if os.environ.get("BENCH_SELFTEST") == "fake":
+        out["families"] = family_out     # raw view for the regression
+                                         # tests' snapshot assertions
+    if partial:
+        out["partial"] = True
+    if errors:
+        out["errors"] = dict(errors)
+    return out
+
+
+def _emit(out: dict) -> None:
+    print(json.dumps(out), flush=True)
 
 
 def main() -> int:
@@ -1081,80 +1231,150 @@ def main() -> int:
                   f"{{{','.join(sorted(_FAMILIES))}}}", file=sys.stderr)
             return 2
         return _family_main(sys.argv[idx])
-    results = {}
-    errors = {}
-    # Phase 1 — one subprocess per family with a fresh client (the
-    # parent must not import jax before these finish: only one process
-    # can own the chip). Order = importance under the soft time budget:
-    # the BASELINE-table configs first, then the VERDICT-critical
-    # kernel/MFU families, then sweeps; if the budget runs out the tail
-    # is skipped loudly and the JSON still ships with everything that
-    # ran (a killed bench ships nothing).
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "3000"))
+
+    errors: dict = {}
+    family_out: dict = {}
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    family_timeout_s = float(os.environ.get("BENCH_FAMILY_TIMEOUT_S",
+                                            "300"))
     t0 = time.monotonic()
-    ordered = (
-        [f"cfg_{n}" for n in _CONFIGS]
-        + ["pallas", "transformer_prefill", "mxu_peak"]
-        + [f"offload_{d}" for d in OFFLOAD_DELAYS]
-        + ["batch_sweep", "int8_native"])
-    family_out = {}
+
+    # a SIGTERM (the usual `timeout` kill) must still ship the record:
+    # reap the in-flight child, print the cumulative snapshot, exit.
+    # SIGKILL can't be trapped — the per-family snapshot lines already
+    # printed cover that case (the driver keeps the last parseable one).
+    import signal
+
+    def _on_term(signum, frame):
+        child = _CHILD
+        if child is not None:
+            try:
+                child.kill()
+            except Exception:
+                pass
+        errors["bench"] = "terminated by SIGTERM"
+        snap = _assemble(family_out, errors, {},
+                         time.monotonic() - t0, partial=True)
+        # async-signal-safe write: print() on buffered stdout raises a
+        # reentrant-call RuntimeError if the signal landed mid-print in
+        # the main loop. The leading newline detaches the snapshot from
+        # any half-written line (which stays unparseable — fine, the
+        # driver keeps the last parseable one).
+        try:
+            os.write(1, ("\n" + json.dumps(snap) + "\n").encode())
+        except OSError:
+            pass
+        os._exit(3)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass                     # non-main thread (tests) — snapshots
+                                 # alone carry the contract
+
+    def remaining() -> float:
+        return budget_s - (time.monotonic() - t0)
+
+    # thresholds scale with the budget (absolute caps sized for the
+    # default 1500s budget) so tiny selftest budgets behave the same
+    skip_below = min(45.0, 0.03 * budget_s)
+    retry_above = min(120.0, 0.08 * budget_s)
+    offload_rerun_above = min(150.0, 0.10 * budget_s)
+
+    def run_one(name: str) -> dict:
+        """One family subprocess, clamped to the remaining budget."""
+        floor = min(30.0, family_timeout_s)
+        timeout = max(floor, min(family_timeout_s, remaining() + 15.0))
+        return _run_family_subprocess(name, errors, timeout)
+
+    # Phase 1 — one subprocess per family with a fresh client (the
+    # parent must not touch jax before these finish: one process owns
+    # the chip at a time). After EVERY family the full cumulative JSON
+    # is printed (flushed): a hard kill at any point loses at most the
+    # in-flight family.
+    ordered = _ordered_families()
     for name in ordered:
-        if time.monotonic() - t0 > budget_s:
+        if remaining() <= skip_below:
             errors[name] = (f"skipped: bench time budget "
                             f"({budget_s:.0f}s) exhausted")
-            family_out[name] = {}
             continue
-        family_out[name] = _run_family_subprocess(name, errors)
-        if not family_out[name] and name in errors \
-                and "budget" not in errors[name] \
-                and time.monotonic() - t0 <= budget_s:
-            # transient failures happen (the tunnel's remote-compile
-            # hop stalls intermittently) — one retry on a fresh client
-            first_err = errors.pop(name)
-            family_out[name] = _run_family_subprocess(name, errors)
-            if name in errors:
-                errors[name] = (f"{errors[name]} (first attempt: "
-                                f"{first_err})")
-    sweep = family_out["batch_sweep"]
-    int8_native = family_out["int8_native"]
-    pallas = family_out["pallas"]
-    prefill = family_out["transformer_prefill"]
-    mxu = family_out["mxu_peak"]
-    offload_curve = {
-        str(d): family_out.get(f"offload_{d}")
-        or {"error": errors.get(f"offload_{d}", "no result")}
-        for d in OFFLOAD_DELAYS}
-    results["offload"] = _assemble_offload(offload_curve)
-    for name in _CONFIGS:
-        r = family_out.get(f"cfg_{name}")
-        if r:
-            results[name] = r
+        if name.startswith("offload_"):
+            # median-of-3 (budget permitting): the offload row is
+            # tunnel-variance-dominated; spread ships in the artifact
+            runs = []
+            for _ in range(3):
+                if runs and remaining() <= offload_rerun_above:
+                    break
+                r = run_one(name)
+                runs.append(r)
+                if r:
+                    errors.pop(name, None)
+            family_out[name] = [r for r in runs if r]
+            if not family_out[name] and name not in errors:
+                errors[name] = "no successful offload run"
+        else:
+            family_out[name] = run_one(name)
+            if not family_out[name] and name in errors \
+                    and "skipped" not in errors[name] \
+                    and "timed out" not in errors[name] \
+                    and remaining() > retry_above:
+                # transient failures happen (the tunnel's remote-compile
+                # hop stalls intermittently) — one retry, fresh client,
+                # still inside the budget
+                first_err = errors.pop(name)
+                family_out[name] = run_one(name)
+                if name in errors:
+                    errors[name] = (f"{errors[name]} (first attempt: "
+                                    f"{first_err})")
+        _emit(_assemble(family_out, errors, {},
+                        time.monotonic() - t0, partial=True))
+
     # Phase 2 — the env probe runs in-process last (its D2H reads can
     # degrade nothing at this point).
-    try:
-        env = _probe_env()
-    except Exception as e:
-        env = {}
-        errors["env"] = f"{type(e).__name__}: {e}"
+    env = {}
+    if os.environ.get("BENCH_SELFTEST") != "fake":
+        try:
+            env = _probe_env()
+        except Exception as e:
+            errors["env"] = f"{type(e).__name__}: {e}"
 
-    headline = results.get("label_device", {}).get("fps", 0.0)
-    out = {
-        "metric": "mobilenet_v2_224_fps_per_chip",
-        "value": headline,
-        "unit": "frames/s",
-        "vs_baseline": round(headline / BASELINE_FPS, 3),
-        "configs": results,
-        "batch_sweep": sweep,
-        "int8_native": int8_native,
-        "pallas": pallas,
-        "transformer_prefill": prefill,
-        "mxu_peak": mxu,
-        "env": env,
+    out = _assemble(family_out, errors, env, time.monotonic() - t0,
+                    partial=False)
+    _emit(out)
+    return 1 if (errors or not out["value"]) else 0
+
+
+# -- selftest fakes (kill-resilience regression tests) -----------------------
+# BENCH_SELFTEST=fake swaps the measurement families for tiny fakes (no
+# jax, no chip) so tests/test_bench_logic.py can drive the FULL
+# orchestration loop — budgets, per-family timeouts, partial streaming,
+# snapshot-per-family, SIGTERM/SIGKILL — in milliseconds.
+if os.environ.get("BENCH_SELFTEST") == "fake":
+    def _fake_hang():
+        deadline = time.monotonic() + float(
+            os.environ.get("BENCH_SELFTEST_HANG_S", "600"))
+        _family_partial({"streamed": "before-hang"})
+        while time.monotonic() < deadline:   # ignores nothing, just slow
+            time.sleep(0.05)
+        return {"hung": False}
+
+    def _fake_slow_stream():
+        out = {}
+        for i in range(40):
+            out[f"step{i}"] = i
+            _family_partial(dict(out))
+            time.sleep(float(os.environ.get(
+                "BENCH_SELFTEST_STEP_S", "0.05")))
+        return out
+
+    _FAMILIES = {
+        "fast_a": lambda: {"v": 1},
+        "fast_b": lambda: {"v": 2},
+        "boom": lambda: 1 / 0,
+        "hang": _fake_hang,
+        "slow_stream": _fake_slow_stream,
+        "tail_z": lambda: {"v": 3},
     }
-    if errors:
-        out["errors"] = errors
-    print(json.dumps(out))
-    return 1 if (errors or not headline) else 0
 
 
 if __name__ == "__main__":

@@ -54,7 +54,7 @@ from nnstreamer_tpu.elements.ipc import IpcSink, IpcSrc
 from nnstreamer_tpu.elements.repo import REPO, TensorRepoSink, TensorRepoSrc
 from nnstreamer_tpu.elements.routing import (
     Join, Queue, Tee, TensorDemux, TensorMerge, TensorMux, TensorSplit)
-from nnstreamer_tpu.elements.sinks import FakeSink, TensorSink
+from nnstreamer_tpu.elements.sinks import FakeSink, FileSink, TensorSink
 from nnstreamer_tpu.elements.sources import AppSrc, TensorSrc, VideoTestSrc
 from nnstreamer_tpu.elements.sparse_elements import (
     TensorSparseDec, TensorSparseEnc)
@@ -63,6 +63,7 @@ from nnstreamer_tpu.elements.transform import TensorTransform, TransformProgram
 __all__ = [
     "AppSrc",
     "FakeSink",
+    "FileSink",
     "IpcSink",
     "IpcSrc",
     "Join",

@@ -1,7 +1,10 @@
 """Sink elements.
 
 Reference parity: gsttensor_sink.c (appsink-like `new-data`/`eos` signals
-with signal-rate limiting :56-109,168-171) and fakesink.
+with signal-rate limiting :56-109,168-171), fakesink, and filesink (the
+reference test pipelines' standard result capture — e.g.
+tests/nnstreamer_filter_deepview_rt/runTest.sh writes the decoded label
+with `filesink location=class.out.log`).
 """
 
 from __future__ import annotations
@@ -60,6 +63,56 @@ class TensorSink(SinkElement):
 
     def flush(self):
         self.eos.set()
+        return []
+
+
+@register_element("filesink")
+class FileSink(SinkElement):
+    """Writes each buffer's raw bytes to a file (gst filesink analog).
+
+    Text streams (e.g. the image_labeling decoder's label output) land
+    as readable text; tensor streams land as their raw little-endian
+    bytes — the same thing gst filesink would write, so the reference's
+    golden-file test recipes (`filesink location=class.out.log` →
+    compare) port verbatim. `append=false` (default) truncates at
+    pipeline start."""
+
+    WANTS_HOST = True
+    ELEMENT_NAME = "filesink"
+    PROPS = {
+        "location": PropDef(str, None, "output file path"),
+        "append": PropDef(prop_bool, False, "append instead of truncate"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.props["location"]:
+            from nnstreamer_tpu.core.errors import PipelineError
+
+            raise PipelineError(
+                f"filesink {self.name}: location= is required")
+        self._fh = None
+        self.count = 0
+
+    def _handle(self):
+        if self._fh is None:
+            mode = "ab" if self.props["append"] else "wb"
+            self._fh = open(self.props["location"], mode)
+        return self._fh
+
+    def render(self, buf: TensorBuffer) -> None:
+        import numpy as np
+
+        fh = self._handle()
+        for t in buf.to_host().tensors:
+            fh.write(np.asarray(t).tobytes())
+        fh.flush()
+        self.count += 1
+
+    def flush(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
         return []
 
 

@@ -235,6 +235,10 @@ def lower_dlc(graph: DLCGraph, batch: Optional[int] = None):
         return None
 
     for layer in graph.layers:
+        if not layer.outputs:
+            raise BackendError(
+                f"dlc: layer {layer.name!r} ({layer.type}) declares no "
+                f"outputs")
         if layer.type == "Input":
             dims = _out_dims(layer)
             if dims is None:

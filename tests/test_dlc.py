@@ -109,6 +109,14 @@ def test_dlc_input_without_dims_fails_loud():
 
 
 @needs_models
+def test_dlc_layer_without_outputs_fails_loud():
+    g = parse_dlc(DLC_FLOAT)
+    g.layers[1].outputs = []
+    with pytest.raises(BackendError, match="no.*outputs"):
+        lower_dlc(g)
+
+
+@needs_models
 def test_dlc_batch_override_on_rank1_fails_loud():
     with pytest.raises(BackendError, match="rank"):
         lower_dlc(parse_dlc(DLC_FLOAT), batch=4)
