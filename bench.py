@@ -1078,11 +1078,14 @@ def _family_partial(result) -> None:
         pass                     # never let telemetry kill measurement
 
 
-def _run_family_subprocess(name: str, errors: dict, timeout_s: float):
+def _run_family_subprocess(name: str, errors: dict, timeout_s: float,
+                           timeout_names: set = None):
     """Run one measurement family in a child process; the parent has not
     touched jax yet, so the child owns the chip alone. On timeout the
-    child is killed and its last streamed partial result (if any) is
-    kept."""
+    child is killed, its last streamed partial result (if any) is kept,
+    and `name` is added to `timed_out` (retry decisions key off this
+    flag, never off error-message text — a child's own exception may
+    legitimately contain the words "timed out")."""
     import subprocess
 
     global _CHILD
@@ -1113,6 +1116,8 @@ def _run_family_subprocess(name: str, errors: dict, timeout_s: float):
         elif "partial" in payload:
             partial = payload["partial"]
     if timed_out:
+        if timeout_names is not None:
+            timeout_names.add(name)
         errors[name] = (f"family subprocess timed out "
                         f"({timeout_s:.0f}s)"
                         + ("; partial result kept" if partial else ""))
@@ -1280,12 +1285,16 @@ def main() -> int:
     skip_below = min(45.0, 0.03 * budget_s)
     retry_above = min(120.0, 0.08 * budget_s)
     offload_rerun_above = min(150.0, 0.10 * budget_s)
+    timeout_names: set = set()     # families the PARENT timed out —
+                                   # never retried (they'd eat the
+                                   # budget twice)
 
     def run_one(name: str) -> dict:
         """One family subprocess, clamped to the remaining budget."""
         floor = min(30.0, family_timeout_s)
         timeout = max(floor, min(family_timeout_s, remaining() + 15.0))
-        return _run_family_subprocess(name, errors, timeout)
+        return _run_family_subprocess(name, errors, timeout,
+                                      timeout_names)
 
     # Phase 1 — one subprocess per family with a fresh client (the
     # parent must not touch jax before these finish: one process owns
@@ -1305,18 +1314,19 @@ def main() -> int:
             for _ in range(3):
                 if runs and remaining() <= offload_rerun_above:
                     break
-                r = run_one(name)
-                runs.append(r)
-                if r:
-                    errors.pop(name, None)
+                runs.append(run_one(name))
             family_out[name] = [r for r in runs if r]
-            if not family_out[name] and name not in errors:
+            if family_out[name]:
+                # the point has data — a failed sibling run (in any
+                # order) must not flag the whole point as an error
+                errors.pop(name, None)
+            elif name not in errors:
                 errors[name] = "no successful offload run"
         else:
             family_out[name] = run_one(name)
             if not family_out[name] and name in errors \
                     and "skipped" not in errors[name] \
-                    and "timed out" not in errors[name] \
+                    and name not in timeout_names \
                     and remaining() > retry_above:
                 # transient failures happen (the tunnel's remote-compile
                 # hop stalls intermittently) — one retry, fresh client,

@@ -94,6 +94,13 @@ class FileSink(SinkElement):
         self._fh = None
         self.count = 0
 
+    def negotiate(self, in_specs):
+        # open (and truncate) at pipeline start, like gst filesink at
+        # state change — a run that produces zero buffers must not
+        # leave a previous run's output behind a passing golden compare
+        self._handle()
+        return super().negotiate(in_specs)
+
     def _handle(self):
         if self._fh is None:
             mode = "ab" if self.props["append"] else "wb"

@@ -17,6 +17,8 @@ Formats:
   file), protowire-decoded (caffe.py).
 - `.dlc` — SNPE Deep Learning Container (zip of NETD/NETP
   flatbuffers), read without the SNPE SDK (dlc.py).
+- `.rtm` — DeepViewRT model (RTMx flatbuffer), read without the
+  vendor runtime (rtm.py).
 
 `load_model_file(path, **opts)` dispatches on extension and returns a
 `backends.xla.ModelBundle`.
@@ -36,7 +38,7 @@ import nnstreamer_tpu.modelio.tflite_custom  # noqa: F401 (registers ops)
 #: extensions this package can ingest → default backend
 MODEL_EXTENSIONS = {"tflite": "xla", "npz": "xla", "pb": "xla",
                     "pt": "xla", "uff": "xla", "caffemodel": "xla",
-                    "dlc": "xla"}
+                    "dlc": "xla", "rtm": "xla"}
 
 
 def load_model_file(path: str, batch: Optional[int] = None,
@@ -195,6 +197,16 @@ def load_model_file(path: str, batch: Optional[int] = None,
         from nnstreamer_tpu.modelio.dlc import lower_dlc, parse_dlc
 
         lowered = lower_dlc(parse_dlc(path), batch=batch)
+        return ModelBundle(
+            fn=lowered.fn, params=lowered.params,
+            in_spec=mk(lowered.in_shapes, lowered.in_dtypes),
+            out_spec=mk(lowered.out_shapes, lowered.out_dtypes),
+            name=os.path.basename(path))
+
+    if ext == "rtm":
+        from nnstreamer_tpu.modelio.rtm import lower_rtm, parse_rtm
+
+        lowered = lower_rtm(parse_rtm(path), batch=batch)
         return ModelBundle(
             fn=lowered.fn, params=lowered.params,
             in_spec=mk(lowered.in_shapes, lowered.in_dtypes),

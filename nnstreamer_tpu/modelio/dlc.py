@@ -90,18 +90,6 @@ def _fb(raw: bytes, ident: bytes, what: str) -> Reader:
     return Reader(fb)
 
 
-def _read_strvec(r: Reader, table: int, fid: int) -> List[str]:
-    base, n = r._vec(table, fid)
-    if base is None:
-        return []
-    out = []
-    for i in range(n):
-        spos = r.indirect(base + 4 * i)
-        ln = r.u32(spos)
-        out.append(bytes(r.buf[spos + 4:spos + 4 + ln]).decode("utf-8"))
-    return out
-
-
 def _read_attr(r: Reader, at: int) -> Tuple[str, Any]:
     name = r.field_string(at, 0) or ""
     tag = r.field_scalar(at, 1, "<B", 0)
@@ -172,8 +160,8 @@ def _parse_members(model: bytes, params: bytes, meta: str,
             id=r.field_scalar(t, 0, "<i", 0),
             name=r.field_string(t, 1) or "",
             type=r.field_string(t, 2) or "",
-            inputs=_read_strvec(r, t, 3),
-            outputs=_read_strvec(r, t, 4),
+            inputs=r.field_vec_strings(t, 3),
+            outputs=r.field_vec_strings(t, 4),
             attrs=dict(_read_attr(r, at)
                        for at in r.field_vec_tables(t, 5))))
     net_attrs = dict(_read_attr(r, at)

@@ -118,6 +118,18 @@ class Reader:
             return []
         return [self.indirect(base + 4 * i) for i in range(n)]
 
+    def field_vec_strings(self, table: int, fid: int) -> List[str]:
+        base, n = self._vec(table, fid)
+        if base is None:
+            return []
+        out = []
+        for i in range(n):
+            spos = self.indirect(base + 4 * i)
+            ln = self.u32(spos)
+            out.append(bytes(self.buf[spos + 4:spos + 4 + ln])
+                       .decode("utf-8"))
+        return out
+
     def field_vec_len(self, table: int, fid: int) -> int:
         _, n = self._vec(table, fid)
         return n

@@ -130,6 +130,16 @@ def test_dlc_parser_contract(tmp_path):
         ".dlc")
 
 
+@pytest.mark.skipif(not os.path.exists(MODELS),
+                    reason="reference models absent")
+def test_rtm_parser_contract(tmp_path):
+    from nnstreamer_tpu.modelio.rtm import parse_rtm
+
+    _file_parser_contract(
+        parse_rtm, os.path.join(MODELS, "mobilenet_v1_0.25_224.rtm"),
+        8, tmp_path, ".rtm")
+
+
 def test_torchscript_loader_contract(tmp_path):
     from nnstreamer_tpu.modelio.torchscript import load_torchscript
 

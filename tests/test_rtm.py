@@ -1,0 +1,164 @@
+"""DeepViewRT `.rtm` ingestion goldens.
+
+Uses the reference's own checked-in `mobilenet_v1_0.25_224.rtm` (full
+fp32 weights inside the RTMx flatbuffer) and the reference's own test
+expectation (`tests/nnstreamer_filter_deepview_rt/runTest.sh:67-75`):
+orange.png, normalize x/127.5 - 1, image_labeling → "orange"."""
+
+import os
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.modelio import load_model_file
+from nnstreamer_tpu.modelio.rtm import lower_rtm, parse_rtm
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+MODELS = "/root/reference/tests/test_models/models"
+RTM = os.path.join(MODELS, "mobilenet_v1_0.25_224.rtm")
+ORANGE = "/root/reference/tests/test_models/data/orange.png"
+LABELS = "/root/reference/tests/test_models/labels/labels.txt"
+
+needs_models = pytest.mark.skipif(
+    not (os.path.exists(RTM) and os.path.exists(ORANGE)),
+    reason="reference test models absent")
+
+
+def _orange_rgb() -> np.ndarray:
+    from PIL import Image
+
+    return np.asarray(Image.open(ORANGE).convert("RGB"), np.uint8)
+
+
+@needs_models
+def test_parse_rtm_structure():
+    g = parse_rtm(RTM)
+    assert "DeepViewRT" in g.creator
+    types = [lay.type_name for lay in g.layers]
+    assert types.count("Const") == 56          # 28 weights + 28 biases
+    assert types.count("Conv2D") == 28         # 27 body + logits
+    assert types.count("Input") == 1
+    assert "Softmax" in types and "Pool" in types
+    # depthwise layers carry their real group count
+    dw = next(lay for lay in g.layers
+              if lay.name.endswith("Conv2d_1_depthwise/Relu6"))
+    assert dw.attrs["groups"] == [8]
+    w = next(lay for lay in g.layers
+             if lay.name.endswith("Conv2d_1_depthwise/depthwise_weights"))
+    assert w.tensor.shape == (3, 3, 8, 1)      # HWCM
+    inp = next(lay for lay in g.layers if lay.type_name == "Input")
+    assert inp.shape == (1, 224, 224, 3)
+
+
+@needs_models
+def test_rtm_classifies_orange():
+    """The reference suite's golden: orange.png → 'orange' (951)."""
+    import jax
+
+    b = load_model_file(RTM)
+    assert b.in_spec.tensors[0].shape == (1, 224, 224, 3)
+    assert b.out_spec.tensors[0].shape == (1, 1001)
+    x = (_orange_rgb().astype(np.float32) / 127.5 - 1.0) \
+        .reshape(1, 224, 224, 3)
+    y = np.asarray(jax.jit(b.fn)(b.params, x)[0])
+    assert int(y.argmax()) == 951              # 'orange'
+    assert float(y.max()) > 0.5                # softmax, decisive
+    np.testing.assert_allclose(y.sum(), 1.0, atol=1e-4)
+
+
+@needs_models
+def test_rtm_full_pipeline_reference_transform():
+    """End-to-end with the reference runTest.sh's exact transform
+    option (typecast:float32,div:127.5,add:-1.0) and labels file."""
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=3:224:224:1 types=uint8 ! "
+        f"tensor_transform mode=arithmetic "
+        f"option=typecast:float32,div:127.5,add:-1.0 ! "
+        f"tensor_filter model={RTM} ! "
+        f"tensor_decoder mode=image_labeling option1={LABELS} ! "
+        f"tensor_sink name=out")
+    runner = nns.PipelineRunner(pipe).start()
+    pipe.get("src").push(
+        TensorBuffer.of(_orange_rgb().reshape(1, 224, 224, 3)))
+    pipe.get("src").end()
+    runner.wait(300)
+    runner.stop()
+    res = pipe.get("out").results
+    assert len(res) == 1
+    assert res[0].meta["label"] == "orange"
+
+
+def test_rtm_padded_avg_pool_excludes_padding():
+    """SAME-padded average pooling must divide each window by its
+    VALID element count (TF semantics), not the full kernel size."""
+    import jax
+
+    from nnstreamer_tpu.modelio.rtm import RTMGraph, RTMLayer
+
+    g = RTMGraph(creator="test", layers=[
+        RTMLayer(index=0, name="input", type=0x01, inputs=[],
+                 shape=(1, 4, 4, 1)),
+        RTMLayer(index=1, name="net/AvgPool", type=0x3D, inputs=[0],
+                 shape=(1, 4, 4, 1),
+                 attrs={"ksize": [1, 3, 3, 1],
+                        "strides": [1, 1, 1, 1],
+                        "head": [0, 1, 1, 0], "tail": [0, 1, 1, 0]}),
+    ])
+    m = lower_rtm(g)
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    y = np.asarray(jax.jit(m.fn)(m.params, x)[0])
+    # manual reference: mean over valid cells only
+    xp = np.pad(x[0, :, :, 0], 1, constant_values=np.nan)
+    want = np.empty((4, 4), np.float32)
+    for i in range(4):
+        for j in range(4):
+            w = xp[i:i + 3, j:j + 3]
+            want[i, j] = np.nanmean(w)
+    np.testing.assert_allclose(y[0, :, :, 0], want, rtol=1e-6)
+
+
+@needs_models
+def test_rtm_batch_override_runs():
+    """batch= rewrites the input batch; the batch-1 Reshape attr must
+    follow the traced batch instead of crashing."""
+    import jax
+
+    b = load_model_file(RTM, batch=2)
+    assert b.in_spec.tensors[0].shape == (2, 224, 224, 3)
+    assert b.out_spec.tensors[0].shape == (2, 1001)
+    x = (np.stack([_orange_rgb()] * 2).astype(np.float32) / 127.5
+         - 1.0)
+    y = np.asarray(jax.jit(b.fn)(b.params, x)[0])
+    assert list(y.argmax(-1)) == [951, 951]
+
+
+@needs_models
+def test_rtm_unknown_layer_fails_loud():
+    g = parse_rtm(RTM)
+    g.layers[-1].type = 0x7777
+    with pytest.raises(BackendError, match="type_0x7777"):
+        lower_rtm(g)
+
+
+@needs_models
+def test_rtm_const_without_data_fails_loud():
+    g = parse_rtm(RTM)
+    const = next(lay for lay in g.layers if lay.type_name == "Const")
+    const.tensor = None
+    with pytest.raises(BackendError, match="no data"):
+        lower_rtm(g)
+
+
+@needs_models
+def test_rtm_rejects_compute_dtype():
+    with pytest.raises(BackendError, match="dtype"):
+        load_model_file(RTM, compute_dtype="bfloat16")
+
+
+def test_rtm_not_a_model_fails_loud(tmp_path):
+    p = tmp_path / "junk.rtm"
+    p.write_bytes(b"\x00\x01nope")
+    with pytest.raises(BackendError, match="RTMx"):
+        parse_rtm(str(p))
