@@ -164,6 +164,23 @@ def test_budget_exhaustion_skips_tail_loudly():
     assert final["families"].get("fast_a") == {"v": 1}
 
 
+def test_implausibly_slow_cfg_retried_with_both_results_shipped(
+        tmp_path):
+    """A BASELINE-table config under the 30 FPS target (tunnel
+    pathology) is retried once; the artifact carries BOTH results."""
+    state = tmp_path / "flaky_count"
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        env=_env(BENCH_BUDGET_S=60, BENCH_FAMILY_TIMEOUT_S=30,
+                 BENCH_SELFTEST_HANG_S=0, BENCH_SELFTEST_STEP_S=0.01,
+                 BENCH_SELFTEST_STATE=state),
+        timeout=120)
+    final = _snapshots(proc.stdout)[-1]
+    flaky = final["families"]["cfg_flaky"]
+    assert flaky["fps"] == 100.0
+    assert flaky["slow_first_attempt"]["fps"] == 5.0
+
+
 def test_sigkill_mid_run_leaves_parseable_snapshot():
     """SIGKILL (untrappable — the driver's last resort) at an arbitrary
     point: the last fully-printed snapshot line still carries every
