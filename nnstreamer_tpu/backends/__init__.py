@@ -9,6 +9,9 @@ zoo with three first-class backends:
 - ``custom``  — in-process python callables (the custom-easy analog,
                 include/tensor_filter_custom_easy.h)
 - ``pallas``  — hand-written TPU kernels registered as filters
+- ``python3`` — reference-contract script files (CustomFilter class,
+                tensor_filter_python3.cc analog — runs the reference's
+                own passthrough.py/scaler.py unmodified)
 
 Importing this package registers all built-in backends.
 """
@@ -17,12 +20,14 @@ from nnstreamer_tpu.backends.base import FilterBackend
 from nnstreamer_tpu.backends.custom import CustomBackend, register_custom_easy
 from nnstreamer_tpu.backends.pallas_backend import (
     PallasBackend, register_pallas_filter)
+from nnstreamer_tpu.backends.python3_script import Python3ScriptBackend
 from nnstreamer_tpu.backends.xla import XLABackend
 
 __all__ = [
     "FilterBackend",
     "CustomBackend",
     "PallasBackend",
+    "Python3ScriptBackend",
     "XLABackend",
     "register_custom_easy",
     "register_pallas_filter",

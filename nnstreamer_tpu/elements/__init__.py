@@ -54,6 +54,8 @@ from nnstreamer_tpu.elements.ipc import IpcSink, IpcSrc
 from nnstreamer_tpu.elements.repo import REPO, TensorRepoSink, TensorRepoSrc
 from nnstreamer_tpu.elements.routing import (
     Join, Queue, Tee, TensorDemux, TensorMerge, TensorMux, TensorSplit)
+import nnstreamer_tpu.elements.script_codec  # noqa: F401 (registers
+                                             # the python3 decoder)
 from nnstreamer_tpu.elements.sinks import FakeSink, FileSink, TensorSink
 from nnstreamer_tpu.elements.sources import AppSrc, TensorSrc, VideoTestSrc
 from nnstreamer_tpu.elements.sparse_elements import (

@@ -34,7 +34,8 @@ class TensorConverter(Element):
         "frames_per_tensor": PropDef(int, 1, "batch N media frames per tensor"),
         "input_dim": PropDef(str, "", "required for octet/text input"),
         "input_type": PropDef(str, "", "required for octet input"),
-        "mode": PropDef(str, "", "custom converter subplugin: custom:<name>"),
+        "mode": PropDef(str, "", "custom converter: custom:<name> or "
+                                 "custom-script:<script.py>"),
     }
 
     def __init__(self, name=None, **props):
@@ -52,9 +53,18 @@ class TensorConverter(Element):
         mode = self.props["mode"]
         if mode:
             kind, _, sub = mode.partition(":")
+            if kind == "custom-script" and sub:
+                # reference python3 converter scripts, run unmodified
+                # (tensor_converter_python3.cc contract)
+                from nnstreamer_tpu.elements.script_codec import (
+                    make_script_converter)
+
+                self._subplugin = make_script_converter(sub)
+                return [self._subplugin.negotiate(spec)]
             if kind != "custom" or not sub:
                 self.fail_negotiation(
-                    f"mode must be custom:<subplugin name>, got {mode!r}"
+                    f"mode must be custom:<subplugin name> or "
+                    f"custom-script:<script.py>, got {mode!r}"
                 )
             self._subplugin = registry.get(PluginKind.CONVERTER, sub)()
             return [self._subplugin.negotiate(spec)]

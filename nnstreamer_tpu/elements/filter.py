@@ -154,11 +154,24 @@ class TensorFilter(Element):
             self._host_aux_cache = aux
         return self._host_aux_cache
 
+    #: reference framework names → our backends, so reference pipeline
+    #: strings run verbatim (`framework=snpe model=add2_float.dlc`,
+    #: `framework=deepview-rt model=....rtm`, runTest.sh recipes). The
+    #: vendor zoo collapses into the xla backend's modelio ingestion
+    #: (PARITY §2.3); scripted filters map onto their analogs.
+    _FRAMEWORK_ALIASES = {
+        "tensorflow-lite": "xla", "tensorflow1-lite": "xla",
+        "tensorflow2-lite": "xla", "tensorflow": "xla",
+        "pytorch": "xla", "caffe2": "xla", "snpe": "xla",
+        "deepview-rt": "xla", "tensorrt": "xla", "armnn": "xla",
+        "custom-easy": "custom",
+    }
+
     # -- negotiation / backend open ---------------------------------------
     def _framework_name(self) -> str:
         fw = self.props["framework"]
         if fw:
-            return fw
+            return self._FRAMEWORK_ALIASES.get(fw, fw)
         model = self.props["model"]
         cfg = get_config()
         if isinstance(model, str):

@@ -1,0 +1,235 @@
+"""python3 scripted-filter backend: runs the reference's own scripts.
+
+The reference embeds CPython (`tensor_filter_python3.cc`) and ships
+test scripts under `tests/test_models/models/`; these tests execute
+those unmodified scripts through `framework=python3` with the
+reference runTest.sh semantics (passthrough byte-identity; scaler
+nearest-neighbor checked against an independent numpy port of
+`checkScaledTensor.py`)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+MODELS = "/root/reference/tests/test_models/models"
+PASSTHROUGH = os.path.join(MODELS, "passthrough.py")
+SCALER = os.path.join(MODELS, "scaler.py")
+
+needs_models = pytest.mark.skipif(
+    not (os.path.exists(PASSTHROUGH) and os.path.exists(SCALER)),
+    reason="reference test scripts absent")
+
+
+def _run_pipeline(launch, frame):
+    pipe = nns.parse_launch(launch)
+    runner = nns.PipelineRunner(pipe).start()
+    pipe.get("src").push(TensorBuffer.of(frame))
+    pipe.get("src").end()
+    runner.wait(120)
+    runner.stop()
+    return pipe.get("out").results
+
+
+@needs_models
+def test_reference_passthrough_script_byte_identity():
+    """runTest.sh testcase 1: passthrough.py declares 3:280:40:1 uint8
+    static dims; output bytes == input bytes."""
+    frame = np.random.default_rng(0).integers(
+        0, 256, (1, 40, 280, 3), np.uint8)
+    res = _run_pipeline(
+        f"appsrc name=src dims=3:280:40:1 types=uint8 ! "
+        f"tensor_filter framework=python3 model={PASSTHROUGH} ! "
+        f"tensor_sink name=out", frame)
+    assert len(res) == 1
+    np.testing.assert_array_equal(np.asarray(res[0].tensors[0]), frame)
+
+
+def _nn_scale(img, out_w, out_h):
+    """Independent nearest-neighbor port of checkScaledTensor.py."""
+    _, in_h, in_w, ch = img.shape
+    out = np.empty((1, out_h, out_w, ch), img.dtype)
+    for y in range(out_h):
+        for x in range(out_w):
+            out[0, y, x] = img[0, int(y * in_h / out_h),
+                               int(x * in_w / out_w)]
+    return out
+
+
+@needs_models
+@pytest.mark.parametrize("out_w,out_h", [(32, 24), (128, 96)])
+def test_reference_scaler_script_matches_independent_decode(out_w,
+                                                            out_h):
+    """runTest.sh testcases 2/3 (down- and up-scale), sized down for CI
+    speed — scaler.py adapts to any input via setInputDim."""
+    frame = np.random.default_rng(1).integers(
+        0, 256, (1, 48, 64, 3), np.uint8)
+    res = _run_pipeline(
+        f"appsrc name=src dims=3:64:48:1 types=uint8 ! "
+        f"tensor_filter framework=python3 model={SCALER} "
+        f"custom={out_w}x{out_h} ! tensor_sink name=out", frame)
+    assert len(res) == 1
+    got = np.asarray(res[0].tensors[0])
+    assert got.shape == (1, out_h, out_w, 3)
+    np.testing.assert_array_equal(got, _nn_scale(frame, out_w, out_h))
+
+
+@needs_models
+def test_vendor_framework_aliases_run_reference_recipes():
+    """Reference pipeline strings with explicit vendor framework names
+    run verbatim: the zoo collapses into the xla backend's ingestion."""
+    res = _run_pipeline(
+        f"appsrc name=src dims=1 types=float32 ! "
+        f"tensor_filter framework=snpe "
+        f"model={MODELS}/add2_float.dlc ! tensor_sink name=out",
+        np.asarray([40.0], np.float32))
+    assert float(np.asarray(res[0].tensors[0])[0]) == 42.0
+
+    res = _run_pipeline(
+        f"appsrc name=src dims=1:28:28:1 types=uint8 ! "
+        f"tensor_filter framework=pytorch "
+        f"model={MODELS}/pytorch_lenet5.pt ! tensor_sink name=out",
+        np.fromfile("/root/reference/tests/test_models/data/9.raw",
+                    np.uint8).reshape(1, 28, 28, 1))
+    assert int(np.asarray(res[0].tensors[0]).argmax()) == 9
+
+
+CONVERTER_SCRIPT = os.path.join(MODELS, "custom_converter.py")
+DECODER_SCRIPT = os.path.join(MODELS, "custom_decoder.py")
+
+needs_codec_scripts = pytest.mark.skipif(
+    not (os.path.exists(CONVERTER_SCRIPT)
+         and os.path.exists(DECODER_SCRIPT)),
+    reason="reference codec scripts absent")
+
+
+@needs_codec_scripts
+def test_reference_codec_scripts_roundtrip():
+    """decoder_python3/converter_python3 runTest semantics: tensors →
+    CustomDecoder (flexbuf bytes) → CustomConverter → original tensors,
+    both the reference's unmodified scripts."""
+    frame = np.random.default_rng(2).integers(
+        0, 256, (1, 4, 6, 3), np.uint8)
+    res = _run_pipeline(
+        f"appsrc name=src dims=3:6:4:1 types=uint8 ! "
+        f"tensor_decoder mode=python3 option1={DECODER_SCRIPT} ! "
+        f"tensor_converter mode=custom-script:{CONVERTER_SCRIPT} ! "
+        f"tensor_sink name=out", frame)
+    assert len(res) == 1
+    got = np.asarray(res[0].tensors[0])
+    np.testing.assert_array_equal(got.reshape(frame.shape), frame)
+
+
+@needs_codec_scripts
+def test_script_decoder_interops_with_native_flexbuf_converter():
+    """The script decoder's wire bytes parse with THIS repo's flexbuf
+    converter, and vice versa — same flexbuffers schema."""
+    frame = np.random.default_rng(3).integers(
+        0, 256, (1, 4, 6, 3), np.uint8)
+    res = _run_pipeline(
+        f"appsrc name=src dims=3:6:4:1 types=uint8 ! "
+        f"tensor_decoder mode=python3 option1={DECODER_SCRIPT} ! "
+        f"tensor_converter mode=custom:flexbuf ! tensor_sink name=out",
+        frame)
+    got = np.asarray(res[0].tensors[0])
+    np.testing.assert_array_equal(got.reshape(frame.shape), frame)
+
+    res = _run_pipeline(
+        f"appsrc name=src dims=3:6:4:1 types=uint8 ! "
+        f"tensor_decoder mode=flexbuf ! "
+        f"tensor_converter mode=custom-script:{CONVERTER_SCRIPT} ! "
+        f"tensor_sink name=out", frame)
+    got = np.asarray(res[0].tensors[0])
+    np.testing.assert_array_equal(got.reshape(frame.shape), frame)
+
+
+@needs_codec_scripts
+def test_reference_invalid_class_script_fails_loud():
+    """The reference's own negative fixture: a converter script whose
+    class has the wrong name must fail at negotiation, loudly."""
+    invalid = os.path.join(MODELS, "invalid_class_custom_converter.py")
+    if not os.path.exists(invalid):
+        pytest.skip("invalid-class fixture absent")
+    from nnstreamer_tpu.core.errors import PipelineError
+
+    with pytest.raises((BackendError, PipelineError),
+                       match="CustomConverter"):
+        _run_pipeline(
+            f"appsrc name=src dims=4 types=uint8 ! "
+            f"tensor_converter mode=custom-script:{invalid} ! "
+            f"tensor_sink name=out",
+            np.zeros(4, np.uint8))
+
+
+@needs_models
+def test_python3_reload_preserves_custom_args_and_negotiation():
+    """Hot-swap (is-updatable analog): reload must carry custom= args
+    and re-drive setInputDim so an adaptive script keeps working."""
+    from nnstreamer_tpu.backends.python3_script import (
+        Python3ScriptBackend)
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    b = Python3ScriptBackend()
+    b.open({"model": SCALER, "custom": "8x6"})
+    spec = TensorsSpec.of(TensorInfo((1, 12, 16, 3), DType.UINT8))
+    out = b.set_input_info(spec)
+    assert out.tensors[0].shape == (1, 6, 8, 3)
+    x = np.random.default_rng(4).integers(0, 256, (1, 12, 16, 3),
+                                          np.uint8)
+    y1 = b.invoke((x,))[0]
+    b.reload(SCALER)
+    y2 = b.invoke((x,))[0]
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_converter_script_may_return_bytes_raw_data(tmp_path):
+    """raw_data entries may be bytes (the wire blob IS bytes) — the
+    natural thing for a script author to return."""
+    script = tmp_path / "bytes_conv.py"
+    script.write_text(
+        "import numpy as np\n"
+        "import nnstreamer_python as nns\n"
+        "class CustomConverter(object):\n"
+        "    def convert(self, input_array):\n"
+        "        data = input_array[0].tobytes()\n"
+        "        info = [nns.TensorShape([len(data), 1, 1, 1],"
+        " np.uint8)]\n"
+        "        return info, [data], 30, 1\n")
+    import nnstreamer_tpu as nns_pkg  # noqa: F401
+
+    from nnstreamer_tpu.elements.script_codec import Python3Converter
+    from nnstreamer_tpu.tensor.info import TensorFormat
+
+    conv = Python3Converter(str(script))
+    frame = np.arange(12, dtype=np.uint8)
+    out = conv.convert(TensorBuffer.of(frame))
+    got = np.asarray(out.tensors[0])
+    assert got.shape == (1, 1, 1, 12)      # reference 4-dim wire
+    np.testing.assert_array_equal(got.ravel(), frame)
+    assert out.format == TensorFormat.FLEXIBLE
+    assert out.meta["rate"] == (30, 1)
+
+
+def test_python3_script_without_customfilter_fails_loud(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text("x = 1\n")
+    from nnstreamer_tpu.backends.python3_script import (
+        Python3ScriptBackend)
+
+    b = Python3ScriptBackend()
+    with pytest.raises(BackendError, match="CustomFilter"):
+        b.open({"model": str(p)})
+
+
+def test_python3_non_script_fails_loud():
+    from nnstreamer_tpu.backends.python3_script import (
+        Python3ScriptBackend)
+
+    b = Python3ScriptBackend()
+    with pytest.raises(BackendError, match="\\.py"):
+        b.open({"model": "model.tflite"})
