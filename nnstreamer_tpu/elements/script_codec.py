@@ -32,8 +32,7 @@ from nnstreamer_tpu.elements.converter import ConverterSubplugin
 from nnstreamer_tpu.elements.decoder import DecoderSubplugin, register_decoder
 from nnstreamer_tpu.graph.media import MediaSpec, OctetSpec
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
-from nnstreamer_tpu.tensor.dtypes import DType
-from nnstreamer_tpu.tensor.info import TensorFormat, TensorInfo, TensorsSpec
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
 
 
 def _rate_pair(rate: Optional[Fraction]):

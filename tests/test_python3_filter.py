@@ -147,6 +147,32 @@ def test_script_decoder_interops_with_native_flexbuf_converter():
     np.testing.assert_array_equal(got.reshape(frame.shape), frame)
 
 
+def test_reference_json_converter_script_two_tensors():
+    """custom_converter_json.py (reference fixture): a JSON frame
+    becomes two uint8 text tensors — multi-tensor scripted convert."""
+    import json as jsonlib
+
+    script = os.path.join(MODELS, "custom_converter_json.py")
+    if not os.path.exists(script):
+        pytest.skip("json converter fixture absent")
+    payload = jsonlib.dumps({
+        "json_string": "string_example", "json_number": 100,
+        "json_array": [1, 2, 3, 4, 5],
+        "json_object": {"name": "John", "age": 30},
+        "json_bool": True}).encode()
+    frame = np.frombuffer(payload, np.uint8)
+    res = _run_pipeline(
+        f"appsrc name=src dims={len(payload)} types=uint8 ! "
+        f"tensor_converter mode=custom-script:{script} ! "
+        f"tensor_sink name=out", frame)
+    assert len(res) == 1
+    t0, t1 = res[0].tensors
+    assert bytes(np.asarray(t0).ravel()) == b"string_example\0"
+    assert jsonlib.loads(bytes(np.asarray(t1).ravel())) == {
+        "name": "John", "age": 30}
+    assert res[0].meta["rate"] == (10, 1)
+
+
 @needs_codec_scripts
 def test_reference_invalid_class_script_fails_loud():
     """The reference's own negative fixture: a converter script whose
