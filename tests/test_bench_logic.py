@@ -96,8 +96,39 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "bench.py")
 
 
+def _blank_sitecustomize_dir():
+    """A dir whose empty sitecustomize.py shadows any site-wide one.
+
+    Dev-chip tunnels install a sitecustomize that imports jax on EVERY
+    python startup (~2.4s measured) — longer than the selftest's
+    per-family timeouts, so the stdlib-only fake families would be
+    killed mid-import. PYTHONPATH entries precede site-packages, so an
+    empty shadow restores interpreter startup to milliseconds and makes
+    these timing contracts machine-independent. Lazy (first _env call,
+    not collection) and removed at interpreter exit.
+    """
+    global _SITE_DIR
+    if _SITE_DIR is None:
+        import atexit
+        import shutil
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="bench_selftest_site_")
+        with open(os.path.join(d, "sitecustomize.py"), "w") as f:
+            f.write("")
+        atexit.register(shutil.rmtree, d, ignore_errors=True)
+        _SITE_DIR = d
+    return _SITE_DIR
+
+
+_SITE_DIR = None
+
+
 def _env(**over):
     e = dict(os.environ, BENCH_SELFTEST="fake")
+    pp = e.get("PYTHONPATH", "")
+    e["PYTHONPATH"] = _blank_sitecustomize_dir() + (
+        os.pathsep + pp if pp else "")
     e.update({k: str(v) for k, v in over.items()})
     return e
 
