@@ -742,10 +742,16 @@ def batch_sweep(batches=None):
 
 def int8_native_check():
     """The int8-native quantized execution path (tflite_quant.py):
-    TPU-vs-CPU agreement (guards the backend's integer conv numerics)
-    plus its pure-compute step time. On this backend int8 NHWC convs
-    are ~5× slower than bf16 (bf16 runs at the HBM roofline), so this
-    is reported as a verified feature, not the perf path."""
+    TPU agreement against the TFLite interpreter (the authoritative
+    int8 semantics for this model file) plus its pure-compute step
+    time. The agreement oracle is the interpreter, not an XLA:CPU
+    recompile of the same program: the int8-conv CPU compile takes
+    ~10 min of host CPU (measured) while interpreter invokes take
+    milliseconds — and a shared-program oracle can't catch a lowering
+    bug the way an independent implementation can. Perf context: int8
+    NHWC convs run ~11× slower than the dequantized bf16 path at the
+    same batch (7.2 vs 0.67 ms/step at b=32, measured round 5), so
+    int8-native stays a verified feature, not the perf path."""
     import jax
     import numpy as np
 
@@ -756,12 +762,20 @@ def int8_native_check():
     b = 32
     bundle = load_model_file(MOBILENET_TFLITE, batch=b,
                              compute_dtype="int8")
-    x = np.random.default_rng(7).integers(
-        0, 256, (b, 224, 224, 3), np.uint8)
+    # structured frames (gradient + block + mild noise), not pure noise:
+    # noise gives near-uniform logits whose argmax flips on ±1 quantized
+    # steps, which would misread rounding-mode skew as model error
+    rng = np.random.default_rng(7)
+    x = np.zeros((b, 224, 224, 3), np.int16)
+    x[..., 0] = np.linspace(0, 255, 224, dtype=np.int16)[None, None, :]
+    for i in range(b):
+        x[i, :, :, 1] = rng.integers(0, 256)
+        bx, by = rng.integers(0, 224 - 64 + 1, 2)
+        x[i, by:by + 64, bx:bx + 64, 2] = 255
+    x = np.clip(x + rng.integers(0, 30, x.shape), 0, 255).astype(np.uint8)
     fn = jax.jit(bundle.fn)
-    # the int8-conv compiles dominate this family's runtime (it is the
-    # budget-clamped tail family) — stream each milestone so a timeout
-    # still ships whatever completed
+    # stream each milestone so a family timeout still ships whatever
+    # completed (this is the budget-clamped tail family)
     got = np.asarray(fn(bundle.params, x)[0])     # TPU compile + run
     out = {}
     params = jax.device_put(bundle.params)
@@ -769,10 +783,21 @@ def int8_native_check():
     ms = _step_ms(fn, params, xd, n1=10, n2=40)
     out.update(ms_b32=round(ms, 3), fps_b32=round(b / ms * 1e3, 1))
     _family_partial(out)
-    cpu = jax.devices("cpu")[0]
-    with jax.default_device(cpu):
-        ref = np.asarray(jax.jit(bundle.fn)(bundle.params, x)[0])
-    out["tpu_vs_cpu_top1"] = round(float(
+    try:
+        import tensorflow as tf
+    except ImportError:
+        out["oracle"] = "tensorflow absent; agreement covered in CI"
+        return out
+    interp = tf.lite.Interpreter(MOBILENET_TFLITE)
+    interp.allocate_tensors()
+    inp = interp.get_input_details()[0]
+    outd = interp.get_output_details()[0]
+    ref = np.empty_like(got)
+    for i in range(b):
+        interp.set_tensor(inp["index"], x[i:i + 1])
+        interp.invoke()
+        ref[i] = interp.get_tensor(outd["index"])[0]
+    out["tpu_vs_tflite_top1"] = round(float(
         (got.argmax(-1) == ref.argmax(-1)).mean()), 3)
     out["max_qdiff"] = int(np.abs(got.astype(np.int32)
                                   - ref.astype(np.int32)).max())
@@ -1142,7 +1167,35 @@ def _run_family_subprocess(name: str, errors: dict, timeout_s: float,
     return partial or {}
 
 
+def _enable_compile_cache() -> None:
+    """Point jax at a persistent on-disk compilation cache.
+
+    Compile time is pure overhead against the bench budget — every
+    measured number is post-warmup steady state — so caching compiled
+    executables across family subprocesses (and across whole runs on
+    the same host) is free honesty: it converts ~minutes of repeated
+    XLA compilation (the int8-conv family alone compiles ~220-270s)
+    into cache hits, letting the full family set fit the 1500s budget.
+    Opt out with BENCH_XLA_CACHE=0; relocate with BENCH_XLA_CACHE_DIR.
+    """
+    if os.environ.get("BENCH_XLA_CACHE", "1") == "0":
+        return
+    cache_dir = os.environ.get(
+        "BENCH_XLA_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "nnstpu_xla"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:
+        pass                     # cache is an optimization, never a gate
+
+
 def _family_main(name: str) -> int:
+    _enable_compile_cache()
     try:
         result = _FAMILIES[name]()
         print(_FAMILY_SENTINEL + json.dumps({"result": result}),
