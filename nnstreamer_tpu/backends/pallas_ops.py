@@ -242,21 +242,27 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = 0, block_k: int = 0):
     """Fused attention for (B, S, H, D) tensors — the transformer hot op
     as a Pallas kernel (flash-attention online softmax; S×S scores never
-    leave VMEM). Block sizes auto-tune to the largest dividing powers of
-    two ≤ (512, 1024) — driver-measured (BENCH_r04.json, quiet chip)
-    ~30% MFU / ~7× XLA's fused attention at S=2048 causal on v5e,
-    ~49% MFU / ~158× at S=8192, and ~4× the stock
-    jax.experimental.pallas TPU kernel (whose defaults undersize the
-    MXU work per step). Requires S % block == 0 (pad upstream); falls
-    back to interpret mode off-TPU like every kernel here.
+    leave VMEM). Block sizes auto-tune per path to the largest dividing
+    powers of two ≤ (512, 512) VMEM-resident / (1024, 1024) K-grid —
+    round-5 sweep on the quiet chip: at S=2048 bk=512 beats the old
+    bk=1024 default 0.61 vs 0.73 ms causal (28.8% vs 23.8% MFU) and
+    0.64 vs 0.79 ms non-causal (54.9% vs 44.4%), the smaller K block
+    wasting fewer masked FLOPs on diagonal blocks; the streaming K-grid
+    runs fewer, larger steps best (S=32768: 30.4 ms/36.8% MFU at
+    1024² vs 34.8/32.1 at the old default; 1024×2048 exceeds the 16M
+    VMEM scoped limit). S=8192 is insensitive (±1.4%). Requires
+    S % block == 0 (pad upstream); falls back to interpret mode off-TPU
+    like every kernel here.
 
     Long sequences: when a head's full K+V would exceed the VMEM budget
     (S ≳ 16k at D=128), the kernel switches to a K-blocked grid that
     streams K/V through VMEM with scratch-carried online-softmax state —
     per-step VMEM is independent of S, so S=64k+ compiles and runs."""
     b, s, h, d = q.shape
-    bq = block_q or _auto_block(s, 512)
-    bk = block_k or _auto_block(s, 1024)
+    kgrid = 2 * s * d * q.dtype.itemsize > _FLASH_VMEM_KV_BYTES
+    want_q, want_k = (1024, 1024) if kgrid else (512, 512)
+    bq = block_q or _auto_block(s, want_q)
+    bk = block_k or _auto_block(s, want_k)
     bq, bk = min(bq, s), min(bk, s)
     if s % bq or s % bk:
         raise ValueError(
@@ -268,8 +274,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
         return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
     qf, kf, vf = bhsd(q), bhsd(k), bhsd(v)
-    kv_bytes = 2 * s * d * q.dtype.itemsize
-    if kv_bytes > _FLASH_VMEM_KV_BYTES:
+    if kgrid:
         out = _flash_attention_kgrid(qf, kf, vf, scale=scale,
                                      causal=causal, bq=bq, bk=bk,
                                      interpret=_interpret())
