@@ -760,19 +760,14 @@ def int8_native_check():
     if not os.path.exists(MOBILENET_TFLITE):
         return {}
     b = 32
+    from nnstreamer_tpu.core.fixtures import synthetic_frames
+
     bundle = load_model_file(MOBILENET_TFLITE, batch=b,
                              compute_dtype="int8")
-    # structured frames (gradient + block + mild noise), not pure noise:
-    # noise gives near-uniform logits whose argmax flips on ±1 quantized
-    # steps, which would misread rounding-mode skew as model error
-    rng = np.random.default_rng(7)
-    x = np.zeros((b, 224, 224, 3), np.int16)
-    x[..., 0] = np.linspace(0, 255, 224, dtype=np.int16)[None, None, :]
-    for i in range(b):
-        x[i, :, :, 1] = rng.integers(0, 256)
-        bx, by = rng.integers(0, 224 - 64 + 1, 2)
-        x[i, by:by + 64, bx:bx + 64, 2] = 255
-    x = np.clip(x + rng.integers(0, 30, x.shape), 0, 255).astype(np.uint8)
+    # structured frames (peaked logits), not pure noise — noise gives
+    # near-uniform logits whose argmax flips on ±1 quantized steps,
+    # misreading rounding-mode skew as model error (fixtures docstring)
+    x = synthetic_frames(b, seed=7)
     fn = jax.jit(bundle.fn)
     # stream each milestone so a family timeout still ships whatever
     # completed (this is the budget-clamped tail family)

@@ -40,17 +40,13 @@ def _tflite_interpreter(path):
 
 
 def _synthetic_images(n, seed=42):
-    """Deterministic structured images (gradients + blocks, not pure
-    noise, so the classifier logits are peaked and argmax is stable)."""
-    rng = np.random.RandomState(seed)
-    for _ in range(n):
-        x = np.zeros((1, 224, 224, 3), np.uint8)
-        x[0, :, :, 0] = np.linspace(0, 255, 224, dtype=np.uint8)[None, :]
-        x[0, :, :, 1] = rng.randint(0, 256)
-        bx, by = rng.randint(0, 180, 2)
-        x[0, by:by + 64, bx:bx + 64, 2] = 255
-        x += rng.randint(0, 30, x.shape).astype(np.uint8)
-        yield x
+    """Deterministic structured images with peaked logits — the shared
+    generator (core.fixtures), yielded one (1, 224, 224, 3) frame at a
+    time to match the single-frame interpreter loops here."""
+    from nnstreamer_tpu.core.fixtures import synthetic_frames
+
+    for frame in synthetic_frames(n, seed=seed):
+        yield frame[None]
 
 
 # -- flatbuffer parsing ------------------------------------------------------
