@@ -238,6 +238,18 @@ def _auto_block(s: int, want: int) -> int:
     return b
 
 
+def _flash_plan(s: int, d: int, itemsize: int,
+                block_q: int = 0, block_k: int = 0):
+    """(kgrid?, bq, bk) for flash_attention — the per-path defaults the
+    round-5 quiet-chip sweep landed on (see flash_attention docstring);
+    pure so the choice is pinned by unit test."""
+    kgrid = 2 * s * d * itemsize > _FLASH_VMEM_KV_BYTES
+    want_q, want_k = (1024, 1024) if kgrid else (512, 512)
+    bq = min(block_q or _auto_block(s, want_q), s)
+    bk = min(block_k or _auto_block(s, want_k), s)
+    return kgrid, bq, bk
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = 0, block_k: int = 0):
     """Fused attention for (B, S, H, D) tensors — the transformer hot op
@@ -259,11 +271,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
     streams K/V through VMEM with scratch-carried online-softmax state —
     per-step VMEM is independent of S, so S=64k+ compiles and runs."""
     b, s, h, d = q.shape
-    kgrid = 2 * s * d * q.dtype.itemsize > _FLASH_VMEM_KV_BYTES
-    want_q, want_k = (1024, 1024) if kgrid else (512, 512)
-    bq = block_q or _auto_block(s, want_q)
-    bk = block_k or _auto_block(s, want_k)
-    bq, bk = min(bq, s), min(bk, s)
+    kgrid, bq, bk = _flash_plan(s, d, q.dtype.itemsize, block_q, block_k)
     if s % bq or s % bk:
         raise ValueError(
             f"flash_attention needs seq len {s} divisible by block sizes "

@@ -143,8 +143,9 @@ def apply_seq(params, ids, *, n_heads=4, dtype=jnp.float32,
         elif use_pallas:
             from nnstreamer_tpu.backends.pallas_ops import flash_attention
 
-            # auto block sizes (≤512/1024): the MXU needs big blocks —
-            # 128/128 here measured 12× slower than 512/1024 at S=2048
+            # per-path auto block sizes (512² resident / 1024² K-grid,
+            # see _flash_plan): the MXU needs big blocks — 128² here
+            # measured ~12× slower than the defaults at S=2048
             attn = flash_attention(q, k, v, causal=True)
         else:
             attn = reference_attention(q, k, v, causal=True)
@@ -187,17 +188,22 @@ def apply_step(params, ids, k_cache, v_cache, pos, *, n_heads=4,
     slot = p % max_len
     x = params["embed"][ids[:, 0]][:, None, :].astype(dtype)   # (B,1,D)
     pvec = p[None]
-    new_k, new_v = [], []
     for li, blk in enumerate(params["blocks"]):
         h = rmsnorm(x, blk["ln1"].astype(dtype))
         q, k, v = _qkv(blk, h, n_heads, dtype)
         q, k = rope(q, pvec), rope(k, pvec)
-        kc = jax.lax.dynamic_update_slice(
-            k_cache[li], k.astype(k_cache.dtype), (0, slot, 0, 0))
-        vc = jax.lax.dynamic_update_slice(
-            v_cache[li], v.astype(v_cache.dtype), (0, slot, 0, 0))
-        new_k.append(kc)
-        new_v.append(vc)
+        # write THROUGH the stacked cache (one dynamic_update_slice on
+        # the full (L,B,S,Hkv,D) array per tensor) — never unstack and
+        # restack: a per-layer k_cache[li] → update → jnp.stack(new_k)
+        # round-trip defeats XLA's in-place aliasing of the donated
+        # cache inside lax.scan/_step_jit and copies the whole cache
+        # every token (measured 2.6× slower at max_len=2048: 2.24 vs
+        # 0.86 ms/step, bit-identical outputs)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype)[None], (li, 0, slot, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype)[None], (li, 0, slot, 0, 0))
+        kc, vc = k_cache[li], v_cache[li]
         # attend over the populated window (all slots once wrapped)
         scale = q.shape[-1] ** -0.5
         # cache layout is (B, max_len, n_kv, D): expand KV groups to
@@ -217,8 +223,7 @@ def apply_step(params, ids, k_cache, v_cache, pos, *, n_heads=4,
         x = x + _mlp(blk, h, dtype)
     x = rmsnorm(x, params["ln_f"].astype(dtype))
     logits = (x[:, 0] @ params["head"].astype(dtype)).astype(jnp.float32)
-    return (logits, jnp.stack(new_k), jnp.stack(new_v),
-            (p + 1)[None].astype(jnp.int32))
+    return (logits, k_cache, v_cache, (p + 1)[None].astype(jnp.int32))
 
 
 #: one compiled decode step per (n_heads, dtype) — generate() calls

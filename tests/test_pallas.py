@@ -139,6 +139,30 @@ class TestFlashAttention:
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_flash_plan_block_defaults():
+    """Pin the per-path auto-block defaults the round-5 quiet-chip sweep
+    landed on (flash_attention docstring): 512^2 while K/V fit VMEM,
+    1024^2 on the K-blocked streaming grid, explicit blocks override,
+    and odd lengths fall to the largest dividing power of two."""
+    from nnstreamer_tpu.backends.pallas_ops import _flash_plan
+
+    # bf16 (itemsize 2), D=128: resident until 2*S*128*2 > 8MiB (S=16k)
+    assert _flash_plan(2048, 128, 2) == (False, 512, 512)
+    assert _flash_plan(8192, 128, 2) == (False, 512, 512)
+    assert _flash_plan(32768, 128, 2) == (True, 1024, 1024)
+    # explicit blocks override the per-path defaults on both paths
+    assert _flash_plan(2048, 128, 2, 256, 1024) == (False, 256, 1024)
+    assert _flash_plan(32768, 128, 2, 512, 512) == (True, 512, 512)
+    # non-power-of-two-divisible lengths shrink to a dividing block
+    assert _flash_plan(24576, 128, 2)[1:] == (1024, 1024)   # 24k % 1024 == 0
+    assert _flash_plan(1536, 128, 2)[1:] == (512, 512)
+    assert _flash_plan(640, 128, 2)[1:] == (128, 128)  # 640 = 5 * 128
+    assert _flash_plan(96, 128, 2)[1:] == (96, 96)     # S <= want: one block
+    # wider heads cross the VMEM budget earlier
+    assert _flash_plan(8192, 128, 4)[0] is False            # fp32, 8MiB
+    assert _flash_plan(16384, 128, 4)[0] is True
+
+
 def test_flash_attention_kgrid_long_context_path(monkeypatch):
     """The K-blocked streaming path (engaged when a head's K/V exceeds
     the VMEM budget — S>=32k on the real chip) matches the reference;
