@@ -110,7 +110,8 @@ def _online_softmax_update(q, k_blk, v_blk, m, l, acc, scale, mask):
     m_cur = jnp.max(s, axis=-1)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new[:, None])
-    p = jnp.where(s <= -1e29, 0.0, p)         # fully-masked rows stay 0
+    if mask is not None:
+        p = jnp.where(s <= -1e29, 0.0, p)     # fully-masked rows stay 0
     corr = jnp.exp(m - m_new)
     l_new = l * corr + jnp.sum(p, axis=-1)
     acc_new = acc * corr[:, None] + jax.lax.dot_general(
@@ -134,24 +135,43 @@ def _flash_kernel(scale: float, causal: bool, bq: int, bk: int,
     qi = pl.program_id(1)
     n_kb = s_total // bk
 
-    def body(j, carry):
+    def body(masked, j, carry):
         # inputs stay in their (bf16) dtype into the MXU; accumulation
         # is f32 via preferred_element_type — the standard flash recipe
         k_blk = k_ref[0, pl.ds(j * bk, bk), :]
         v_blk = v_ref[0, pl.ds(j * bk, bk), :]
         mask = _causal_mask(jnp, qi * bq, j * bk, bq, bk) \
-            if causal else None
+            if masked else None
         return _online_softmax_update(q, k_blk, v_blk, *carry, scale, mask)
 
     d = q.shape[-1]
     m0 = jnp.full((bq,), -1e30, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     a0 = jnp.zeros((bq, d), jnp.float32)
-    # causal: K blocks entirely above the diagonal are fully masked —
-    # skip them instead of burning MXU cycles on zeroed scores (halves
-    # the causal FLOPs, the case the transformer always runs)
-    upper = pl.cdiv((qi + 1) * bq, bk) if causal else n_kb
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, a0))
+    carry = (m0, l0, a0)
+    if causal:
+        # K blocks entirely above the diagonal are fully masked — skip
+        # them (halves the causal FLOPs). Blocks entirely BELOW the
+        # diagonal need no mask either: with enough blocks per program
+        # (long S), running them through an unmasked first loop saves
+        # the per-block iota/compare/where VPU lane work and measured
+        # +13% at S=8192 (interleaved A/B, round 5). With few blocks
+        # (S=2048 → 4) the second loop's pipeline restart costs more
+        # than the mask it saves, so short grids keep one masked loop.
+        upper = pl.cdiv((qi + 1) * bq, bk)            # first masked blk
+        if n_kb >= 8:
+            full = (qi * bq) // bk                    # blks fully below
+            carry = jax.lax.fori_loop(
+                0, full, functools.partial(body, False), carry)
+            carry = jax.lax.fori_loop(
+                full, upper, functools.partial(body, True), carry)
+        else:
+            carry = jax.lax.fori_loop(
+                0, upper, functools.partial(body, True), carry)
+    else:
+        carry = jax.lax.fori_loop(
+            0, n_kb, functools.partial(body, False), carry)
+    m, l, acc = carry
     l = jnp.maximum(l, 1e-20)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 

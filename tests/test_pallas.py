@@ -119,6 +119,21 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_causal_split_loop_path_matches_reference(self):
+        """n_kb >= 8 engages the two-loop causal body (unmasked full
+        blocks + masked diagonal blocks); its block-boundary arithmetic
+        must match the single-loop reference bit-for-bit — an off-by-one
+        in `full` would silently attend above the diagonal at long S."""
+        from nnstreamer_tpu.backends.pallas_ops import flash_attention
+        from nnstreamer_tpu.parallel.ring_attention import reference_attention
+
+        q, k, v = self._qkv(S=64)
+        got = flash_attention(q, k, v, causal=True,
+                              block_q=16, block_k=8)      # n_kb = 8
+        want = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_uneven_blocks_rejected(self):
         from nnstreamer_tpu.backends.pallas_ops import flash_attention
 
