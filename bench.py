@@ -1023,6 +1023,24 @@ def transformer_prefill():
     dms = _med3(fd, params, step_ids, kc, vc, pos, n1=5, n2=20) / NSTEP
     out["decode"] = {"step_ms": round(dms, 4),
                      "tokens_per_s": round(B / dms * 1e3)}
+    _family_partial(out)
+    # W8A8 prefill: int8 projections via the fused Pallas row-quant
+    # kernel, bf16 inter-op activations (models/quant.py perf note) —
+    # same math, measured against the bf16 prefill above
+    from nnstreamer_tpu.models.quant import (apply_seq_w8a8,
+                                             quantize_transformer)
+
+    fparams = T.init_params(d_model=d_model, n_heads=n_heads,
+                            n_layers=n_layers, vocab=vocab)
+    pq = jax.device_put(quantize_transformer(fparams))
+    fq = jax.jit(lambda p, i: apply_seq_w8a8(
+        p, i, n_heads=n_heads, attn="pallas", dtype=jnp.bfloat16))
+    qms = _med3(fq, pq, ids, n1=5, n2=20)
+    bf_ms = out["pallas_attn"]["ms"]
+    out["w8a8_prefill"] = {
+        "ms": round(qms, 3),
+        "tokens_per_s": round(B * S / qms * 1e3),
+        "vs_bf16": round(bf_ms / qms, 2) if qms else 0.0}
     return out
 
 

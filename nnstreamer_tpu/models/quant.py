@@ -16,15 +16,18 @@ This mirrors the role of the reference's quantized execution providers
 quantized DLCs): quantization as an execution feature with the accuracy
 contract checked against the float path (tests).
 
-**Measured perf reality on v5e (documented, not hidden)**: the int8
-dot itself runs ~3× the bf16 rate at transformer shapes
-(16384×1024×3072: 0.13 ms vs 0.45 ms), but ONE dynamic activation
-quantization pass costs 0.62 ms — more than the matmul it feeds — so
-W8A8 measures 0.74× bf16 end-to-end at d_model=1024 (quant is O(d)
-HBM passes, matmul is O(d²) MXU work; the crossover is at larger d).
-bf16 therefore stays the transformer perf path on this backend, the
-same conclusion as the int8-native conv path (tflite_quant.py); this
-module is the accuracy-verified quantized-execution capability.
+**Measured perf reality on v5e**: the int8 dot itself runs ~2-3× the
+bf16 rate at transformer shapes, and the former bottleneck — the
+dynamic activation-quant pass, which as plain XLA ops made ~3 HBM
+trips over the activations and cost more than the matmul it fed
+(0.62 ms vs 0.13 ms at 16384×1024; round 4 measured the whole W8A8
+matmul at 0.74× bf16 because of it) — is now a single-VMEM-pass
+Pallas kernel (`backends/pallas_ops.quantize_rows`). With it the full
+W8A8 matmul measures **1.9× the bf16 matmul** (0.37 vs 0.71 ms at
+16384×1024×3072, round 5): W8A8 is a genuine perf path for MXU-bound
+projections, not just an accuracy-verified capability. Int8
+*convolutions* still lose to relayout on this backend, so
+tflite_quant.py keeps dequantize→bf16 as its conv default.
 """
 from __future__ import annotations
 
@@ -67,34 +70,51 @@ def quantize_transformer(params: Dict[str, Any]) -> Dict[str, Any]:
 def w8a8_matmul(x, w_q, w_scale):
     """(…, K) f32/bf16 × int8 (K, N) → (…, N) f32.
 
-    Dynamic per-row activation quantization; int8×int8→int32 on the
-    MXU; one fused rescale. The quant/dequant is elementwise VPU work
-    XLA fuses around the dot."""
-    xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-    x_scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    x_q = jnp.clip(jnp.round(xf / x_scale), -127, 127).astype(jnp.int8)
+    Dynamic per-row activation quantization (the Pallas single-pass
+    `quantize_rows` kernel), int8×int8→int32 on the MXU, one fused
+    rescale. Expressed in plain XLA the quant pass made ~3 HBM trips
+    over the activations and cost more than the int8 dot it feeds;
+    with the fused kernel the whole W8A8 matmul measured **1.9× the
+    bf16 matmul** at 16384×1024×3072 on v5e (0.37 vs 0.71 ms, round
+    5) — see the perf-reality note in the module docstring. Row counts
+    that can't tile the kernel fall back to the equivalent XLA
+    expression inside quantize_rows itself."""
+    from nnstreamer_tpu.backends.pallas_ops import quantize_rows
+
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    x2 = x.reshape(-1, k)
+    x_q, x_scale = quantize_rows(x2)
     acc = jax.lax.dot_general(
-        x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+        x_q, w_q, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
-    return acc.astype(jnp.float32) * x_scale * w_scale.reshape(
-        (1,) * (acc.ndim - 1) + (-1,))
+    out = acc.astype(jnp.float32) * x_scale * w_scale.reshape(1, -1)
+    return out.reshape(lead + (out.shape[-1],))
 
 
-def apply_seq_w8a8(params_q, ids, *, n_heads=4, attn: str = "auto"):
+def apply_seq_w8a8(params_q, ids, *, n_heads=4, attn: str = "auto",
+                   dtype=jnp.float32):
     """Full-sequence forward with W8A8 projections — the quantized twin
     of transformer.apply_seq (same block structure, same attention
-    kernels; only the big matmuls run int8)."""
+    kernels; only the big matmuls run int8).
+
+    `dtype` is the inter-op activation dtype, exactly like apply_seq's:
+    pass bfloat16 for the perf path — the int8 matmuls don't care (they
+    re-quantize their input rows), but f32 activations double every
+    residual/norm/attention HBM trip between them (measured: the f32
+    default ran a d=1024 prefill SLOWER than bf16 apply_seq even with
+    each matmul 1.9× faster; bf16 activations let the matmul win
+    through, see PARITY)."""
     from nnstreamer_tpu.models import transformer as T
     from nnstreamer_tpu.parallel.ring_attention import reference_attention
 
     b, s = ids.shape
-    x = params_q["embed"][ids].astype(jnp.float32)
+    x = params_q["embed"][ids].astype(dtype)
     pos = jnp.arange(s)
     use_pallas = attn == "pallas" or (attn == "auto" and s % 128 == 0)
     for blk in params_q["blocks"]:
-        h = T.rmsnorm(x, blk["ln1"].astype(jnp.float32))
-        qkv = w8a8_matmul(h, blk["wqkv"], blk["wqkv_scale"])
+        h = T.rmsnorm(x, blk["ln1"].astype(dtype))
+        qkv = w8a8_matmul(h, blk["wqkv"], blk["wqkv_scale"]).astype(dtype)
         d = x.shape[-1]
         hd = d // n_heads
         kv_dim = (qkv.shape[-1] - d) // 2
@@ -113,12 +133,13 @@ def apply_seq_w8a8(params_q, ids, *, n_heads=4, attn: str = "auto"):
                                        causal=True)
         else:
             attn_out = reference_attention(q, k, v, causal=True)
-        attn_out = attn_out.reshape(b, s, -1).astype(jnp.float32)
-        x = x + w8a8_matmul(attn_out, blk["wo"], blk["wo_scale"])
-        h = T.rmsnorm(x, blk["ln2"].astype(jnp.float32))
-        gate_up = w8a8_matmul(h, blk["wi"], blk["wi_scale"])
+        attn_out = attn_out.reshape(b, s, -1).astype(dtype)
+        x = x + w8a8_matmul(attn_out, blk["wo"],
+                            blk["wo_scale"]).astype(dtype)
+        h = T.rmsnorm(x, blk["ln2"].astype(dtype))
+        gate_up = w8a8_matmul(h, blk["wi"], blk["wi_scale"]).astype(dtype)
         gate, up = jnp.split(gate_up, 2, axis=-1)
         x = x + w8a8_matmul(jax.nn.silu(gate) * up, blk["wd"],
-                            blk["wd_scale"])
-    x = T.rmsnorm(x, params_q["ln_f"].astype(jnp.float32))
+                            blk["wd_scale"]).astype(dtype)
+    x = T.rmsnorm(x, params_q["ln_f"].astype(dtype))
     return w8a8_matmul(x, params_q["head"], params_q["head_scale"])

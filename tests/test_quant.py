@@ -58,3 +58,37 @@ def test_apply_seq_w8a8_tracks_float_forward():
     assert np.abs(got - ref).max() / denom < 0.05
     # quantization must not reorder most next-token decisions
     assert (got.argmax(-1) == ref.argmax(-1)).mean() > 0.9
+    # the bf16-activation perf path holds the same accuracy contract
+    got16 = np.asarray(jax.jit(
+        lambda p, i: apply_seq_w8a8(p, i, n_heads=H, attn="xla",
+                                    dtype=jnp.bfloat16))(pq, ids))
+    assert np.abs(got16 - ref).max() / denom < 0.08
+    assert (got16.argmax(-1) == ref.argmax(-1)).mean() > 0.9
+
+
+def test_quantize_rows_kernel_exact_and_fallback():
+    """The Pallas single-pass row quantizer must match the plain
+    formula exactly (it replaced the XLA expression as the W8A8 hot
+    path), and odd row counts must take the XLA fallback unchanged."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.backends.pallas_ops import quantize_rows
+    from nnstreamer_tpu.models.quant import w8a8_matmul, quantize_weight
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(48, 128)).astype(np.float32)
+    x[7] = 0.0                                     # all-zero row: scale 1
+    q, s = quantize_rows(jnp.asarray(x))
+    amax = np.abs(x).max(-1, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0)
+    ref = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+    assert np.array_equal(np.asarray(q), ref)
+    np.testing.assert_allclose(np.asarray(s), scale, rtol=1e-6)
+    # kernel path vs fallback path agree through the full matmul
+    w = rng.normal(size=(128, 32)).astype(np.float32)
+    wq, ws = quantize_weight(jnp.asarray(w))
+    kernel_out = np.asarray(w8a8_matmul(jnp.asarray(x), wq, ws))  # 48 % 8 == 0
+    fb_out = np.asarray(w8a8_matmul(jnp.asarray(x[:5]), wq, ws))   # 5: fallback
+    assert kernel_out.shape == (48, 32)
+    assert fb_out.shape == (5, 32)
+    np.testing.assert_allclose(fb_out, kernel_out[:5], rtol=1e-5, atol=1e-5)
