@@ -1009,18 +1009,23 @@ def transformer_prefill():
 
     NSTEP = 32
 
-    def dloop(p, i, kc, vc, pos):
+    def make_dloop(step):
         # a real decode loop: cache threaded through lax.scan, one
-        # token per step, logits head sampled per step
-        def body(carry, _):
-            kc, vc, pos = carry
-            logits, kc, vc, pos = T.apply_step(
-                p, i, kc, vc, pos, n_heads=n_heads, dtype=jnp.bfloat16)
-            return (kc, vc, pos), logits[:, :8]
-        _, outs = jax.lax.scan(body, (kc, vc, pos), None, length=NSTEP)
-        return outs
+        # token per step, logits head sampled per step. One factory
+        # for the float and W8A8 variants so NSTEP/carry/logits-slice
+        # stay in lockstep and the vs_bf16 ratio is apples-to-apples.
+        def dloop(p, i, kc, vc, pos):
+            def body(carry, _):
+                kc, vc, pos = carry
+                logits, kc, vc, pos = step(p, i, kc, vc, pos)
+                return (kc, vc, pos), logits[:, :8]
+            _, outs = jax.lax.scan(body, (kc, vc, pos), None,
+                                   length=NSTEP)
+            return outs
+        return dloop
 
-    fd = jax.jit(dloop)
+    fd = jax.jit(make_dloop(lambda p, i, kc, vc, pos: T.apply_step(
+        p, i, kc, vc, pos, n_heads=n_heads, dtype=jnp.bfloat16)))
     dms = _med3(fd, params, step_ids, kc, vc, pos, n1=5, n2=20) / NSTEP
     out["decode"] = {"step_ms": round(dms, 4),
                      "tokens_per_s": round(B / dms * 1e3)}
@@ -1042,6 +1047,20 @@ def transformer_prefill():
         "ms": round(qms, 3),
         "tokens_per_s": round(B * S / qms * 1e3),
         "vs_bf16": round(bf_ms / qms, 2) if qms else 0.0}
+    _family_partial(out)
+    # W8A8 decode: int8 weights halve the per-step weight sweep
+    from nnstreamer_tpu.models.quant import apply_step_w8a8
+
+    kc2, vc2, pos2 = T.init_cache(batch=B, max_len=min(S, 2048),
+                                  d_model=d_model, n_heads=n_heads,
+                                  n_layers=n_layers, dtype=jnp.bfloat16)
+    fqd = jax.jit(make_dloop(lambda p, i, kc, vc, pos: apply_step_w8a8(
+        p, i, kc, vc, pos, n_heads=n_heads)))
+    qdms = _med3(fqd, pq, step_ids, kc2, vc2, pos2, n1=5, n2=20) / NSTEP
+    out["w8a8_decode"] = {
+        "step_ms": round(qdms, 4),
+        "tokens_per_s": round(B / qdms * 1e3),
+        "vs_bf16": round(dms / qdms, 2) if qdms else 0.0}
     return out
 
 

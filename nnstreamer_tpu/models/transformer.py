@@ -177,14 +177,19 @@ def init_cache(*, batch=1, max_len=128, d_model=64, n_heads=4, n_layers=2,
             jnp.zeros((1,), jnp.int32))
 
 
-def apply_step(params, ids, k_cache, v_cache, pos, *, n_heads=4,
-               dtype=jnp.float32):
-    """One streaming decode step: ids (B, 1) int32 + cache → logits
-    (B, vocab) + updated cache. Static shapes throughout: the cache is a
-    TRUE ring — writes land at pos % max_len, so past max_len tokens the
-    window slides (sliding-window attention over the last max_len
-    tokens; RoPE keys carry absolute positions, so relative geometry
-    stays correct across the wrap)."""
+def _step_impl(params, ids, k_cache, v_cache, pos, n_heads, dtype, proj):
+    """Shared decode-step body for the float and W8A8 paths.
+
+    `proj(store, name, x)` runs one projection matmul and returns in
+    `dtype` — the ONLY thing the two paths differ in (dense `x @ w`
+    here; int8 `w8a8_matmul` in models/quant.py). Everything
+    load-bearing lives once: the ring-slot write goes THROUGH the
+    stacked cache (one dynamic_update_slice on the full (L,B,S,Hkv,D)
+    array per tensor) — never unstack and restack: a per-layer
+    k_cache[li] → update → jnp.stack round-trip defeats XLA's in-place
+    aliasing of the donated cache inside lax.scan/_step_jit and copies
+    the whole cache every token (measured 2.6× slower at max_len=2048:
+    2.24 vs 0.86 ms/step, bit-identical outputs)."""
     b = ids.shape[0]
     max_len = k_cache.shape[2]
     p = pos.astype(jnp.int32)[0]
@@ -193,22 +198,22 @@ def apply_step(params, ids, k_cache, v_cache, pos, *, n_heads=4,
     pvec = p[None]
     for li, blk in enumerate(params["blocks"]):
         h = rmsnorm(x, blk["ln1"].astype(dtype))
-        q, k, v = _qkv(blk, h, n_heads, dtype)
+        d = x.shape[-1]
+        hd = d // n_heads
+        qkv = proj(blk, "wqkv", h)
+        kv_dim = (qkv.shape[-1] - d) // 2
+        n_kv = kv_dim // hd
+        q = qkv[..., :d].reshape(b, 1, n_heads, hd)
+        k = qkv[..., d:d + kv_dim].reshape(b, 1, n_kv, hd)
+        v = qkv[..., d + kv_dim:].reshape(b, 1, n_kv, hd)
         q, k = rope(q, pvec), rope(k, pvec)
-        # write THROUGH the stacked cache (one dynamic_update_slice on
-        # the full (L,B,S,Hkv,D) array per tensor) — never unstack and
-        # restack: a per-layer k_cache[li] → update → jnp.stack(new_k)
-        # round-trip defeats XLA's in-place aliasing of the donated
-        # cache inside lax.scan/_step_jit and copies the whole cache
-        # every token (measured 2.6× slower at max_len=2048: 2.24 vs
-        # 0.86 ms/step, bit-identical outputs)
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype)[None], (li, 0, slot, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype)[None], (li, 0, slot, 0, 0))
         kc, vc = k_cache[li], v_cache[li]
         # attend over the populated window (all slots once wrapped)
-        scale = q.shape[-1] ** -0.5
+        scale = hd ** -0.5
         # cache layout is (B, max_len, n_kv, D): expand KV groups to
         # full heads for the attention einsum; scores/softmax in f32
         # regardless of the cache storage dtype
@@ -221,12 +226,29 @@ def apply_step(params, ids, k_cache, v_cache, pos, *, n_heads=4,
         pattn = jax.nn.softmax(s, axis=-1)
         vcx = _expand_kv(vc, n_heads).astype(jnp.float32)
         attn = jnp.einsum("bhqk,bkhd->bqhd", pattn, vcx).astype(dtype)
-        x = x + attn.reshape(b, 1, -1) @ blk["wo"].astype(dtype)
+        x = x + proj(blk, "wo", attn.reshape(b, 1, -1))
         h = rmsnorm(x, blk["ln2"].astype(dtype))
-        x = x + _mlp(blk, h, dtype)
+        gate, up = jnp.split(proj(blk, "wi", h), 2, axis=-1)
+        x = x + proj(blk, "wd", jax.nn.silu(gate) * up)
     x = rmsnorm(x, params["ln_f"].astype(dtype))
-    logits = (x[:, 0] @ params["head"].astype(dtype)).astype(jnp.float32)
+    logits = proj(params, "head", x[:, 0]).astype(jnp.float32)
     return (logits, k_cache, v_cache, (p + 1)[None].astype(jnp.int32))
+
+
+def apply_step(params, ids, k_cache, v_cache, pos, *, n_heads=4,
+               dtype=jnp.float32):
+    """One streaming decode step: ids (B, 1) int32 + cache → logits
+    (B, vocab) + updated cache. Static shapes throughout: the cache is a
+    TRUE ring — writes land at pos % max_len, so past max_len tokens the
+    window slides (sliding-window attention over the last max_len
+    tokens; RoPE keys carry absolute positions, so relative geometry
+    stays correct across the wrap). Body shared with the W8A8 twin via
+    `_step_impl`."""
+    def proj(store, name, x):
+        return x @ store[name].astype(dtype)
+
+    return _step_impl(params, ids, k_cache, v_cache, pos, n_heads,
+                      dtype, proj)
 
 
 #: one compiled decode step per (n_heads, dtype) — generate() calls

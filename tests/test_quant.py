@@ -92,3 +92,35 @@ def test_quantize_rows_kernel_exact_and_fallback():
     assert kernel_out.shape == (48, 32)
     assert fb_out.shape == (5, 32)
     np.testing.assert_allclose(fb_out, kernel_out[:5], rtol=1e-5, atol=1e-5)
+
+
+def test_apply_step_w8a8_tracks_float_decode():
+    """The quantized decode step must track the float decode step over
+    a teacher-forced greedy rollout: after a random prompt token, every
+    subsequent input is the FLOAT path's argmax fed to both paths, so
+    quantization error flowing through the KV cache cannot hide behind
+    diverging inputs and must not compound across steps."""
+    from nnstreamer_tpu.models.quant import apply_step_w8a8
+
+    d, H, L, V, B = 64, 4, 2, 64, 2
+    params = T.init_params(d_model=d, n_heads=H, n_layers=L, vocab=V)
+    pq = quantize_transformer(params)
+    rng = np.random.default_rng(3)
+    kc, vc, pos = T.init_cache(batch=B, max_len=16, d_model=d,
+                               n_heads=H, n_layers=L)
+    qkc, qvc, qpos = T.init_cache(batch=B, max_len=16, d_model=d,
+                                  n_heads=H, n_layers=L)
+    ids = rng.integers(0, V, (B, 1)).astype(np.int32)
+    agree = 0
+    steps = 12
+    for i in range(steps):
+        ref, kc, vc, pos = T.apply_step(params, ids, kc, vc, pos,
+                                        n_heads=H)
+        got, qkc, qvc, qpos = apply_step_w8a8(pq, ids, qkc, qvc, qpos,
+                                              n_heads=H)
+        ref, got = np.asarray(ref), np.asarray(got)
+        denom = np.abs(ref).max() or 1.0
+        assert np.abs(got - ref).max() / denom < 0.12, f"step {i}"
+        agree += int((got.argmax(-1) == ref.argmax(-1)).all())
+        ids = ref.argmax(-1).astype(np.int32)[:, None]   # greedy feedback
+    assert agree >= steps - 2       # greedy decisions essentially match
