@@ -68,10 +68,50 @@ class FilterBackend:
         region; XLABackend overrides with batched + bucketed compiles."""
         return [self.invoke((r,))[0] for r in regions]
 
+    def invoke_batched(self, tensors: ArrayTuple, n: int,
+                       keepdims: Sequence[bool] = ()) -> ArrayTuple:
+        """Run the model over a micro-batched frame (tensor_batch
+        upstream): each input tensor carries `n` frames coalesced on
+        axis 0 — concatenated where the per-frame leading dim is 1
+        (keepdims[j] True, rank preserved), stacked on a new axis
+        otherwise. Outputs must come back batched by the same rule
+        (leading dim 1 per frame → concatenated, else stacked).
+
+        Default: one invoke per frame, outputs restacked on the host —
+        correct for any backend. XLABackend overrides with a single
+        padded, bucket-compiled batched XLA call."""
+        frames_out = []
+        for i in range(n):
+            frame = tuple(
+                t[i:i + 1] if (j < len(keepdims) and keepdims[j]) else t[i]
+                for j, t in enumerate(tensors)
+            )
+            frames_out.append(self.invoke(frame))
+        return _restack_frames(frames_out)
+
     def reload(self, model: Any) -> None:
         raise BackendError(
             f"backend {self.BACKEND_NAME!r} does not support model reload"
         )
+
+
+def _restack_frames(frames_out: Sequence[ArrayTuple]) -> ArrayTuple:
+    """Recombine per-frame invoke outputs into batched wire format:
+    per output k, concatenate along axis 0 when the per-frame result has
+    a leading dim of 1, else stack on a new axis (the same rule
+    tensor_batch applies on the input side, so tensor_unbatch can split
+    by rank alone)."""
+    out = []
+    for k in range(len(frames_out[0])):
+        rows = [f[k] for f in frames_out]
+        if any(type(r).__module__.startswith("jax") for r in rows):
+            import jax.numpy as xp
+        else:
+            import numpy as xp
+        keep = len(rows[0].shape) >= 1 and rows[0].shape[0] == 1
+        out.append(xp.concatenate(rows, axis=0) if keep
+                   else xp.stack(rows, axis=0))
+    return tuple(out)
 
 
 def register_backend(name: str):

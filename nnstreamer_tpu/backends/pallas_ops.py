@@ -84,15 +84,21 @@ def quantize_rows(x, block_rows: int = 256):
     matmul it feeds (0.62 ms vs 0.13 ms at 16384×1024, the measured
     reason models/quant.py documented W8A8 at 0.74× bf16). Fused here:
     read x once, write int8 + one (M, 1) scale column. Row counts not
-    divisible by the 8-row Mosaic sublane fall back to the equivalent
-    XLA expression (same formula, `_quantize_rows_xla`) instead of
-    picking an untileable block."""
+    divisible by the 8-row Mosaic sublane are zero-padded up to the
+    next multiple of 8 and the outputs sliced back — pad rows quantize
+    independently (per-row scales; amax 0 → scale 1 → q 0) so they
+    never touch real rows, and the kernel keeps the single-HBM-trip
+    property for ragged M (decode steps, tail microbatches) instead of
+    falling back to the ~3-trip XLA path. `_quantize_rows_xla` remains
+    as the formula's plain-XLA twin for reference/testing."""
     m, k = x.shape
+    m_pad = (-m) % 8
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+        m += m_pad
     bm = block_rows
     while bm > 8 and m % bm:
         bm //= 2
-    if m % bm:
-        return _quantize_rows_xla(x)
     q, s = pl.pallas_call(
         _quantize_rows_kernel,
         grid=(m // bm,),
@@ -103,6 +109,8 @@ def quantize_rows(x, block_rows: int = 256):
                    jax.ShapeDtypeStruct((m, 1), jnp.float32)],
         interpret=_interpret(),
     )(x)
+    if m_pad:
+        q, s = q[:m - m_pad], s[:m - m_pad]
     return q, s
 
 

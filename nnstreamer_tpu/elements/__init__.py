@@ -6,6 +6,7 @@ registry — the plugin_init analog (registerer/nnstreamer.c:91-119).
 
 from nnstreamer_tpu.elements import (  # noqa: F401
     aggregator,
+    batch,
     control,
     converter,
     debug,
@@ -44,6 +45,7 @@ except ImportError as _interop_err:  # pragma: no cover - env without deps
         "not registered", _interop_err)
 
 from nnstreamer_tpu.elements.aggregator import TensorAggregator
+from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
 from nnstreamer_tpu.elements.control import (
     TensorCrop, TensorIf, TensorRate, register_if_condition)
 from nnstreamer_tpu.elements.converter import TensorConverter, register_converter
@@ -73,6 +75,7 @@ __all__ = [
     "REPO",
     "Tee",
     "TensorAggregator",
+    "TensorBatch",
     "TensorConverter",
     "TensorCrop",
     "TensorDebug",
@@ -91,6 +94,7 @@ __all__ = [
     "TensorSplit",
     "TensorSrc",
     "TensorTransform",
+    "TensorUnbatch",
     "TransformProgram",
     "VideoTestSrc",
     "register_converter",

@@ -54,6 +54,11 @@ def _parse_combination(s: str) -> Optional[List[int]]:
 @register_element("tensor_filter")
 class TensorFilter(Element):
     ELEMENT_NAME = "tensor_filter"
+    # a tensor_batch upstream is the whole point of micro-batching: the
+    # filter runs ONE batched invoke per coalesced buffer (backend
+    # invoke_batched, bucket-compiled) and forwards batched outputs with
+    # the dyn_batch meta intact for tensor_unbatch downstream
+    ACCEPTS_DYN_BATCH = True
     PROPS = {
         "framework": PropDef(str, "", "backend name (xla|custom|pallas|…)"),
         "model": PropDef(lambda s: s, None, "model reference (backend-specific)"),
@@ -97,6 +102,8 @@ class TensorFilter(Element):
         self._invoke_count = 0
         self._t_start = None
         self._flexible = False
+        self._dyn_batched = 0                 # dyn_batch of the input stream
+        self._batch_keepdims: List[bool] = []
 
     # -- combination parsing ----------------------------------------------
     @staticmethod
@@ -194,6 +201,22 @@ class TensorFilter(Element):
         from nnstreamer_tpu.tensor.info import TensorFormat
 
         spec = self.expect_tensors(in_specs[0])
+        self._dyn_batched = spec.dyn_batch
+        if spec.dyn_batch:
+            if self._in_combination is not None or \
+                    self._out_combination is not None:
+                self.fail_negotiation(
+                    "input-/output-combination cannot apply to a "
+                    "micro-batched stream (batched buffers carry one "
+                    "variable batch axis, not fixed per-frame tensor "
+                    "slots); place tensor_batch after the combination or "
+                    "drop the combination properties")
+            # negotiation currency stays PER-FRAME: the model and every
+            # override/check below see the frame spec; dyn_batch is
+            # re-attached to the output spec at the end
+            spec = replace(spec, dyn_batch=0)
+            self._batch_keepdims = [
+                len(t.shape) >= 1 and t.shape[0] == 1 for t in spec.tensors]
         fw = self._framework_name()
         try:
             self.backend = get_backend(fw)
@@ -293,6 +316,8 @@ class TensorFilter(Element):
                     )
                 infos.append(pool[idx])
             out = replace(out, tensors=tuple(infos))
+        if self._dyn_batched:
+            out = replace(out, dyn_batch=self._dyn_batched)
         return [out]
 
     def _subset_spec(self, spec: TensorsSpec) -> TensorsSpec:
@@ -321,6 +346,8 @@ class TensorFilter(Element):
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
         if self._flexible:
             return self._process_flexible(buf)
+        if self._dyn_batched:
+            return self._process_batched(buf)
         inputs = buf.tensors
         if self._in_combination is not None:
             inputs = tuple(inputs[i] for i in self._in_combination)
@@ -349,6 +376,39 @@ class TensorFilter(Element):
             for kind, idx in self._out_combination:
                 sel.append(buf.tensors[idx] if kind == "i" else outputs[idx])
             outputs = tuple(sel)
+        return [(0, buf.with_tensors(outputs))]
+
+    def _process_batched(self, buf: TensorBuffer) -> List[Emission]:
+        """Micro-batched buffer (tensor_batch upstream): one batched
+        invoke over the coalesced frames; outputs stay batched and keep
+        the dyn_batch meta so tensor_unbatch can split them. Fused
+        host-side pre/post chains are elementwise, hence batch-
+        polymorphic — they apply to the batched arrays directly."""
+        db = buf.meta.get("dyn_batch")
+        if db is None:
+            raise BackendError(
+                f"tensor_filter {self.name}: micro-batched stream buffer "
+                f"has no dyn_batch meta (upstream element dropped it?)")
+        n = int(db["n"])
+        inputs = buf.tensors
+        t0 = time.perf_counter()
+        if self._pre is not None and not self._fused_in_backend:
+            inputs = self._pre(inputs)
+        try:
+            outputs = self.backend.invoke_batched(
+                inputs, n, self._batch_keepdims)
+        except Exception as e:
+            raise BackendError(
+                f"tensor_filter {self.name}: batched invoke failed on "
+                f"buffer pts={buf.pts} occupancy={n}: {e}"
+            ) from e
+        if self._post is not None and not self._fused_in_backend:
+            outputs = self._post(outputs) if self._fused_decoder is None \
+                else self._post(outputs, self._host_decoder_aux())
+        if self.props["latency_mode"] == "sync":
+            outputs = tuple(_block(o) for o in outputs)
+        self._lat_window.append(time.perf_counter() - t0)
+        self._invoke_count += n   # throughput prop counts FRAMES
         return [(0, buf.with_tensors(outputs))]
 
     def _process_flexible(self, buf: TensorBuffer) -> List[Emission]:

@@ -133,12 +133,24 @@ class Element:
     def fail_negotiation(self, msg: str) -> None:
         raise NegotiationError(f"element {self.name} ({self.ELEMENT_NAME}): {msg}")
 
+    #: element understands dynamically micro-batched streams (buffers
+    #: carrying a variable leading batch axis, tensor_batch upstream);
+    #: everything else refuses them at negotiation via expect_tensors
+    ACCEPTS_DYN_BATCH: bool = False
+
     def expect_tensors(self, spec: StreamSpec, pad: int = 0) -> TensorsSpec:
         if not isinstance(spec, TensorsSpec):
             self.fail_negotiation(
                 f"sink pad {pad} requires a tensor stream but got "
                 f"{type(spec).__name__} ({spec}); insert a tensor_converter "
                 f"upstream to turn media into tensors"
+            )
+        if spec.dyn_batch and not self.ACCEPTS_DYN_BATCH:
+            self.fail_negotiation(
+                f"sink pad {pad} stream is dynamically micro-batched "
+                f"(tensor_batch upstream, up to {spec.dyn_batch} frames per "
+                f"buffer) but {self.ELEMENT_NAME} is not batch-aware; insert "
+                f"tensor_unbatch before it to restore per-frame buffers"
             )
         return spec
 
@@ -155,6 +167,21 @@ class Element:
 
     def flush(self) -> List[Emission]:
         """Drain internal state at EOS (aggregators, adapters)."""
+        return []
+
+    # -- time-based wakeups (deadline coalescing) ---------------------------
+    def next_deadline(self) -> Optional[float]:
+        """Earliest `time.perf_counter()` instant at which this element
+        needs a timer wakeup even if no buffer arrives (e.g. a half-full
+        tensor_batch whose max-latency deadline is approaching). None =
+        no pending deadline. Called by the scheduler's worker loop to
+        bound its queue wait; only ever called from the element's own
+        worker thread, so no locking is needed."""
+        return None
+
+    def on_timer(self) -> List[Emission]:
+        """Fired by the scheduler when next_deadline() expires before a
+        buffer arrives. Same threading contract as process()."""
         return []
 
     def __repr__(self):
@@ -331,6 +358,20 @@ class Pipeline:
                 raise NegotiationError(
                     f"element {e.name} has unlinked sink pad(s) {missing}"
                 )
+            # enforced centrally (not just in expect_tensors) so elements
+            # whose negotiate() never inspects the spec — sinks — still
+            # refuse micro-batched wires they cannot interpret
+            for i, s in enumerate(in_specs):
+                if isinstance(s, TensorsSpec) and s.dyn_batch \
+                        and not e.ACCEPTS_DYN_BATCH:
+                    raise NegotiationError(
+                        f"element {e.name} ({e.ELEMENT_NAME}): sink pad {i} "
+                        f"stream is dynamically micro-batched (tensor_batch "
+                        f"upstream, up to {s.dyn_batch} frames per buffer) "
+                        f"but {e.ELEMENT_NAME} is not batch-aware; insert "
+                        f"tensor_unbatch before it to restore per-frame "
+                        f"buffers"
+                    )
             out_specs = e.negotiate(in_specs)
             e.in_specs = list(in_specs)
             e.out_specs = list(out_specs)

@@ -49,7 +49,8 @@ class ElementStats:
     analog (SURVEY.md §5.1: tools/tracing/README.md:34-41), first-class
     instead of out-sourced. Read via PipelineRunner.stats()."""
 
-    __slots__ = ("buffers", "total_s", "max_s", "wait_s", "wait_max_s")
+    __slots__ = ("buffers", "total_s", "max_s", "wait_s", "wait_max_s",
+                 "timer_fires")
 
     def __init__(self):
         self.buffers = 0
@@ -61,6 +62,9 @@ class ElementStats:
         # composite-tail diagnosis needs (GstShark interlatency analog)
         self.wait_s = 0.0
         self.wait_max_s = 0.0
+        # deadline wakeups delivered to on_timer() (tensor_batch
+        # max-latency flushes fire through here)
+        self.timer_fires = 0
 
     def record(self, dt: float) -> None:
         self.buffers += 1
@@ -83,7 +87,8 @@ class ElementStats:
                 "proctime_total_s": self.total_s,
                 "queue_wait_avg_us": (1e6 * self.wait_s / self.buffers
                                       if self.buffers else 0.0),
-                "queue_wait_max_us": 1e6 * self.wait_max_s}
+                "queue_wait_max_us": 1e6 * self.wait_max_s,
+                "timer_fires": self.timer_fires}
 
 
 class PipelineRunner:
@@ -196,6 +201,11 @@ class PipelineRunner:
             if hasattr(e, "latency_us"):
                 d["invoke_latency_us"] = e.latency_us
                 d["invoke_throughput"] = e.throughput
+            # element-specific counters (tensor_batch occupancy histogram
+            # + flush reasons, …) merge into the same stats row
+            extra = getattr(e, "extra_stats", None)
+            if extra is not None:
+                d.update(extra())
             out[name] = d
         return out
 
@@ -277,8 +287,23 @@ class PipelineRunner:
         stats = self._stats[elem.name]
         try:
             while not self._stop_evt.is_set():
+                # deadline-aware wait: an element holding half-assembled
+                # state (tensor_batch) publishes its next flush instant;
+                # the queue wait shortens to meet it so a partial batch
+                # ships on time even when no further buffer ever arrives
+                deadline = elem.next_deadline()
+                if deadline is None:
+                    timeout = 0.1
+                else:
+                    now = time.perf_counter()
+                    if now >= deadline:
+                        stats.timer_fires += 1
+                        for sp, b in elem.on_timer():
+                            self._emit(elem, sp, b)
+                        continue
+                    timeout = min(0.1, deadline - now)
                 try:
-                    pad, item, t_enq = q.get(timeout=0.1)
+                    pad, item, t_enq = q.get(timeout=timeout)
                 except queue.Empty:
                     continue
                 if item is EOS:

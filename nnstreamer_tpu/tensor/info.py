@@ -164,6 +164,14 @@ class TensorsSpec:
     tensors: Tuple[TensorInfo, ...]
     format: TensorFormat = TensorFormat.STATIC
     rate: Fraction = Fraction(0, 1)  # frames/sec; 0/1 = unknown/unfixed
+    #: >0: the stream carries dynamic micro-batches (tensor_batch
+    #: upstream) of up to this many frames coalesced on a leading batch
+    #: axis. `tensors` keeps the PER-FRAME shapes — the batch axis is a
+    #: runtime property (each buffer's occupancy varies with load), not
+    #: a type property, so downstream unbatch/decoders still negotiate
+    #: per-frame specs. Elements that are not batch-aware refuse such
+    #: streams at negotiation (Element.expect_tensors).
+    dyn_batch: int = 0
 
     def __post_init__(self):
         object.__setattr__(self, "tensors", tuple(self.tensors))
@@ -222,6 +230,10 @@ class TensorsSpec:
         """
         if self.format == TensorFormat.FLEXIBLE or other.format == TensorFormat.FLEXIBLE:
             return True
+        if self.dyn_batch != other.dyn_batch:
+            # a micro-batched stream is wire-incompatible with a
+            # per-frame one: buffers carry an extra (variable) batch axis
+            return False
         if self.format != other.format:
             # STATIC vs SPARSE payloads are wire-incompatible; only FLEXIBLE
             # streams self-describe per buffer (reference:
@@ -246,4 +258,5 @@ class TensorsSpec:
         body = ", ".join(str(t) for t in self.tensors)
         fmt = self.format.name.lower()
         r = f" @{self.rate}fps" if self.rate else ""
-        return f"TensorsSpec[{fmt}]({body}{r})"
+        db = f" dyn_batch<={self.dyn_batch}" if self.dyn_batch else ""
+        return f"TensorsSpec[{fmt}]({body}{r}{db})"

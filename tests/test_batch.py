@@ -1,0 +1,263 @@
+"""Dynamic micro-batching runtime: tensor_batch / tensor_unbatch /
+batched filter invokes (CPU-only, deterministic where timing allows;
+the timing tests use budgets generous enough for CI jitter)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import NegotiationError
+from nnstreamer_tpu.elements import (
+    AppSrc, TensorBatch, TensorFilter, TensorSink, TensorUnbatch)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.dtypes import DType
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+SPEC = TensorsSpec.of(TensorInfo((1, 4), DType.FLOAT32))
+
+
+def _affine(x):
+    return x * 2.0 + 1.0
+
+
+def _frame(v, pts):
+    return TensorBuffer.of(np.full((1, 4), float(v), np.float32), pts=pts)
+
+
+def _chain(pipe, stages):
+    for e in stages:
+        pipe.add(e)
+    for a, b in zip(stages, stages[1:]):
+        pipe.link(a, b)
+
+
+class TestBatchUnbatch:
+    def test_full_and_eos_flush_order_and_meta(self):
+        """max-batch flushes plus a partial EOS flush; per-frame pts,
+        meta and arrival order restored through a batched filter."""
+        pipe = nns.Pipeline()
+        src = AppSrc("src", spec=SPEC)
+        sink = TensorSink("sink")
+        _chain(pipe, [src,
+                      TensorBatch("b", max_batch=4, max_latency_ms=1000),
+                      TensorFilter("f", framework="xla", model=_affine),
+                      TensorUnbatch("u"), sink])
+        runner = nns.PipelineRunner(pipe).start()
+        for i in range(10):
+            buf = _frame(i, pts=i)
+            buf.meta["tag"] = f"frame{i}"
+            src.push(buf)
+        src.end()
+        runner.wait(60)
+        runner.stop()
+        assert [o.pts for o in sink.results] == list(range(10))
+        for i, o in enumerate(sink.results):
+            assert o.tensors[0].shape == (1, 4)
+            np.testing.assert_allclose(
+                np.asarray(o.tensors[0]), np.full((1, 4), i * 2.0 + 1.0))
+            assert o.meta["tag"] == f"frame{i}"
+        st = runner.stats()["b"]
+        assert st["frames_in"] == 10
+        assert st["flush_full"] == 2          # 4 + 4
+        assert st["flush_eos"] == 1           # + 2 at EOS
+        assert st["occupancy_hist"] == {2: 1, 4: 2}
+
+    def test_partial_batch_flush_at_eos(self):
+        """Frames fewer than max-batch must not be stranded: EOS drains
+        the half-assembled batch through Element.flush()."""
+        pipe = nns.Pipeline()
+        src = AppSrc("src", spec=SPEC)
+        sink = TensorSink("sink")
+        _chain(pipe, [src,
+                      TensorBatch("b", max_batch=64, max_latency_ms=60000),
+                      TensorUnbatch("u"), sink])
+        runner = nns.PipelineRunner(pipe).start()
+        for i in range(3):
+            src.push(_frame(i, pts=i))
+        src.end()
+        runner.wait(30)
+        runner.stop()
+        assert [o.pts for o in sink.results] == [0, 1, 2]
+        st = runner.stats()["b"]
+        assert st["flush_eos"] == 1 and st["flush_full"] == 0
+        assert st["occupancy_hist"] == {3: 1}
+
+    def test_deadline_flush_slow_source(self):
+        """A source slower than max-latency-ms must get every frame
+        flushed by the scheduler's timer wakeup, not by batch-full or
+        EOS — and no frame may wait longer than the budget plus the
+        scheduler tick (0.1s) plus CI slack."""
+        budget_ms = 150.0
+        pipe = nns.Pipeline()
+        src = AppSrc("src", spec=SPEC)
+        done = []
+        sink = TensorSink("sink",
+                          new_data=lambda b: done.append(
+                              (b.pts, time.perf_counter())))
+        _chain(pipe, [src,
+                      TensorBatch("b", max_batch=64,
+                                  max_latency_ms=budget_ms),
+                      TensorUnbatch("u"), sink])
+        runner = nns.PipelineRunner(pipe).start()
+        pushed = {}
+        for i in range(4):
+            pushed[i] = time.perf_counter()
+            src.push(_frame(i, pts=i))
+            time.sleep(0.35)          # > budget: nothing to coalesce with
+        src.end()
+        runner.wait(30)
+        runner.stop()
+        st = runner.stats()["b"]
+        assert st["flush_deadline"] == 4, st
+        assert st["timer_fires"] >= 4
+        assert runner.stats()["b"]["occupancy_hist"] == {1: 4}
+        waits = {pts: t - pushed[pts] for pts, t in done}
+        assert len(waits) == 4
+        # budget + one 0.1s scheduler tick + generous CI slack — but far
+        # below the 60s EOS horizon, so a flush that only happened at
+        # EOS (timer broken) fails loudly
+        for pts, w in waits.items():
+            assert w < budget_ms / 1e3 + 0.1 + 0.35, (pts, w)
+
+    def test_multi_stream_routes_back_in_order(self):
+        """N muxed input streams through tensor_batch → tensor_filter →
+        tensor_unbatch: each output pad gets exactly its own stream's
+        frames, in arrival order, with per-frame meta restored."""
+        pipe = nns.Pipeline()
+        s0 = AppSrc("s0", spec=SPEC)
+        s1 = AppSrc("s1", spec=SPEC)
+        b = TensorBatch("b", max_batch=4, max_latency_ms=1000)
+        f = TensorFilter("f", framework="xla", model=_affine)
+        u = TensorUnbatch("u")
+        k0, k1 = TensorSink("k0"), TensorSink("k1")
+        for e in (s0, s1, b, f, u, k0, k1):
+            pipe.add(e)
+        pipe.link(s0, b, dst_pad=0)
+        pipe.link(s1, b, dst_pad=1)
+        pipe.link(b, f)
+        pipe.link(f, u)
+        pipe.link(u, k0, src_pad=0)
+        pipe.link(u, k1, src_pad=1)
+        runner = nns.PipelineRunner(pipe).start()
+        for i in range(4):
+            s0.push(_frame(10 + i, pts=100 + i))
+            s1.push(_frame(20 + i, pts=200 + i))
+        s0.end()
+        s1.end()
+        runner.wait(60)
+        runner.stop()
+        assert [o.pts for o in k0.results] == [100, 101, 102, 103]
+        assert [o.pts for o in k1.results] == [200, 201, 202, 203]
+        for i, o in enumerate(k0.results):
+            np.testing.assert_allclose(
+                np.asarray(o.tensors[0]), np.full((1, 4), (10 + i) * 2 + 1))
+            assert o.meta["stream_id"] == 0
+            assert o.meta["batch_seq"] == i
+        for o in k1.results:
+            assert o.meta["stream_id"] == 1
+
+    def test_non_batch_aware_sink_refused_at_negotiation(self):
+        pipe = nns.Pipeline()
+        src = AppSrc("src", spec=SPEC)
+        sink = TensorSink("sink")
+        _chain(pipe, [src, TensorBatch("b", max_batch=4), sink])
+        with pytest.raises(NegotiationError, match="tensor_unbatch"):
+            pipe.negotiate()
+
+    def test_unbatch_requires_batched_stream(self):
+        pipe = nns.Pipeline()
+        src = AppSrc("src", spec=SPEC)
+        sink = TensorSink("sink")
+        _chain(pipe, [src, TensorUnbatch("u"), sink])
+        with pytest.raises(NegotiationError, match="not micro-batched"):
+            pipe.negotiate()
+
+    def test_per_frame_spec_preserved_downstream(self):
+        """The whole point of dyn_batch-as-spec-field: elements after
+        tensor_unbatch negotiate the same per-frame spec they would see
+        without the batch/unbatch pair."""
+        pipe = nns.Pipeline()
+        src = AppSrc("src", spec=SPEC)
+        b = TensorBatch("b", max_batch=8)
+        u = TensorUnbatch("u")
+        sink = TensorSink("sink")
+        _chain(pipe, [src, b, u, sink])
+        pipe.negotiate()
+        assert b.out_specs[0].dyn_batch == 8
+        assert b.out_specs[0].tensors == SPEC.tensors     # per-frame shapes
+        assert u.out_specs[0].dyn_batch == 0
+        assert u.out_specs[0].tensors == SPEC.tensors
+        assert sink.in_specs[0].is_compatible(SPEC)
+
+
+class TestBatchedInvokes:
+    def _open_backend(self, model, in_spec):
+        from nnstreamer_tpu.backends.xla import XLABackend
+
+        be = XLABackend()
+        be.open({"model": model})
+        be.set_input_info(in_spec)
+        return be
+
+    def test_bucketed_compile_count_under_ragged_batches(self):
+        """Ragged occupancies (deadline flushes under varying load) must
+        reuse power-of-two buckets: occupancies 1..8 may cost at most
+        the 4 bucket compilations {1,2,4,8}, not 8."""
+        be = self._open_backend(_affine, SPEC)
+        for n in (3, 5, 2, 7, 1, 6, 4, 8, 3, 5):
+            x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+            out = be.invoke_batched((x,), n, [True])
+            assert np.asarray(out[0]).shape == (n, 4)
+            np.testing.assert_allclose(np.asarray(out[0]), x * 2.0 + 1.0)
+        assert be.compile_count <= 4, be.compile_count
+        be.close()
+
+    def test_stack_mode_for_rank_without_leading_one(self):
+        """Per-frame tensors whose leading dim isn't 1 batch by stacking
+        (rank + 1); outputs come back stacked and slice clean."""
+        spec = TensorsSpec.of(TensorInfo((4,), DType.FLOAT32))
+        be = self._open_backend(_affine, spec)
+        frames = np.stack([np.full(4, i, np.float32) for i in range(3)])
+        out = be.invoke_batched((frames,), 3, [False])
+        assert np.asarray(out[0]).shape == (3, 4)
+        np.testing.assert_allclose(np.asarray(out[0]), frames * 2.0 + 1.0)
+        be.close()
+
+    def test_batch_rejecting_model_falls_back_per_frame(self):
+        """A model with a baked-in per-frame shape (rejects any batched
+        input) must still produce correct batched output via the base
+        per-frame fallback — correctness never depends on batchability."""
+        def rigid(x):
+            import jax.numpy as jnp
+
+            return jnp.reshape(x, (4,)) * 3.0     # only (1, 4) reshapes
+
+        be = self._open_backend(rigid, SPEC)
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = be.invoke_batched((x,), 3, [True])
+        # per-frame outputs have shape (4,): fallback stacks → (3, 4)
+        assert np.asarray(out[0]).shape == (3, 4)
+        np.testing.assert_allclose(np.asarray(out[0]), x * 3.0)
+        be.close()
+
+    def test_pipeline_batched_filter_compiles_bounded(self):
+        """End to end: ragged flush sizes through the pipeline stay
+        within the power-of-two compile budget, observable on the
+        element's backend."""
+        pipe = nns.Pipeline()
+        src = AppSrc("src", spec=SPEC)
+        f = TensorFilter("f", framework="xla", model=_affine)
+        sink = TensorSink("sink")
+        _chain(pipe, [src, TensorBatch("b", max_batch=4,
+                                       max_latency_ms=1000),
+                      f, TensorUnbatch("u"), sink])
+        runner = nns.PipelineRunner(pipe).start()
+        for i in range(7):                 # 4-full + 3-at-EOS (→ pad 4)
+            src.push(_frame(i, pts=i))
+        src.end()
+        runner.wait(60)
+        runner.stop()
+        assert len(sink.results) == 7
+        assert f.backend.compile_count <= 2   # buckets {4} (3 pads to 4)

@@ -134,6 +134,23 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_causal_misaligned_boundary_block_q_lt_block_k(self):
+        """block_q < block_k makes the diagonal cut THROUGH k-blocks at
+        q-block granularity: with S=128, bq=8, bk=16 (n_kb = 8, split
+        loop engaged) every odd q-block's diagonal lands mid-k-block, so
+        `full = (qi*bq)//bk` must floor — rounding up would count the
+        half-covered diagonal block as fully below the diagonal and
+        attend to future positions."""
+        from nnstreamer_tpu.backends.pallas_ops import flash_attention
+        from nnstreamer_tpu.parallel.ring_attention import reference_attention
+
+        q, k, v = self._qkv(S=128)
+        got = flash_attention(q, k, v, causal=True,
+                              block_q=8, block_k=16)
+        want = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_uneven_blocks_rejected(self):
         from nnstreamer_tpu.backends.pallas_ops import flash_attention
 

@@ -69,7 +69,10 @@ def test_apply_seq_w8a8_tracks_float_forward():
 def test_quantize_rows_kernel_exact_and_fallback():
     """The Pallas single-pass row quantizer must match the plain
     formula exactly (it replaced the XLA expression as the W8A8 hot
-    path), and odd row counts must take the XLA fallback unchanged."""
+    path), including row counts not divisible by the 8-row Mosaic
+    sublane — those now pad up to a multiple of 8 inside the kernel
+    path and slice the outputs back (per-row scales make pad rows
+    inert), instead of falling back to the multi-HBM-trip XLA twin."""
     import jax.numpy as jnp
 
     from nnstreamer_tpu.backends.pallas_ops import quantize_rows
@@ -84,11 +87,16 @@ def test_quantize_rows_kernel_exact_and_fallback():
     ref = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
     assert np.array_equal(np.asarray(q), ref)
     np.testing.assert_allclose(np.asarray(s), scale, rtol=1e-6)
-    # kernel path vs fallback path agree through the full matmul
+    # ragged M (5 % 8 != 0): padded kernel path, outputs sliced to M
+    q5, s5 = quantize_rows(jnp.asarray(x[:5]))
+    assert np.asarray(q5).shape == (5, 128)
+    assert np.array_equal(np.asarray(q5), ref[:5])
+    np.testing.assert_allclose(np.asarray(s5), scale[:5], rtol=1e-6)
+    # aligned and ragged paths agree through the full matmul
     w = rng.normal(size=(128, 32)).astype(np.float32)
     wq, ws = quantize_weight(jnp.asarray(w))
     kernel_out = np.asarray(w8a8_matmul(jnp.asarray(x), wq, ws))  # 48 % 8 == 0
-    fb_out = np.asarray(w8a8_matmul(jnp.asarray(x[:5]), wq, ws))   # 5: fallback
+    fb_out = np.asarray(w8a8_matmul(jnp.asarray(x[:5]), wq, ws))   # 5: padded
     assert kernel_out.shape == (48, 32)
     assert fb_out.shape == (5, 32)
     np.testing.assert_allclose(fb_out, kernel_out[:5], rtol=1e-5, atol=1e-5)
