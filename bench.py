@@ -181,7 +181,31 @@ class _Bench:
             "fps": round(fps, 2),
             "p50_ms": round(_percentile(lats, 50), 3),
             "p99_ms": round(_percentile(lats, 99), 3),
+            # per-stage trajectory for future perf PRs: the untraced
+            # runner's always-on counters (tracing stays off so fps/lat
+            # numbers remain comparable across rounds)
+            "stages": _stage_summary(self.runner),
         }
+
+
+def _stage_summary(runner) -> dict:
+    """Condense runner.stats() into the per-element numbers worth
+    keeping in the BENCH artifact: proctime, queue high-water, drops,
+    and backend compile-cache behavior."""
+    out = {}
+    for name, d in runner.stats().items():
+        row = {
+            "buffers": d.get("buffers", 0),
+            "proctime_total_ms": round(d.get("proctime_total_s", 0.0) * 1e3, 3),
+            "proctime_avg_us": round(d.get("proctime_avg_us", 0.0), 1),
+            "queue_peak": d.get("queue_peak", 0),
+        }
+        for k in ("backend_compile_count", "backend_cache_hits",
+                  "backend_cache_misses", "timer_fires", "dropped"):
+            if d.get(k):
+                row[k] = d[k]
+        out[name] = row
+    return out
 
 
 def _on_tpu() -> bool:

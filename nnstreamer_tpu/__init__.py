@@ -41,6 +41,7 @@ __all__ = [
     "parse_launch",
     "run_pipeline",
     "PipelineRunner",
+    "Tracer",
     "register_custom_easy",
     "__version__",
 ]
@@ -50,6 +51,7 @@ _LAZY = {
     "parse_launch": ("nnstreamer_tpu.graph.parse", "parse_launch"),
     "run_pipeline": ("nnstreamer_tpu.runtime.scheduler", "run_pipeline"),
     "PipelineRunner": ("nnstreamer_tpu.runtime.scheduler", "PipelineRunner"),
+    "Tracer": ("nnstreamer_tpu.runtime.tracing", "Tracer"),
     "register_custom_easy": ("nnstreamer_tpu.backends.custom",
                              "register_custom_easy"),
 }

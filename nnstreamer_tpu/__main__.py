@@ -6,6 +6,8 @@
     python -m nnstreamer_tpu --inspect tensor_filter   # element detail
     python -m nnstreamer_tpu --models                  # list zoo models
     python -m nnstreamer_tpu --stats '...pipeline...'  # per-element stats
+    python -m nnstreamer_tpu trace '...pipeline...'    # traced run: report
+                                                       #  + Chrome trace JSON
 """
 
 from __future__ import annotations
@@ -50,7 +52,49 @@ def _models() -> int:
     return 0
 
 
+def _trace_main(argv) -> int:
+    """`trace` subcommand: run a pipeline with the tracer on, print the
+    observability report, write a Chrome-trace JSON (Perfetto /
+    chrome://tracing). The pipeline description needs no changes —
+    tracing is a runner-level switch."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu trace",
+        description="run a pipeline traced: element report + Chrome trace")
+    ap.add_argument("pipeline", help="pipeline description string")
+    ap.add_argument("--out", default="trace.json", metavar="FILE",
+                    help="Chrome-trace JSON output path (default trace.json)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="max run seconds")
+    ap.add_argument("--no-optimize", action="store_true",
+                    help="disable transform-into-filter fusion")
+    args = ap.parse_args(argv)
+
+    import nnstreamer_tpu as nns
+
+    pipe = nns.parse_launch(args.pipeline)
+    runner = nns.PipelineRunner(pipe, optimize=not args.no_optimize,
+                                trace=True)
+    interrupted = False
+    try:
+        runner.start()
+        runner.wait(args.timeout)
+    except KeyboardInterrupt:
+        interrupted = True
+        print("interrupted — writing partial trace", file=sys.stderr)
+    finally:
+        runner.stop()
+    with open(args.out, "w") as f:
+        json.dump(runner.tracer.to_chrome_trace(pipe.name), f)
+    print(runner.report())
+    print(f"chrome trace written to {args.out} "
+          f"(load in Perfetto or chrome://tracing)", file=sys.stderr)
+    return 130 if interrupted else 0
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "trace":
+        return _trace_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu",
         description="TPU-native streaming AI pipelines (gst-launch parity)")

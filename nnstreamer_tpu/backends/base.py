@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 from nnstreamer_tpu.core.errors import BackendError
 from nnstreamer_tpu.core.registry import PluginKind, registry
+from nnstreamer_tpu.runtime.tracing import NULL_TRACER
 from nnstreamer_tpu.tensor.info import TensorsSpec
 
 ArrayTuple = Tuple[Any, ...]
@@ -31,6 +32,11 @@ class FilterBackend:
     """One model-execution engine instance (per tensor_filter element)."""
 
     BACKEND_NAME: str = ""
+    #: tracing hooks — the owning tensor_filter forwards the session
+    #: tracer (and its element name) at start(), so backends can record
+    #: compile/invoke spans onto the element's track when tracer.active
+    tracer = NULL_TRACER
+    trace_name: str = ""
 
     def open(self, props: Dict[str, Any]) -> None:
         """Load the model described by element properties (fw->open)."""

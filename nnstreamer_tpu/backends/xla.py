@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import importlib
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -124,6 +125,10 @@ class XLABackend(FilterBackend):
         self._batch_ok: Dict[tuple, bool] = {}   # batchability verdicts
         self._dynamic_spatial = False
         self.compile_count = 0   # traces, observable for bucketing tests
+        # bucket-cache behavior (_bucket_jit), surfaced in stats() via
+        # tensor_filter.extra_stats and in backend trace spans
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     # -- open / model resolution ------------------------------------------
     def open(self, props: Dict[str, Any]) -> None:
@@ -362,13 +367,22 @@ class XLABackend(FilterBackend):
         if self._bundle.host_pre is not None:
             tensors = tuple(self._bundle.host_pre(tuple(tensors)))
         params = self._packed_params()
-        if self._jitted is None:
+        fresh = self._jitted is None
+        if fresh:
             self._jitted = jax.jit(self._full_fn())
         # explicit async H2D staging before dispatch: on tunneled/remote
         # devices this overlaps the transfer with the previous frame's
         # compute (measured ~3.6x e2e FPS vs jit-internal staging)
         staged = tuple(jax.device_put(t, self._device) for t in tensors)
-        out = self._jitted(params, *staged)
+        tr = self.tracer
+        if tr.active:
+            t0 = time.perf_counter()
+            out = self._jitted(params, *staged)
+            tr.backend_span(self.trace_name or "xla", "invoke", t0,
+                            time.perf_counter(),
+                            compile="fresh" if fresh else "cached")
+        else:
+            out = self._jitted(params, *staged)
         return _to_tuple(out)
 
     # -- flexible shapes (invoke-dynamic analog) ---------------------------
@@ -513,9 +527,19 @@ class XLABackend(FilterBackend):
                 [a, np_.repeat(a[-1:], nb - n, axis=0)], axis=0)
                 for a in arrs]
         params = self._packed_params()
+        hits0 = self.cache_hits
         jitted = self._bucket_jit(("dynb", nb) + batched_shapes)
         staged = tuple(jax.device_put(a, self._device) for a in arrs)
-        out = _to_tuple(jitted(params, *staged))
+        tr = self.tracer
+        if tr.active:
+            t0 = time.perf_counter()
+            out = _to_tuple(jitted(params, *staged))
+            tr.backend_span(self.trace_name or "xla", "invoke_batched",
+                            t0, time.perf_counter(), n=n, bucket=nb,
+                            cache="hit" if self.cache_hits > hits0
+                            else "miss")
+        else:
+            out = _to_tuple(jitted(params, *staged))
         return tuple(o[:n] for o in out)
 
     def _bucket_jit(self, key: tuple):
@@ -523,10 +547,13 @@ class XLABackend(FilterBackend):
 
         jitted = self._dyn_jits.pop(key, None)
         if jitted is None:
+            self.cache_misses += 1
             jitted = jax.jit(self._full_fn())
             if len(self._dyn_jits) >= self._dyn_cache_max:
                 evicted, _ = self._dyn_jits.popitem(last=False)
                 log.info("dyn-shape cache full: evicted %s", evicted)
+        else:
+            self.cache_hits += 1
         self._dyn_jits[key] = jitted      # re-insert = LRU touch
         return jitted
 

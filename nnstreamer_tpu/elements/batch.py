@@ -185,6 +185,10 @@ class TensorBatch(Element):
         self.occupancy_hist[n] = self.occupancy_hist.get(n, 0) + 1
         setattr(self, "flush_" + reason,
                 getattr(self, "flush_" + reason) + 1)
+        if self._tracer.active:
+            # flush markers make batch assembly visible in the trace:
+            # full vs deadline flushes with occupancy, per flush
+            self._tracer.instant(self.name, "flush_" + reason, n=n)
         batched = []
         for j, keep in enumerate(self._keepdims):
             rows = [f["tensors"][j] for f in frames]

@@ -3,12 +3,14 @@
 Reference parity: gsttensor_debug.c (:29) — prints caps/meta of passing
 buffers. Here it logs spec + per-buffer summary (shape/dtype/pts/device
 residency) through the framework logger, with `output=console|log` and a
-`capture` list for tests.
+`capture` deque for tests (bounded by `capture-limit` so a long-running
+pipeline can't grow it without bound).
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from collections import deque
+from typing import Deque, List, Sequence
 
 import numpy as np
 
@@ -27,11 +29,16 @@ class TensorDebug(Element):
         "output": PropDef(str, "log", "log|console"),
         "verbose": PropDef(prop_bool, False, "include value stats"),
         "capture": PropDef(prop_bool, False, "record lines in .lines"),
+        "capture_limit": PropDef(int, 1000,
+                                 "max captured lines kept (oldest dropped)"),
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self.lines: List[str] = []
+        limit = max(1, int(self.props["capture_limit"]))
+        self.lines: Deque[str] = deque(maxlen=limit)
+        self.buffers_seen = 0
+        self._captured_total = 0
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         self._say(f"{self.name}: negotiated {in_specs[0]}")
@@ -40,12 +47,14 @@ class TensorDebug(Element):
     def _say(self, line: str) -> None:
         if self.props["capture"]:
             self.lines.append(line)
+            self._captured_total += 1
         if self.props["output"] == "console":
             print(line)
         else:
             log.info("%s", line)
 
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        self.buffers_seen += 1
         desc = repr(buf)
         if self.props["verbose"]:
             stats = []
@@ -59,3 +68,10 @@ class TensorDebug(Element):
             desc += " [" + "; ".join(stats) + "]"
         self._say(f"{self.name}: {desc}")
         return [(0, buf)]
+
+    def extra_stats(self) -> dict:
+        return {
+            "buffers_seen": self.buffers_seen,
+            "captured_lines": len(self.lines),
+            "capture_dropped": self._captured_total - len(self.lines),
+        }

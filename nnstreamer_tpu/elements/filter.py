@@ -337,10 +337,25 @@ class TensorFilter(Element):
 
     def start(self) -> None:
         self._t_start = time.monotonic()
+        if self.backend is not None:
+            # hand the runner's tracer down so backend compile/invoke
+            # spans land on this element's trace track
+            self.backend.tracer = self._tracer
+            self.backend.trace_name = self.name
 
     def stop(self) -> None:
         if self.backend is not None:
             self.backend.close()
+
+    def extra_stats(self) -> dict:
+        """Backend compile/cache counters merged into this element's
+        stats() row (absent for backends that don't track them)."""
+        out = {}
+        for k in ("compile_count", "cache_hits", "cache_misses"):
+            v = getattr(self.backend, k, None)
+            if v is not None:
+                out["backend_" + k] = v
+        return out
 
     # -- hot loop (reference §3.2) -----------------------------------------
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:

@@ -54,6 +54,19 @@ def prop_bool(s) -> bool:
     return str(s).strip().lower() in ("1", "true", "yes", "on")
 
 
+class _InertTracer:
+    """Tracing stub for elements outside a running pipeline: `.active`
+    is False and nothing else is ever called behind that guard.
+    PipelineRunner.start() swaps in the session tracer (the real hook
+    API lives in runtime/tracing.py; this stub exists here only to break
+    the graph→runtime import cycle)."""
+
+    active = False
+
+
+_NO_TRACE = _InertTracer()
+
+
 class Element:
     """Base pipeline element.
 
@@ -69,6 +82,10 @@ class Element:
     #: scheduler starts async D2H copies when queueing buffers toward it,
     #: overlapping transfers with other in-flight frames
     WANTS_HOST: bool = False
+    #: tracing hook surface — the runner assigns the session tracer to
+    #: every element before start(); elements emit custom events with
+    #: `if self._tracer.active: self._tracer.instant(self.name, ...)`
+    _tracer = _NO_TRACE
 
     def __init__(self, name: Optional[str] = None, **props):
         self.name = name or f"{self.ELEMENT_NAME}{id(self) & 0xFFFF:x}"

@@ -1,9 +1,11 @@
 """Streaming runtime — the scheduler substrate GStreamer provides the
 reference (threads, queues, backpressure, EOS/error propagation)."""
 
+from nnstreamer_tpu.runtime.tracing import NULL_TRACER, NullTracer, Tracer
 from nnstreamer_tpu.runtime.scheduler import EOS, PipelineRunner, run_pipeline
 from nnstreamer_tpu.runtime.input_pipeline import (
     DeviceFeeder, prefetch_to_device)
 
 __all__ = ["PipelineRunner", "run_pipeline", "EOS",
+           "Tracer", "NullTracer", "NULL_TRACER",
            "DeviceFeeder", "prefetch_to_device"]

@@ -30,6 +30,7 @@ from nnstreamer_tpu.core.config import get_config
 from nnstreamer_tpu.core.errors import PipelineError, StreamError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.graph.pipeline import Element, Link, Pipeline, SourceElement
+from nnstreamer_tpu.runtime.tracing import NULL_TRACER, Tracer
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 
 log = get_logger("runtime")
@@ -50,7 +51,7 @@ class ElementStats:
     instead of out-sourced. Read via PipelineRunner.stats()."""
 
     __slots__ = ("buffers", "total_s", "max_s", "wait_s", "wait_max_s",
-                 "timer_fires")
+                 "timer_fires", "dropped", "queue_peak")
 
     def __init__(self):
         self.buffers = 0
@@ -65,6 +66,12 @@ class ElementStats:
         # deadline wakeups delivered to on_timer() (tensor_batch
         # max-latency flushes fire through here)
         self.timer_fires = 0
+        # buffers this element emitted that teardown aborted mid-put
+        # (counted on the *producer* so the loss is attributable)
+        self.dropped = 0
+        # high-water mark of this element's input queue (queuelevel
+        # tracer analog; capacity is the runner's queue_capacity)
+        self.queue_peak = 0
 
     def record(self, dt: float) -> None:
         self.buffers += 1
@@ -88,14 +95,24 @@ class ElementStats:
                 "queue_wait_avg_us": (1e6 * self.wait_s / self.buffers
                                       if self.buffers else 0.0),
                 "queue_wait_max_us": 1e6 * self.wait_max_s,
-                "timer_fires": self.timer_fires}
+                "timer_fires": self.timer_fires,
+                "dropped": self.dropped,
+                "queue_peak": self.queue_peak}
 
 
 class PipelineRunner:
     def __init__(self, pipeline: Pipeline, queue_capacity: Optional[int] = None,
-                 optimize: bool = True):
+                 optimize: bool = True, trace=False):
         self.pipeline = pipeline
         self._optimize = optimize
+        # trace=False → NULL_TRACER (hot path pays one attribute load);
+        # trace=True → fresh Tracer; or pass a Tracer/NullTracer directly
+        if hasattr(trace, "active"):
+            self.tracer = trace
+        elif trace:
+            self.tracer = Tracer()
+        else:
+            self.tracer = NULL_TRACER
         cap = queue_capacity or get_config().get_int("runtime", "queue_capacity", 4)
         self._cap = max(1, cap)
         self._queues: Dict[str, "queue.Queue"] = {}
@@ -124,6 +141,9 @@ class PipelineRunner:
             self._stats.setdefault(name, ElementStats())
         for e in pipe.elements.values():
             e._event_router = self._route_upstream
+            # tracer handed down before start() so elements can forward
+            # it further (tensor_filter → backend invoke/compile spans)
+            e._tracer = self.tracer
             e.start()
         for l in pipe.links:
             self._route[(l.src.name, l.src_pad)] = l
@@ -151,6 +171,15 @@ class PipelineRunner:
             t.join(remaining)
             if t.is_alive():
                 self.stop()
+                if self._error is not None:
+                    # the hang is a symptom: a worker already failed and
+                    # a peer never drained — surface the root cause, not
+                    # a bare timeout that swallows it
+                    raise StreamError(
+                        f"pipeline {self.pipeline.name!r} failed: "
+                        f"{self._error} (thread {t.name} then did not "
+                        f"finish within {timeout}s)"
+                    ) from self._error
                 raise StreamError(
                     f"pipeline {self.pipeline.name!r} did not finish within "
                     f"{timeout}s (thread {t.name} still running)"
@@ -209,6 +238,69 @@ class PipelineRunner:
             out[name] = d
         return out
 
+    def report(self) -> str:
+        """Human-readable observability report: per-element proctime
+        table (sorted by total processing time, heaviest first), per-link
+        queue high-water marks, and — when tracing is on — interlatency
+        percentiles per element with sinks marked (the sink rows are the
+        end-to-end pipeline latency) and backend compile/cache counters.
+        """
+        st = self.stats()
+        lines = [f"pipeline {self.pipeline.name!r} — element report",
+                 "",
+                 f"{'element':<22} {'buffers':>8} {'total ms':>9} "
+                 f"{'avg µs':>9} {'max µs':>9} {'wait µs':>9} "
+                 f"{'q.peak':>6} {'drop':>5} {'timer':>6}"]
+        for name, d in sorted(st.items(),
+                              key=lambda kv: -kv[1]["proctime_total_s"]):
+            lines.append(
+                f"{name:<22} {d['buffers']:>8} "
+                f"{d['proctime_total_s'] * 1e3:>9.2f} "
+                f"{d['proctime_avg_us']:>9.1f} {d['proctime_max_us']:>9.1f} "
+                f"{d['queue_wait_avg_us']:>9.1f} {d['queue_peak']:>6} "
+                f"{d['dropped']:>5} {d['timer_fires']:>6}")
+        lines.append("")
+        lines.append(f"queue high-water (capacity {self._cap}):")
+        for l in self.pipeline.links:
+            d = st.get(l.dst.name)
+            if d is None:
+                continue
+            lines.append(f"  {l.src.name} → {l.dst.name}: "
+                         f"peak {d['queue_peak']}/{self._cap}")
+        tr = self.tracer
+        if tr.active:
+            inter = tr.interlatency()
+            if inter:
+                sinks = {e.name for e in self.pipeline.elements.values()
+                         if not self.pipeline.links_from(e)}
+                lines.append("")
+                lines.append("interlatency source → element (ms):")
+                lines.append(f"  {'element':<22} {'n':>6} {'p50':>8} "
+                             f"{'p95':>8} {'p99':>8} {'max':>8}")
+                for name, r in sorted(inter.items(),
+                                      key=lambda kv: kv[1]["p50_ms"]):
+                    mark = " (sink)" if name in sinks else ""
+                    lines.append(
+                        f"  {name + mark:<22} {r['n']:>6} "
+                        f"{r['p50_ms']:>8.3f} {r['p95_ms']:>8.3f} "
+                        f"{r['p99_ms']:>8.3f} {r['max_ms']:>8.3f}")
+            if tr.events_dropped:
+                lines.append("")
+                lines.append(f"note: event ring wrapped, "
+                             f"{tr.events_dropped} oldest events dropped")
+        backend_rows = [
+            (name, {k: v for k, v in d.items() if k.startswith("backend_")})
+            for name, d in st.items()]
+        backend_rows = [(n, b) for n, b in backend_rows if b]
+        if backend_rows:
+            lines.append("")
+            lines.append("backend counters:")
+            for name, b in backend_rows:
+                kv = " ".join(f"{k[len('backend_'):]}={v}"
+                              for k, v in sorted(b.items()))
+                lines.append(f"  {name}: {kv}")
+        return "\n".join(lines)
+
     # -- internals ---------------------------------------------------------
     def _route_upstream(self, origin: Element, event: dict) -> None:
         """Walk the link graph upstream from `origin`, offering `event`
@@ -255,22 +347,47 @@ class PipelineRunner:
             item.prefetch_host()
         q = self._queues[link.dst.name]
         t_enq = time.perf_counter()
+        tr = self.tracer
         while not self._stop_evt.is_set():
             try:
                 q.put((link.dst_pad, item, t_enq), timeout=0.1)
-                return
             except queue.Full:
                 continue
+            # queuelevel gauge: the high-water mark is always-on (one
+            # qsize() per enqueue, same spirit as the wait counters);
+            # the full depth time-series is tracer-gated
+            depth = q.qsize()
+            dst_stats = self._stats.get(link.dst.name)
+            if dst_stats is not None and depth > dst_stats.queue_peak:
+                dst_stats.queue_peak = depth
+            if tr.active:
+                tr.enqueue(link.dst.name, depth, time.perf_counter())
+            return
+        # _stop_evt aborted the put loop: the buffer is lost. Count it
+        # so teardown/failure losses are visible in stats() instead of
+        # vanishing silently (EOS is not a payload — no loss to count).
+        if item is not EOS:
+            stats = self._stats.get(elem.name)
+            if stats is not None:
+                stats.dropped += 1
+            log.debug("teardown dropped a buffer from %s -> %s (pts=%s)",
+                      elem.name, link.dst.name, getattr(item, "pts", None))
+            if tr.active:
+                tr.record_drop(elem.name, time.perf_counter())
 
     def _broadcast_eos(self, elem: Element) -> None:
         for l in self.pipeline.links_from(elem):
             self._emit(elem, l.src_pad, EOS)
 
     def _pump(self, src: SourceElement) -> None:
+        tr = self.tracer
         try:
             for buf in src.generate():
                 if self._stop_evt.is_set():
                     break
+                if tr.active:
+                    # interlatency origin: stamp the pipeline-entry time
+                    tr.source_emit(src.name, buf, time.perf_counter())
                 self._emit(src, 0, buf)
             self._broadcast_eos(src)
         except Exception as e:
@@ -285,6 +402,7 @@ class PipelineRunner:
         n_pads = max(1, len(self.pipeline.links_to(elem)))
         eos_pads = set()
         stats = self._stats[elem.name]
+        tr = self.tracer
         try:
             while not self._stop_evt.is_set():
                 # deadline-aware wait: an element holding half-assembled
@@ -300,19 +418,29 @@ class PipelineRunner:
                         stats.timer_fires += 1
                         for sp, b in elem.on_timer():
                             self._emit(elem, sp, b)
+                        if tr.active:
+                            tr.record_timer(elem.name, now,
+                                            time.perf_counter())
                         continue
                     timeout = min(0.1, deadline - now)
                 try:
                     pad, item, t_enq = q.get(timeout=timeout)
                 except queue.Empty:
                     continue
+                if tr.active:
+                    tr.dequeue(elem.name, q.qsize(), time.perf_counter())
                 if item is EOS:
                     if pad is None:  # teardown wakeup
                         return
                     eos_pads.add(pad)
                     if len(eos_pads) >= n_pads:
+                        t0 = time.perf_counter()
                         for sp, b in elem.flush():
                             self._emit(elem, sp, b)
+                        if tr.active:
+                            tr.record_flush(elem.name, t0,
+                                            time.perf_counter())
+                            tr.record_eos(elem.name, time.perf_counter())
                         self._broadcast_eos(elem)
                         return
                     continue
@@ -320,7 +448,10 @@ class PipelineRunner:
                 if t_enq:
                     stats.record_wait(t0 - t_enq)
                 emissions = elem.process(pad, item)
-                stats.record(time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                stats.record(t1 - t0)
+                if tr.active:
+                    tr.record_process(elem.name, item, t0, t1)
                 for sp, b in emissions:
                     self._emit(elem, sp, b)
         except Exception as e:

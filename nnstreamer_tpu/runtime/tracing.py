@@ -1,0 +1,282 @@
+"""Pipeline tracing: span events, interlatency, queue gauges, Chrome trace.
+
+The reference outsources pipeline observability to external GstShark
+tracers (SURVEY.md §5.1); here the four tracers that matter for pipeline
+tuning are first-class runtime citizens:
+
+- proctime    → always-on `ElementStats` (scheduler.py) + "X" span events
+                per element invocation when tracing is on
+- interlatency→ per-buffer source-timestamp tagging: every source emit
+                stamps `buf.meta[SOURCE_TS_META]`; every downstream
+                element records (now - source_ts) into a bounded
+                reservoir, giving p50/p95/p99 end-to-end latency *per
+                element* (the sink rows are the pipeline latency)
+- queuelevel  → queue-depth gauges sampled at enqueue/dequeue ("C"
+                counter events) + an always-on per-queue high-water mark
+- framerate   → tensor_filter's native throughput prop (stats() rows)
+
+Two implementations share one duck type: `Tracer` (recording) and
+`NullTracer` (`NULL_TRACER`, the default). The scheduler keeps hooks out
+of the hot path by guarding every call site with `if tracer.active:` —
+a traced-off run pays one attribute load per buffer, nothing else.
+
+Ring-buffer discipline: events land in a `collections.deque(maxlen=N)`.
+`deque.append` is atomic under the GIL, so worker threads record without
+a lock; when the ring wraps, the oldest events fall off and
+`events_dropped` in `summary()` says how many.
+
+Export: `to_chrome_trace()` emits the Trace Event Format JSON that
+chrome://tracing and Perfetto load — one named track (tid) per element
+thread, "X" complete spans for process/timer/flush/backend work, "C"
+counters for queue depth, "i" instants for EOS/drops/batch flushes.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+#: TensorBuffer.meta key carrying the pipeline-entry timestamp
+#: (time.perf_counter seconds, stamped by the scheduler at source emit).
+#: `with_tensors` copies meta, and tensor_batch carries per-frame metas
+#: through `dyn_batch.frames`, so the stamp survives every element.
+SOURCE_TS_META = "_trace_src_ts"
+
+
+def percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    k = max(1, min(len(sorted_vals),
+                   math.ceil(p / 100.0 * len(sorted_vals))))
+    return sorted_vals[k - 1]
+
+
+class NullTracer:
+    """Do-nothing tracer: the default. Every hook exists so callers can
+    skip the `.active` guard where the call is not on a hot path."""
+
+    active = False
+
+    def source_emit(self, name, buf, t):
+        pass
+
+    def enqueue(self, dst, depth, t):
+        pass
+
+    def dequeue(self, name, depth, t):
+        pass
+
+    def record_process(self, name, buf, t0, t1):
+        pass
+
+    def record_timer(self, name, t0, t1):
+        pass
+
+    def record_flush(self, name, t0, t1):
+        pass
+
+    def record_eos(self, name, t):
+        pass
+
+    def record_drop(self, name, t):
+        pass
+
+    def backend_span(self, name, kind, t0, t1, **args):
+        pass
+
+    def instant(self, name, label, t=None, **args):
+        pass
+
+
+#: shared no-op singleton — scheduler, elements and backends all default
+#: to this; PipelineRunner(trace=True) swaps in a recording Tracer
+NULL_TRACER = NullTracer()
+
+# event tuple layout: (ph, cat, name, label, ts, dur, args)
+_Event = Tuple[str, str, str, str, float, float, Any]
+
+
+class Tracer:
+    """Recording tracer fed by the scheduler's hook points.
+
+    All hooks are called from element worker threads; state is designed
+    so no lock is needed: the event ring is an atomic-append deque, each
+    element's interlatency reservoir is touched only by that element's
+    own worker, and the gauge peak update is a benign read-modify-write
+    (a lost race costs one sample, never a crash).
+    """
+
+    active = True
+
+    def __init__(self, max_events: int = 65536,
+                 max_latency_samples: int = 8192):
+        self._t0 = time.perf_counter()
+        self._events: Deque[_Event] = deque(maxlen=max_events)
+        self._total_events = 0
+        self._max_latency_samples = max_latency_samples
+        # element name -> reservoir of (t_done - t_source_emit) seconds
+        self._interlat: Dict[str, Deque[float]] = {}
+        # dst element name -> {"peak": max depth ever sampled}
+        self._gauges: Dict[str, Dict[str, int]] = {}
+
+    # -- scheduler hooks ---------------------------------------------------
+    def source_emit(self, name: str, buf, t: float) -> None:
+        """Stamp the buffer's pipeline-entry time (interlatency origin)."""
+        meta = getattr(buf, "meta", None)
+        if isinstance(meta, dict):
+            meta[SOURCE_TS_META] = t
+        self._append("i", "source", name, "emit", t, 0.0, None)
+
+    def enqueue(self, dst: str, depth: int, t: float) -> None:
+        self._gauge(dst, depth, t)
+
+    def dequeue(self, name: str, depth: int, t: float) -> None:
+        self._gauge(name, depth, t)
+
+    def record_process(self, name: str, buf, t0: float, t1: float) -> None:
+        self._append("X", "element", name, "process", t0, t1 - t0, None)
+        src_ts = self._buf_source_ts(buf)
+        if src_ts is not None:
+            r = self._interlat.get(name)
+            if r is None:
+                r = self._interlat[name] = deque(
+                    maxlen=self._max_latency_samples)
+            r.append(t1 - src_ts)
+
+    def record_timer(self, name: str, t0: float, t1: float) -> None:
+        self._append("X", "element", name, "timer", t0, t1 - t0, None)
+
+    def record_flush(self, name: str, t0: float, t1: float) -> None:
+        self._append("X", "element", name, "flush", t0, t1 - t0, None)
+
+    def record_eos(self, name: str, t: float) -> None:
+        self._append("i", "element", name, "eos", t, 0.0, None)
+
+    def record_drop(self, name: str, t: float) -> None:
+        self._append("i", "element", name, "buffer_dropped", t, 0.0, None)
+
+    def backend_span(self, name: str, kind: str, t0: float, t1: float,
+                     **args) -> None:
+        """Backend-side span (compile/invoke) attributed to the owning
+        tensor_filter's track; args carry bucket/cache-hit details."""
+        self._append("X", "backend", name, kind, t0, t1 - t0, args or None)
+
+    def instant(self, name: str, label: str, t: Optional[float] = None,
+                **args) -> None:
+        if t is None:
+            t = time.perf_counter()
+        self._append("i", "element", name, label, t, 0.0, args or None)
+
+    # -- internals ---------------------------------------------------------
+    def _append(self, ph: str, cat: str, name: str, label: str,
+                ts: float, dur: float, args) -> None:
+        self._total_events += 1
+        self._events.append((ph, cat, name, label, ts, dur, args))
+
+    def _gauge(self, dst: str, depth: int, t: float) -> None:
+        g = self._gauges.get(dst)
+        if g is None:
+            g = self._gauges[dst] = {"peak": 0}
+        if depth > g["peak"]:
+            g["peak"] = depth
+        self._append("C", "queue", dst, "queue_depth", t, 0.0, depth)
+
+    @staticmethod
+    def _buf_source_ts(buf) -> Optional[float]:
+        """Earliest source timestamp reachable from `buf` — the direct
+        stamp, or for a micro-batch the oldest frame's stamp (the
+        deadline-bound frame is the one whose latency matters)."""
+        meta = getattr(buf, "meta", None)
+        if not isinstance(meta, dict):
+            return None
+        ts = meta.get(SOURCE_TS_META)
+        if ts is not None:
+            return ts
+        db = meta.get("dyn_batch")
+        if isinstance(db, dict):
+            stamps = [f["meta"][SOURCE_TS_META]
+                      for f in db.get("frames", ())
+                      if isinstance(f.get("meta"), dict)
+                      and SOURCE_TS_META in f["meta"]]
+            if stamps:
+                return min(stamps)
+        return None
+
+    # -- read-out ----------------------------------------------------------
+    def events(self) -> List[_Event]:
+        return list(self._events)
+
+    @property
+    def events_dropped(self) -> int:
+        return max(0, self._total_events - len(self._events))
+
+    def interlatency(self) -> Dict[str, dict]:
+        """Per-element end-to-end latency percentiles (ms) from source
+        emit to completion of that element's process()."""
+        out = {}
+        for name, r in self._interlat.items():
+            vals = sorted(r)
+            if not vals:
+                continue
+            out[name] = {
+                "n": len(vals),
+                "p50_ms": 1e3 * percentile(vals, 50),
+                "p95_ms": 1e3 * percentile(vals, 95),
+                "p99_ms": 1e3 * percentile(vals, 99),
+                "max_ms": 1e3 * vals[-1],
+            }
+        return out
+
+    def queue_gauges(self) -> Dict[str, dict]:
+        return {name: dict(g) for name, g in self._gauges.items()}
+
+    def summary(self) -> dict:
+        return {
+            "interlatency": self.interlatency(),
+            "queues": self.queue_gauges(),
+            "events": len(self._events),
+            "events_dropped": self.events_dropped,
+        }
+
+    def to_chrome_trace(self, pipeline_name: str = "pipeline") -> dict:
+        """Trace Event Format dict — `json.dump` it and load the file in
+        Perfetto or chrome://tracing. One track (tid) per element, in
+        order of first appearance; ts/dur in µs relative to tracer
+        creation."""
+        trace: List[dict] = [{
+            "ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+            "args": {"name": pipeline_name},
+        }]
+        tids: Dict[str, int] = {}
+
+        def tid_of(name: str) -> int:
+            t = tids.get(name)
+            if t is None:
+                t = tids[name] = len(tids) + 1
+                trace.append({"ph": "M", "name": "thread_name",
+                              "pid": 0, "tid": t,
+                              "args": {"name": name}})
+            return t
+
+        for ph, cat, name, label, ts, dur, args in list(self._events):
+            us = round((ts - self._t0) * 1e6, 3)
+            if ph == "X":
+                ev = {"ph": "X", "cat": cat, "name": label, "pid": 0,
+                      "tid": tid_of(name), "ts": us,
+                      "dur": round(dur * 1e6, 3)}
+                if args:
+                    ev["args"] = dict(args)
+            elif ph == "C":
+                ev = {"ph": "C", "cat": cat, "name": f"queue:{name}",
+                      "pid": 0, "tid": 0, "ts": us,
+                      "args": {"depth": args}}
+            else:  # "i" instant, scoped to the element's thread track
+                ev = {"ph": "i", "cat": cat, "name": label, "pid": 0,
+                      "tid": tid_of(name), "ts": us, "s": "t"}
+                if args:
+                    ev["args"] = dict(args)
+            trace.append(ev)
+        return {"traceEvents": trace, "displayTimeUnit": "ms"}

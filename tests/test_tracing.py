@@ -1,0 +1,381 @@
+"""Tracing subsystem: span events, interlatency percentiles, queue
+gauges, Chrome-trace export, drop/error accounting (CPU-only; timing
+assertions use budgets generous enough for CI jitter)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu import parse_launch, register_custom_easy, run_pipeline
+from nnstreamer_tpu.backends.custom import unregister_custom_easy
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.runtime.scheduler import PipelineRunner
+from nnstreamer_tpu.runtime.tracing import (
+    NULL_TRACER, SOURCE_TS_META, NullTracer, Tracer, percentile)
+
+
+@pytest.fixture(autouse=True)
+def _clean_models():
+    names = []
+
+    def reg(name, *a, **kw):
+        names.append(name)
+        return register_custom_easy(name, *a, **kw)
+
+    yield reg
+    for n in names:
+        unregister_custom_easy(n)
+
+
+def _run_traced(desc, timeout=30, **kw):
+    p = parse_launch(desc)
+    runner = PipelineRunner(p, trace=True, **kw).start()
+    try:
+        runner.wait(timeout)
+    finally:
+        runner.stop()
+    return p, runner
+
+
+SLEEP_S = 0.01
+
+
+def _sleepy(ts):
+    time.sleep(SLEEP_S)
+    return ts
+
+
+class TestTracerCore:
+    def test_default_is_noop(self):
+        p = parse_launch("videotestsrc width=4 height=4 num-buffers=2 "
+                         "! tensor_converter ! tensor_sink")
+        runner = PipelineRunner(p)
+        assert runner.tracer is NULL_TRACER
+        assert runner.tracer.active is False
+        runner.start()
+        runner.wait(10)
+        runner.stop()
+        # a NullTracer records nothing and has no ring to inspect
+        assert isinstance(runner.tracer, NullTracer)
+
+    def test_percentile_nearest_rank(self):
+        vals = sorted(float(i) for i in range(1, 101))
+        assert percentile(vals, 50) == 50.0
+        assert percentile(vals, 99) == 99.0
+        assert percentile(vals, 100) == 100.0
+        assert percentile([], 50) == 0.0
+
+    def test_process_spans_per_element_ordered(self):
+        p, runner = _run_traced(
+            "videotestsrc width=4 height=4 num-buffers=6 ! "
+            "tensor_converter name=conv ! tensor_sink name=out")
+        spans = {}
+        for ph, cat, name, label, ts, dur, args in runner.tracer.events():
+            if ph == "X" and label == "process":
+                spans.setdefault(name, []).append((ts, dur))
+        # every non-source element got one process span per buffer...
+        assert len(spans["conv"]) == 6
+        assert len(spans["out"]) == 6
+        # ...in monotonically increasing start order (one worker thread
+        # per element: spans on one track never interleave)
+        for name, ss in spans.items():
+            starts = [t for t, _ in ss]
+            assert starts == sorted(starts)
+            assert all(d >= 0.0 for _, d in ss)
+
+    def test_interlatency_percentiles_sleep_element(self, _clean_models):
+        _clean_models("sleepy", _sleepy)
+        p, runner = _run_traced(
+            "videotestsrc width=4 height=4 num-buffers=8 ! tensor_converter "
+            "! tensor_transform mode=typecast option=float32 "
+            "! tensor_filter framework=custom model=sleepy "
+            "! tensor_sink name=out")
+        inter = runner.tracer.interlatency()
+        assert "out" in inter
+        r = inter["out"]
+        assert r["n"] == 8
+        # every frame crossed the sleeping filter: end-to-end latency at
+        # the sink is at least the sleep, and percentiles are ordered
+        assert r["p50_ms"] >= SLEEP_S * 1e3
+        assert r["p50_ms"] <= r["p95_ms"] <= r["p99_ms"] <= r["max_ms"]
+        # the source-side converter saw the frame before the sleep: its
+        # median must come in under the sink's
+        conv = [v for k, v in inter.items() if k != "out"]
+        assert conv and min(c["p50_ms"] for c in conv) < r["p50_ms"]
+
+    def test_source_ts_stamped_in_meta(self):
+        p, runner = _run_traced(
+            "videotestsrc width=4 height=4 num-buffers=2 ! "
+            "tensor_converter ! tensor_sink name=out")
+        for buf in p.get("out").results:
+            assert SOURCE_TS_META in buf.meta
+
+    def test_queue_highwater_under_backpressure(self):
+        p = parse_launch(
+            "videotestsrc width=4 height=4 num-buffers=20 ! "
+            "tensor_converter ! tensor_sink name=out")
+        sink = p.get("out")
+        orig = sink.render
+
+        def slow_render(buf):
+            time.sleep(0.005)
+            orig(buf)
+
+        sink.render = slow_render
+        runner = PipelineRunner(p, queue_capacity=2, trace=True).start()
+        runner.wait(30)
+        runner.stop()
+        assert len(sink.results) == 20
+        # the slow sink's queue filled to capacity — visible both in the
+        # tracer gauge and the always-on stats high-water mark
+        assert runner.tracer.queue_gauges()["out"]["peak"] >= 2
+        assert runner.stats()["out"]["queue_peak"] >= 2
+
+    def test_event_ring_is_bounded(self):
+        tr = Tracer(max_events=16)
+        for i in range(100):
+            tr.instant("e", "tick", t=float(i))
+        assert len(tr.events()) == 16
+        assert tr.events_dropped == 84
+        # the ring keeps the newest events
+        assert tr.events()[-1][4] == 99.0
+
+
+class TestChromeTrace:
+    def test_schema_and_one_track_per_element(self, _clean_models):
+        _clean_models("ident", lambda ts: ts)
+        p, runner = _run_traced(
+            "videotestsrc width=4 height=4 num-buffers=4 ! "
+            "tensor_converter name=conv "
+            "! tensor_transform mode=typecast option=float32 "
+            "! tensor_filter framework=custom model=ident name=filt "
+            "! tensor_sink name=out")
+        doc = runner.tracer.to_chrome_trace("demo")
+        # valid JSON round-trip of the Trace Event Format container
+        doc = json.loads(json.dumps(doc))
+        assert isinstance(doc["traceEvents"], list)
+        assert doc["displayTimeUnit"] == "ms"
+        tracks = {}
+        for ev in doc["traceEvents"]:
+            assert ev["ph"] in ("M", "X", "C", "i")
+            assert "pid" in ev
+            if ev["ph"] == "M" and ev["name"] == "thread_name":
+                tracks[ev["args"]["name"]] = ev["tid"]
+            if ev["ph"] == "X":
+                assert ev["ts"] >= 0.0 and ev["dur"] >= 0.0
+            if ev["ph"] == "C":
+                assert "depth" in ev["args"]
+        # one named track per element that produced events, unique tids
+        for name in ("conv", "filt", "out"):
+            assert name in tracks
+        assert len(set(tracks.values())) == len(tracks)
+        # spans reference declared tracks only
+        declared = set(tracks.values())
+        for ev in doc["traceEvents"]:
+            if ev["ph"] == "X":
+                assert ev["tid"] in declared
+
+    def test_batch_flush_markers_and_batched_interlatency(self):
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+        desc = ("appsrc name=in dims=4 types=float32 ! "
+                "tensor_batch name=b max-batch=4 max-latency-ms=1000 ! "
+                "tensor_unbatch ! tensor_sink name=out")
+        p = parse_launch(desc)
+        runner = PipelineRunner(p, trace=True).start()
+        src = p.get("in")
+        for i in range(10):
+            src.push(TensorBuffer.of(np.full((4,), float(i), np.float32),
+                                     pts=i))
+        src.end()
+        runner.wait(30)
+        runner.stop()
+        flushes = [(name, label, args) for ph, cat, name, label, ts, dur,
+                   args in runner.tracer.events()
+                   if ph == "i" and label.startswith("flush_")]
+        # 10 frames at max-batch=4 → two full flushes + one EOS flush
+        assert [l for _, l, _ in flushes].count("flush_full") == 2
+        assert [l for _, l, _ in flushes].count("flush_eos") == 1
+        assert {a["n"] for _, _, a in flushes} == {4, 2}
+        # interlatency survives batch→unbatch: per-frame source stamps
+        # ride in the dyn_batch frame metas and are restored downstream
+        inter = runner.tracer.interlatency()
+        assert inter["out"]["n"] == 10
+        # the batcher's own interlatency comes from the oldest frame in
+        # each batch (the deadline-bound one)
+        assert inter["b"]["n"] == 10
+
+    def test_backend_spans_and_cache_counters(self):
+        from nnstreamer_tpu.backends.xla import XLABackend
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+        be = XLABackend()
+        be.open({"model": lambda x: x * 2.0})
+        be.set_input_info(TensorsSpec.of(TensorInfo((1, 4), DType.FLOAT32)))
+        tr = Tracer()
+        be.tracer = tr
+        be.trace_name = "filt"
+        try:
+            for n in (3, 3, 5):
+                x = np.ones((n, 4), np.float32)
+                be.invoke_batched((x,), n, [True])
+        finally:
+            be.close()
+        # 3→bucket 4 (miss), 3→bucket 4 (hit), 5→bucket 8 (miss)
+        assert be.cache_misses == 2
+        assert be.cache_hits == 1
+        spans = [(name, label, args) for ph, cat, name, label, ts, dur,
+                 args in tr.events() if ph == "X" and cat == "backend"]
+        assert len(spans) == 3
+        assert all(name == "filt" and label == "invoke_batched"
+                   for name, label, _ in spans)
+        assert [a["cache"] for _, _, a in spans] == ["miss", "hit", "miss"]
+        assert [a["bucket"] for _, _, a in spans] == [4, 4, 8]
+
+
+class TestReport:
+    def test_report_table_and_sections(self, _clean_models):
+        _clean_models("sleepy", _sleepy)
+        p, runner = _run_traced(
+            "videotestsrc width=4 height=4 num-buffers=4 ! tensor_converter "
+            "! tensor_transform mode=typecast option=float32 "
+            "! tensor_filter framework=custom model=sleepy name=filt "
+            "! tensor_sink name=out")
+        rep = runner.report()
+        assert "element report" in rep
+        assert "queue high-water" in rep
+        assert "interlatency" in rep
+        assert "(sink)" in rep
+        for col in ("buffers", "total ms", "q.peak", "p50", "p99"):
+            assert col in rep
+        # sorted by total proctime: the sleeping filter leads the table
+        table_rows = [l for l in rep.splitlines()
+                      if l.startswith(("filt", "out", "conv"))]
+        assert table_rows and table_rows[0].startswith("filt")
+
+    def test_report_without_tracer_still_has_proctime(self):
+        p = parse_launch("videotestsrc width=4 height=4 num-buffers=2 "
+                         "! tensor_converter ! tensor_sink name=out")
+        runner = PipelineRunner(p).start()
+        runner.wait(10)
+        runner.stop()
+        rep = runner.report()
+        assert "element report" in rep
+        assert "queue high-water" in rep
+        assert "interlatency" not in rep
+
+
+class TestSchedulerAccounting:
+    def test_wait_timeout_chains_pending_error(self, _clean_models):
+        def boom(ts):
+            raise RuntimeError("model exploded")
+
+        _clean_models("boom", boom, infer_out=lambda s: s)
+        # appsrc never ends: the source pump stays alive after the filter
+        # fails, so wait() hits the timeout path WITH a pending error —
+        # the root cause must surface, not a bare timeout
+        p = parse_launch(
+            "appsrc name=in dims=4 types=float32 ! "
+            "tensor_filter framework=custom model=boom ! tensor_sink")
+        runner = PipelineRunner(p).start()
+        p.get("in").push(np.zeros((4,), np.float32))
+        time.sleep(0.2)
+        with pytest.raises(StreamError, match="model exploded"):
+            runner.wait(0.5)
+        runner.stop()
+
+    def test_teardown_drop_counter(self):
+        p = parse_launch(
+            "videotestsrc width=4 height=4 num-buffers=40 ! "
+            "tensor_converter name=conv ! tensor_sink name=out")
+        sink = p.get("out")
+        orig = sink.render
+
+        def crawl(buf):
+            time.sleep(0.2)
+            orig(buf)
+
+        sink.render = crawl
+        runner = PipelineRunner(p, queue_capacity=1).start()
+        time.sleep(0.5)   # producers are now blocked on the full queue
+        runner.stop()
+        runner.wait(10)
+        st = runner.stats()
+        # the aborted put loop counted its lost buffer on the producer
+        assert sum(d["dropped"] for d in st.values()) >= 1
+        # clean EOS runs never drop (covered by every other test here,
+        # asserted once explicitly):
+        p2, r2 = _run_traced("videotestsrc width=4 height=4 num-buffers=3 "
+                             "! tensor_converter ! tensor_sink")
+        assert all(d["dropped"] == 0 for d in r2.stats().values())
+
+    def test_noop_tracer_overhead_smoke(self, _clean_models):
+        _clean_models("sleepy", _sleepy)
+        desc = ("videotestsrc width=4 height=4 num-buffers=12 ! "
+                "tensor_converter "
+                "! tensor_transform mode=typecast option=float32 "
+                "! tensor_filter framework=custom model=sleepy name=filt "
+                "! tensor_sink")
+
+        def proctime(trace):
+            p = parse_launch(desc)
+            runner = PipelineRunner(p, trace=trace).start()
+            runner.wait(30)
+            runner.stop()
+            return runner.stats()["filt"]["proctime_avg_us"]
+
+        off = proctime(False)
+        on = proctime(True)
+        # the filter's work is a 10ms sleep: tracing (off OR on) must be
+        # invisible at this scale — generous 1.5x bound for CI jitter,
+        # the real claim (≤10%) is held by the dyn_batch bench family
+        assert off < SLEEP_S * 1e6 * 1.5
+        assert on < off * 1.5
+
+
+class TestDebugCapture:
+    def test_capture_bounded_and_extra_stats(self):
+        p = parse_launch(
+            "videotestsrc width=4 height=4 num-buffers=12 ! "
+            "tensor_converter ! "
+            "tensor_debug name=dbg capture=true capture-limit=5 ! "
+            "tensor_sink name=out")
+        runner = PipelineRunner(p).start()
+        runner.wait(10)
+        runner.stop()
+        dbg = p.get("dbg")
+        assert len(dbg.lines) == 5            # bounded: oldest dropped
+        st = runner.stats()["dbg"]
+        assert st["buffers_seen"] == 12
+        assert st["captured_lines"] == 5
+        # 12 buffer lines + 1 negotiation line, 5 kept
+        assert st["capture_dropped"] == 8
+        # the deque keeps the newest lines: the (earliest) negotiation
+        # line is among the dropped
+        assert not any("negotiated" in l for l in dbg.lines)
+
+
+class TestCLI:
+    def test_trace_subcommand_writes_valid_trace(self, tmp_path, capsys):
+        from nnstreamer_tpu.__main__ import main
+
+        out = tmp_path / "trace.json"
+        rc = main(["trace",
+                   "videotestsrc width=4 height=4 num-buffers=3 ! "
+                   "tensor_converter ! tensor_sink",
+                   "--out", str(out), "--timeout", "30"])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+        names = {ev["args"]["name"] for ev in doc["traceEvents"]
+                 if ev["ph"] == "M" and ev["name"] == "thread_name"}
+        assert len(names) >= 2       # converter + sink tracks at least
+        captured = capsys.readouterr()
+        assert "element report" in captured.out
+        assert "interlatency" in captured.out
