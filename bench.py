@@ -1197,6 +1197,113 @@ def _cfg_ssd():
                   lag=SSD_MAX_IN_FLIGHT - 1).run(**kw)
 
 
+# -- chaos smoke (docs/robustness.md) ----------------------------------------
+#: seeded so a failing chaos run replays exactly (override to explore)
+CHAOS_SEED = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+
+
+def _splice_fault(pipe, src, **fault_props):
+    """Insert a tensor_fault right after `src` on its first output link
+    (the standard chaos splice point: every downstream stage then sees
+    the injected faults)."""
+    from nnstreamer_tpu.elements.fault import TensorFault
+    from nnstreamer_tpu.graph.pipeline import Link
+
+    link = next(l for l in pipe.links if l.src is src)
+    pipe.links.remove(link)
+    fault = pipe.add(TensorFault(name="chaos", **fault_props))
+    pipe.links.append(Link(src, link.src_pad, fault, 0))
+    pipe.links.append(Link(fault, 0, link.dst, link.dst_pad))
+    return fault
+
+
+def _build_chaos_synthetic():
+    """Model-free chaos target — always runnable, so chaos_smoke can
+    never go vacuously green just because model files are absent."""
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements import FakeSink, TensorTransform
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    pipe = nns.Pipeline("chaos_synthetic")
+    src = AppSrc(spec=TensorsSpec.of(
+        TensorInfo((1, 16, 16, 3), DType.UINT8)), name="src")
+    xf = TensorTransform(name="t", mode="typecast", option="float32")
+    sink = FakeSink(name="sink")
+    for e in (src, xf, sink):
+        pipe.add(e)
+    pipe.link(src, xf)
+    pipe.link(xf, sink)
+    frame = np.random.default_rng(0).integers(
+        0, 256, (1, 16, 16, 3), np.uint8)
+    return pipe, src, sink, frame
+
+
+def _chaos_one(build, n_frames):
+    """Run one pipeline to EOS with a 1%-raising tensor_fault under
+    error-policy=skip; pass iff EOS is reached and every pushed frame is
+    accounted for (emitted + skipped == pushed)."""
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    pipe, src, sink, frame = build()
+    _splice_fault(pipe, src, mode="raise", probability=0.01,
+                  seed=CHAOS_SEED, error_policy="skip")
+    runner = nns.PipelineRunner(pipe, queue_capacity=4).start()
+    try:
+        for i in range(n_frames):
+            f = frame if isinstance(frame, tuple) else (frame,)
+            src.push(TensorBuffer.of(*f, pts=i))
+        src.end()
+        runner.wait(timeout=240)
+    finally:
+        runner.stop()
+    skipped = runner.stats()["chaos"]["skipped"]
+    return {"frames": n_frames, "emitted": sink.count,
+            "faults_injected": pipe.get("chaos").injected,
+            "skipped": skipped,
+            "ok": sink.count + skipped == n_frames}
+
+
+def chaos_smoke() -> dict:
+    """Seeded chaos smoke over representative bench pipelines: each runs
+    once with a spliced tensor_fault (1% raise, error-policy=skip) and
+    must complete to EOS with exact buffer conservation. chaos_ok is
+    True iff every target completed cleanly (the model targets build
+    against the zoo fallback when weight files are absent, and the
+    synthetic target needs no model at all, so nothing is skipped).
+    BENCH_CHAOS_TARGETS=a,b filters targets (tests use synthetic)."""
+    builders = {
+        "synthetic": lambda: _chaos_one(_build_chaos_synthetic, 200),
+        "label_device": lambda: _chaos_one(
+            _build_label_device, 64 if _on_tpu() else 12),
+        "label": lambda: _chaos_one(
+            _build_label, 64 if _on_tpu() else 12),
+    }
+    only = os.environ.get("BENCH_CHAOS_TARGETS", "")
+    if only:
+        keep = {t.strip() for t in only.split(",") if t.strip()}
+        builders = {k: v for k, v in builders.items() if k in keep}
+    out = {"seed": CHAOS_SEED, "pipelines": {}}
+    ran = failed = 0
+    for name, fn in builders.items():
+        try:
+            r = fn()
+            out["pipelines"][name] = r
+            ran += 1
+            if not r["ok"]:
+                failed += 1
+        except Exception as e:
+            out["pipelines"][name] = {
+                "error": f"{type(e).__name__}: {e}"}
+            failed += 1
+    out["chaos_ok"] = ran > 0 and failed == 0
+    return out
+
+
 #: pipeline configs, each its own subprocess family as well — host-path
 #: configs do per-frame D2H, and running them after anything else in
 #: one process measured 2x drift (label 157 -> 76 FPS across trials)
@@ -1220,6 +1327,7 @@ _FAMILIES = {
     "batch_sweep": lambda: batch_sweep(),
     "dyn_batch": lambda: dyn_batch_check(),
     "int8_native": lambda: int8_native_check(),
+    "chaos_smoke": lambda: chaos_smoke(),
 }
 for _d in OFFLOAD_DELAYS:
     _FAMILIES[f"offload_{_d}"] = (
@@ -1374,7 +1482,7 @@ def _ordered_families() -> list:
              "mxu_peak", "batch_sweep", "dyn_batch"]
             + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
             + [f"offload_{d}" for d in OFFLOAD_DELAYS]
-            + ["int8_native"])
+            + ["int8_native", "chaos_smoke"])
 
 
 def _has_unverified(v) -> bool:
@@ -1423,6 +1531,10 @@ def _assemble(family_out: dict, errors: dict, env: dict,
         "elapsed_s": round(elapsed_s, 1),
         "families_done": sorted(k for k, v in family_out.items() if v),
     }
+    chaos = family_out.get("chaos_smoke")
+    if chaos:
+        out["chaos"] = chaos
+        out["chaos_ok"] = bool(chaos.get("chaos_ok"))
     # families that completed but flagged part of their own result as
     # unverified (e.g. int8_native without its interpreter oracle) —
     # surfaced as a count so a "0 errors" run can't silently carry
@@ -1446,6 +1558,12 @@ def _emit(out: dict) -> None:
 
 
 def main() -> int:
+    if "--chaos" in sys.argv:
+        # standalone chaos smoke: run in-process, print the result JSON,
+        # exit 0 iff every target survived (CI gate / local repro)
+        out = chaos_smoke()
+        print(json.dumps(out), flush=True)
+        return 0 if out.get("chaos_ok") else 1
     if "--family" in sys.argv:
         idx = sys.argv.index("--family") + 1
         if idx >= len(sys.argv) or sys.argv[idx] not in _FAMILIES:

@@ -43,6 +43,8 @@ __all__ = [
     "PipelineRunner",
     "Tracer",
     "register_custom_easy",
+    "StreamError",
+    "ErrorPolicy",
     "__version__",
 ]
 
@@ -54,6 +56,10 @@ _LAZY = {
     "Tracer": ("nnstreamer_tpu.runtime.tracing", "Tracer"),
     "register_custom_easy": ("nnstreamer_tpu.backends.custom",
                              "register_custom_easy"),
+    # error handling is public API: catch StreamError around wait()/run(),
+    # pass ErrorPolicy (or its string form) as any element's error-policy
+    "StreamError": ("nnstreamer_tpu.core.errors", "StreamError"),
+    "ErrorPolicy": ("nnstreamer_tpu.core.errors", "ErrorPolicy"),
 }
 
 

@@ -17,12 +17,16 @@ getModelInfo/eventHandler). Differences, TPU-first:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
-from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.core.errors import BackendError, CircuitOpenError
+from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.core.registry import PluginKind, registry
 from nnstreamer_tpu.runtime.tracing import NULL_TRACER
 from nnstreamer_tpu.tensor.info import TensorsSpec
+
+log = get_logger("backend")
 
 ArrayTuple = Tuple[Any, ...]
 ElementwiseFn = Callable[[ArrayTuple], ArrayTuple]
@@ -37,6 +41,10 @@ class FilterBackend:
     #: compile/invoke spans onto the element's track when tracer.active
     tracer = NULL_TRACER
     trace_name: str = ""
+    #: invoke exceptions observed by the owning tensor_filter (surfaced
+    #: as backend_invoke_failures in stats; breaker short-circuits are
+    #: NOT counted — the backend was never touched)
+    invoke_failures: int = 0
 
     def open(self, props: Dict[str, Any]) -> None:
         """Load the model described by element properties (fw->open)."""
@@ -118,6 +126,91 @@ def _restack_frames(frames_out: Sequence[ArrayTuple]) -> ArrayTuple:
         out.append(xp.concatenate(rows, axis=0) if keep
                    else xp.stack(rows, axis=0))
     return tuple(out)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker around backend invokes
+    (docs/robustness.md state machine):
+
+    - **closed** (normal): invokes pass through; `threshold` consecutive
+      failures open the circuit.
+    - **open**: `guard()` raises CircuitOpenError without touching the
+      backend, so the owning tensor_filter's error policy serves the
+      degrade/skip path at queue speed instead of stacking timeouts on
+      a dead backend. After `cooldown_s` the next guard() half-opens.
+    - **half-open**: exactly one probe invoke passes through — success
+      closes the circuit, failure re-opens it with a fresh cooldown.
+
+    Driven by the single worker thread of the owning element, so state
+    transitions need no lock. `clock` is injectable for deterministic
+    unit tests.
+    """
+
+    def __init__(self, threshold: int, cooldown_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got "
+                             f"{threshold}")
+        self.threshold = threshold
+        self.cooldown_s = max(0.0, cooldown_s)
+        self._clock = clock
+        self.state = "closed"            # closed | open | half_open
+        self._failures = 0               # consecutive, current streak
+        self._opened_at = 0.0
+        # observability counters (surfaced via tensor_filter extra_stats)
+        self.opened_count = 0
+        self.short_circuited = 0
+        self.probes = 0
+        self.recoveries = 0
+
+    def guard(self, owner: str = "backend") -> None:
+        """Call before an invoke. Raises CircuitOpenError while the
+        circuit is open and cooling down; transitions open → half_open
+        once the cooldown has elapsed (the caller's next invoke is the
+        probe)."""
+        if self.state == "closed":
+            return
+        if self.state == "open":
+            waited = self._clock() - self._opened_at
+            if waited < self.cooldown_s:
+                self.short_circuited += 1
+                raise CircuitOpenError(
+                    f"{owner}: circuit open after {self._failures} "
+                    f"consecutive backend failures; cooling down "
+                    f"({self.cooldown_s - waited:.2f}s of "
+                    f"{self.cooldown_s:.2f}s left) — serving the "
+                    f"fallback/skip path"
+                )
+            self.state = "half_open"
+            self.probes += 1
+            log.info("%s: circuit half-open — probing backend", owner)
+        # half_open: let exactly this invoke through as the probe
+
+    def record_success(self) -> None:
+        if self.state != "closed":
+            self.recoveries += 1
+            log.info("circuit closed — probe invoke succeeded")
+        self.state = "closed"
+        self._failures = 0
+
+    def record_failure(self) -> None:
+        self._failures += 1
+        if self.state == "half_open" or (
+                self.state == "closed" and self._failures >= self.threshold):
+            self.state = "open"
+            self._opened_at = self._clock()
+            self.opened_count += 1
+            log.warning("circuit opened after %d consecutive backend "
+                        "failure(s); cooling down %.2fs",
+                        self._failures, self.cooldown_s)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"state": self.state,
+                "consecutive_failures": self._failures,
+                "opened": self.opened_count,
+                "short_circuited": self.short_circuited,
+                "probes": self.probes,
+                "recoveries": self.recoveries}
 
 
 def register_backend(name: str):

@@ -113,6 +113,17 @@ class Config:
                 f"config [{group}] {key}={v!r} is not an integer"
             ) from None
 
+    def get_float(self, group: str, key: str, default: float = 0.0) -> float:
+        v = self.get(group, key)
+        if v is None:
+            return default
+        try:
+            return float(v)
+        except ValueError:
+            raise ConfigError(
+                f"config [{group}] {key}={v!r} is not a number"
+            ) from None
+
     def plugin_paths(self) -> List[Path]:
         """Directories scanned for plugin modules (env paths first)."""
         paths: List[Path] = []

@@ -539,5 +539,9 @@ class BatchedQueryServer:
         self._stop.set()
         for t in self._drainers:
             t.join(timeout=5)
+            if t.is_alive():
+                log.warning(
+                    "query server: drainer thread %s still alive after "
+                    "5s join at close — wedged consumer leaked", t.name)
         self.dispatcher.shutdown()
         self.qs.stop()

@@ -11,6 +11,7 @@ from nnstreamer_tpu.elements import (  # noqa: F401
     converter,
     debug,
     decoder,
+    fault,
     filter as filter_element,
     iio,
     ipc,
@@ -51,6 +52,7 @@ from nnstreamer_tpu.elements.control import (
 from nnstreamer_tpu.elements.converter import TensorConverter, register_converter
 from nnstreamer_tpu.elements.debug import TensorDebug
 from nnstreamer_tpu.elements.decoder import TensorDecoder, register_decoder
+from nnstreamer_tpu.elements.fault import TensorFault
 from nnstreamer_tpu.elements.filter import TensorFilter
 from nnstreamer_tpu.elements.ipc import IpcSink, IpcSrc
 from nnstreamer_tpu.elements.repo import REPO, TensorRepoSink, TensorRepoSrc
@@ -81,6 +83,7 @@ __all__ = [
     "TensorDebug",
     "TensorDecoder",
     "TensorDemux",
+    "TensorFault",
     "TensorFilter",
     "TensorIf",
     "TensorMerge",

@@ -27,9 +27,9 @@ from collections import deque
 from dataclasses import replace
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from nnstreamer_tpu.backends.base import FilterBackend, get_backend
+from nnstreamer_tpu.backends.base import CircuitBreaker, FilterBackend, get_backend
 from nnstreamer_tpu.core.config import get_config
-from nnstreamer_tpu.core.errors import BackendError, PipelineError
+from nnstreamer_tpu.core.errors import BackendError, CircuitOpenError, PipelineError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.graph.pipeline import Element, Emission, PropDef, StreamSpec
@@ -83,6 +83,16 @@ class TensorFilter(Element):
             "accept FLEXIBLE input (per-buffer shapes, bucketed recompile)"),
         "shared_tensor_filter_key": PropDef(
             str, "", "share one device model across filters with this key"),
+        # circuit breaker around backend invokes (docs/robustness.md):
+        # after `breaker_threshold` consecutive invoke failures the
+        # circuit opens and invokes short-circuit with CircuitOpenError
+        # for `breaker_cooldown_ms`, then one half-open probe decides
+        # recovery. 0 disables (default). Pair with error-policy=skip or
+        # degrade so the short-circuits don't fail the pipeline.
+        "breaker_threshold": PropDef(
+            int, 0, "consecutive invoke failures to open the circuit (0=off)"),
+        "breaker_cooldown_ms": PropDef(
+            float, 1000.0, "open-circuit cooldown before the probe invoke"),
     }
 
     def __init__(self, name=None, **props):
@@ -98,6 +108,7 @@ class TensorFilter(Element):
         self._out_combination = self._parse_out_combination(
             self.props["output_combination"]
         )
+        self._breaker: Optional[CircuitBreaker] = None
         self._lat_window = deque(maxlen=10)   # last-10 window, ref :443-455
         self._invoke_count = 0
         self._t_start = None
@@ -337,6 +348,12 @@ class TensorFilter(Element):
 
     def start(self) -> None:
         self._t_start = time.monotonic()
+        # tests may pre-install a breaker with an injected clock; only
+        # build one here if the props ask for it and none exists yet
+        if self._breaker is None and self.props["breaker_threshold"] > 0:
+            self._breaker = CircuitBreaker(
+                self.props["breaker_threshold"],
+                self.props["breaker_cooldown_ms"] / 1e3)
         if self.backend is not None:
             # hand the runner's tracer down so backend compile/invoke
             # spans land on this element's trace track
@@ -351,10 +368,32 @@ class TensorFilter(Element):
         """Backend compile/cache counters merged into this element's
         stats() row (absent for backends that don't track them)."""
         out = {}
-        for k in ("compile_count", "cache_hits", "cache_misses"):
+        for k in ("compile_count", "cache_hits", "cache_misses",
+                  "invoke_failures"):
             v = getattr(self.backend, k, None)
             if v is not None:
                 out["backend_" + k] = v
+        if self._breaker is not None:
+            for k, v in self._breaker.stats().items():
+                out["breaker_" + k] = v
+        return out
+
+    def _invoke_guarded(self, invoke, *args):
+        """Run one backend invoke through the circuit breaker (when
+        configured). A `guard()` short-circuit raises CircuitOpenError
+        *without* touching the backend and without counting as a new
+        failure; the owning element's error policy decides what the
+        short-circuit means (skip/degrade/fail)."""
+        br = self._breaker
+        if br is None:
+            return invoke(*args)
+        br.guard(self.name)
+        try:
+            out = invoke(*args)
+        except Exception:
+            br.record_failure()
+            raise
+        br.record_success()
         return out
 
     # -- hot loop (reference §3.2) -----------------------------------------
@@ -370,8 +409,11 @@ class TensorFilter(Element):
         if self._pre is not None and not self._fused_in_backend:
             inputs = self._pre(inputs)
         try:
-            outputs = self.backend.invoke(inputs)
+            outputs = self._invoke_guarded(self.backend.invoke, inputs)
+        except CircuitOpenError:
+            raise   # keep the type — error policies never retry these
         except Exception as e:
+            self.backend.invoke_failures += 1
             raise BackendError(
                 f"tensor_filter {self.name}: invoke failed on frame "
                 f"pts={buf.pts}: {e}"
@@ -410,9 +452,12 @@ class TensorFilter(Element):
         if self._pre is not None and not self._fused_in_backend:
             inputs = self._pre(inputs)
         try:
-            outputs = self.backend.invoke_batched(
-                inputs, n, self._batch_keepdims)
+            outputs = self._invoke_guarded(
+                self.backend.invoke_batched, inputs, n, self._batch_keepdims)
+        except CircuitOpenError:
+            raise
         except Exception as e:
+            self.backend.invoke_failures += 1
             raise BackendError(
                 f"tensor_filter {self.name}: batched invoke failed on "
                 f"buffer pts={buf.pts} occupancy={n}: {e}"
@@ -434,8 +479,12 @@ class TensorFilter(Element):
             regions = [self._pre((r,))[0] for r in regions]
         t0 = time.perf_counter()
         try:
-            outputs = list(self.backend.invoke_flexible(regions))
+            outputs = list(self._invoke_guarded(
+                self.backend.invoke_flexible, regions))
+        except CircuitOpenError:
+            raise
         except Exception as e:
+            self.backend.invoke_failures += 1
             raise BackendError(
                 f"tensor_filter {self.name}: flexible invoke failed on "
                 f"frame pts={buf.pts} with region shapes "

@@ -16,12 +16,13 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time
 from fractions import Fraction
 from typing import Dict, Iterator
 
 import numpy as np
 
-from nnstreamer_tpu.core.errors import PipelineError
+from nnstreamer_tpu.core.errors import PipelineError, StreamError
 from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.graph.pipeline import (
     Element,
@@ -59,18 +60,34 @@ class TensorRepoSink(SinkElement):
     ELEMENT_NAME = "tensor_repo_sink"
     PROPS = {
         "slot": PropDef(int, 0, "repository slot index"),
+        "put_timeout": PropDef(float, 10.0,
+                               "seconds to wait for a free slot entry"),
     }
 
     def render(self, buf: TensorBuffer) -> None:
-        q = REPO.slot(self.props["slot"])
-        try:
-            q.put(buf, timeout=10)
-        except _queue.Full:
-            raise PipelineError(
-                f"tensor_repo_sink {self.name}: slot "
-                f"{self.props['slot']} full — is the matching "
-                f"tensor_repo_src consuming?"
-            ) from None
+        slot = self.props["slot"]
+        q = REPO.slot(slot)
+        # bounded, stop-aware wait: a pipeline tearing down (e.g. another
+        # element failed) must not leave this worker parked the full
+        # timeout on a slot nobody will ever drain
+        deadline = time.monotonic() + self.props["put_timeout"]
+        while True:
+            try:
+                q.put(buf, timeout=0.2)
+                return
+            except _queue.Full:
+                if self._stop_evt is not None and self._stop_evt.is_set():
+                    raise StreamError(
+                        f"tensor_repo_sink {self.name}: pipeline stopping "
+                        f"while waiting on full repo slot {slot}"
+                    ) from None
+                if time.monotonic() >= deadline:
+                    raise StreamError(
+                        f"tensor_repo_sink {self.name}: repo slot {slot} "
+                        f"still full after {self.props['put_timeout']:.1f}s "
+                        f"— is the matching tensor_repo_src consuming, and "
+                        f"is the feedback loop making progress?"
+                    ) from None
 
     def stop(self) -> None:
         # wake a blocked reposrc at teardown

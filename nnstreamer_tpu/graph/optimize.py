@@ -32,6 +32,10 @@ def _is_fusable_transform(pipe: Pipeline, elem) -> bool:
         isinstance(elem, TensorTransform)
         and len(pipe.links_to(elem)) == 1
         and len(pipe.links_from(elem)) == 1
+        # a transform with its own error policy must stay a separate
+        # element — fused into the filter, its failures would be
+        # charged to (and policied by) the filter instead
+        and elem.error_policy.kind == "fail"
     )
 
 

@@ -24,7 +24,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
-from nnstreamer_tpu.core.errors import NegotiationError, PipelineError
+from nnstreamer_tpu.core.errors import (
+    FAIL_FAST,
+    ErrorPolicy,
+    NegotiationError,
+    PipelineError,
+)
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.graph.media import MediaSpec
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
@@ -78,6 +83,20 @@ class Element:
     NUM_SINK_PADS: int = 1
     NUM_SRC_PADS: int = 1
     PROPS: Dict[str, PropDef] = {}
+    #: properties every element understands, resolved alongside the
+    #: subclass PROPS table (kept separate so subclasses never have to
+    #: merge them in by hand)
+    COMMON_PROPS: Dict[str, PropDef] = {
+        "error_policy": PropDef(
+            ErrorPolicy.parse, FAIL_FAST,
+            "what the scheduler does when process() raises: fail "
+            "(default) | skip | retry:N[:backoff_ms] | degrade "
+            "(route input to the auto-added fallback src pad)"),
+    }
+    #: teardown signal shared by the running pipeline — elements that
+    #: block (repo puts, injected delays) should wait on this instead of
+    #: sleeping blind; assigned by PipelineRunner.start()
+    _stop_evt = None
     #: element consumes host arrays (decoders, sinks, wire encoders): the
     #: scheduler starts async D2H copies when queueing buffers toward it,
     #: overlapping transfers with other in-flight frames
@@ -90,8 +109,9 @@ class Element:
     def __init__(self, name: Optional[str] = None, **props):
         self.name = name or f"{self.ELEMENT_NAME}{id(self) & 0xFFFF:x}"
         self.props: Dict[str, Any] = {
-            k: d.default for k, d in self.PROPS.items()
+            k: d.default for k, d in self.COMMON_PROPS.items()
         }
+        self.props.update({k: d.default for k, d in self.PROPS.items()})
         self.set_props(**props)
         self.in_specs: List[Optional[StreamSpec]] = []
         self.out_specs: List[Optional[StreamSpec]] = []
@@ -101,13 +121,14 @@ class Element:
     def set_props(self, **props) -> None:
         for key, value in props.items():
             k = key.replace("-", "_")
-            if k not in self.PROPS:
+            pd = self.PROPS.get(k) or self.COMMON_PROPS.get(k)
+            if pd is None:
+                valid = sorted(p.replace("_", "-") for p in
+                               list(self.PROPS) + list(self.COMMON_PROPS))
                 raise PipelineError(
                     f"element {self.ELEMENT_NAME!r} ({self.name}) has no "
-                    f"property {key!r}; valid properties: "
-                    f"{sorted(p.replace('_', '-') for p in self.PROPS)}"
+                    f"property {key!r}; valid properties: {valid}"
                 )
-            pd = self.PROPS[k]
             try:
                 self.props[k] = (
                     pd.parse(value) if isinstance(value, str) else value
@@ -118,6 +139,24 @@ class Element:
                     f"{self.name}: {e}"
                 ) from e
 
+    # -- error policy ------------------------------------------------------
+    @property
+    def error_policy(self) -> ErrorPolicy:
+        """Parsed error-policy property (FAIL_FAST unless overridden)."""
+        return self.props.get("error_policy") or FAIL_FAST
+
+    @property
+    def fallback_src_pad(self) -> Optional[int]:
+        """Pad index the scheduler routes failed input buffers to under
+        error-policy=degrade: one extra src pad appended after the
+        declared ones (so a plain 1-src element degrades on pad 1, and a
+        sink degrades on pad 0). Its stream spec is the element's sink
+        pad 0 input spec — the fallback consumer sees the *unprocessed*
+        input. None unless the policy is degrade."""
+        if self.error_policy.kind != "degrade" or self.NUM_SRC_PADS == DYNAMIC:
+            return None
+        return self.NUM_SRC_PADS
+
     # -- pads --------------------------------------------------------------
     @property
     def num_sink_pads(self) -> int:
@@ -125,6 +164,8 @@ class Element:
 
     @property
     def num_src_pads(self) -> int:
+        if self.fallback_src_pad is not None:
+            return self.NUM_SRC_PADS + 1
         return self.NUM_SRC_PADS
 
     # -- upstream events (GStreamer upstream-event analog) ------------------
@@ -390,6 +431,12 @@ class Pipeline:
                         f"buffers"
                     )
             out_specs = e.negotiate(in_specs)
+            fb = e.fallback_src_pad
+            if fb is not None and len(out_specs) == fb:
+                # degrade fallback pad: carries the element's pad-0
+                # input stream verbatim (the scheduler re-routes failed
+                # input buffers there), so its spec IS the input spec
+                out_specs = list(out_specs) + [in_specs[0]]
             e.in_specs = list(in_specs)
             e.out_specs = list(out_specs)
             out_links = self.links_from(e)
@@ -420,6 +467,22 @@ class Pipeline:
                 "least one (appsrc, videotestsrc, filesrc, …)"
             )
         for e in self.elements.values():
+            policy = e.error_policy
+            if policy.kind != "fail" and isinstance(e, SourceElement):
+                raise PipelineError(
+                    f"element {e.name}: error-policy={policy} is not "
+                    f"supported on a source element — a generate() "
+                    f"failure kills its pump thread, so sources are "
+                    f"always fail-fast; put the policy on the element "
+                    f"that can actually fail per-buffer"
+                )
+            if policy.kind == "degrade" and e.NUM_SRC_PADS == DYNAMIC:
+                raise PipelineError(
+                    f"element {e.name}: error-policy=degrade needs a "
+                    f"fixed src pad count to place the fallback pad, but "
+                    f"{e.ELEMENT_NAME} has dynamic src pads; use skip or "
+                    f"retry instead"
+                )
             n_in = len(self.links_to(e))
             n_out = len(self.links_from(e))
             if e.NUM_SINK_PADS != DYNAMIC and n_in != e.num_sink_pads:
@@ -428,10 +491,17 @@ class Pipeline:
                     f"has {n_in}"
                 )
             if e.NUM_SRC_PADS != DYNAMIC and n_out != e.num_src_pads:
+                hint = (
+                    f" (error-policy=degrade adds a fallback src pad — "
+                    f"pad {e.fallback_src_pad} — that must be linked, "
+                    f"e.g. to a cheaper model branch or a sink)"
+                    if e.fallback_src_pad is not None else
+                    " — every src pad must be linked (terminate unused "
+                    "branches with a sink such as fakesink)"
+                )
                 raise PipelineError(
                     f"element {e.name} needs {e.num_src_pads} src link(s), "
-                    f"has {n_out} — every src pad must be linked (terminate "
-                    f"unused branches with a sink such as fakesink)"
+                    f"has {n_out}{hint}"
                 )
 
     def _topo_order(self) -> List[Element]:

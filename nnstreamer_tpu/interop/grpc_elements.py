@@ -212,6 +212,11 @@ class TensorSinkGrpc(SinkElement):
         if self._sender is not None:
             self._signal_eos(self._sendq)
             self._sender.join(timeout=5)
+            if self._sender.is_alive():
+                log.warning(
+                    "tensor_sink_grpc %s: sender thread %s still alive "
+                    "after 5s join at stop — wedged gRPC stream leaked",
+                    self.name, self._sender.name)
             self._sender = None
 
 

@@ -106,6 +106,10 @@ class MeshDispatcher:
             self._stop = True
         self._wake.set()
         self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            log.warning("dispatcher: batcher thread %s still alive after "
+                        "30s join at shutdown — thread leaked",
+                        self._thread.name)
         # bounded sentinel enqueue: if the completion stage is wedged
         # (hung D2H) its queue may be full — shutdown must still return
         try:
@@ -113,6 +117,10 @@ class MeshDispatcher:
         except Exception:
             log.warning("dispatcher completion queue wedged at shutdown")
         self._completer.join(timeout=10)
+        if self._completer.is_alive():
+            log.warning("dispatcher: completer thread %s still alive after "
+                        "10s join at shutdown — thread leaked",
+                        self._completer.name)
 
     # -- batcher loop ------------------------------------------------------
     def _loop(self) -> None:

@@ -51,7 +51,9 @@ class ElementStats:
     instead of out-sourced. Read via PipelineRunner.stats()."""
 
     __slots__ = ("buffers", "total_s", "max_s", "wait_s", "wait_max_s",
-                 "timer_fires", "dropped", "queue_peak")
+                 "timer_fires", "dropped", "queue_peak", "errors",
+                 "retries", "skipped", "degraded", "watchdog_warnings",
+                 "event_errors")
 
     def __init__(self):
         self.buffers = 0
@@ -72,6 +74,24 @@ class ElementStats:
         # high-water mark of this element's input queue (queuelevel
         # tracer analog; capacity is the runner's queue_capacity)
         self.queue_peak = 0
+        # -- robustness counters (error-policy machinery) ------------------
+        # process() exceptions caught under this element's error policy
+        # (every failed attempt counts, so retries show up here too)
+        self.errors = 0
+        # re-invocations attempted under retry:N
+        self.retries = 0
+        # input buffers abandoned after an error (skip policy, or retry
+        # budget exhausted). Conservation invariant per pipeline:
+        # emitted + skipped + dropped == generated
+        self.skipped = 0
+        # input buffers routed to the fallback src pad (degrade policy)
+        self.degraded = 0
+        # watchdog incidents flagged against this element (stalled
+        # process() or input queue pinned at capacity)
+        self.watchdog_warnings = 0
+        # handle_upstream_event() exceptions (event swallowed, not
+        # consumed — propagation continues past this element)
+        self.event_errors = 0
 
     def record(self, dt: float) -> None:
         self.buffers += 1
@@ -97,12 +117,42 @@ class ElementStats:
                 "queue_wait_max_us": 1e6 * self.wait_max_s,
                 "timer_fires": self.timer_fires,
                 "dropped": self.dropped,
-                "queue_peak": self.queue_peak}
+                "queue_peak": self.queue_peak,
+                "errors": self.errors,
+                "retries": self.retries,
+                "skipped": self.skipped,
+                "degraded": self.degraded,
+                "watchdog_warnings": self.watchdog_warnings,
+                "event_errors": self.event_errors}
 
 
 class PipelineRunner:
+    """Runs a negotiated pipeline: one worker thread per element.
+
+    Fault-tolerance knobs (docs/robustness.md):
+
+    - per-element `error-policy` properties are enforced in `_work`
+      (fail | skip | retry:N[:backoff_ms] | degrade);
+    - `max_consecutive_errors` (default from config, 100): after that
+      many policy-handled errors with no successful process() anywhere
+      in the pipeline, the run escalates to failure — a poison stream
+      under skip/retry still dies loudly instead of spinning forever.
+      0 disables escalation;
+    - `watchdog` (default on): a monitor thread that flags elements
+      whose process() exceeds `stall_budget_s` and input queues pinned
+      at capacity beyond `queue_stall_budget_s`. `watchdog_action`
+      "warn" emits structured warnings + stats; "fail" tears the
+      pipeline down with WatchdogStall — the "fail loud, never hang"
+      promise extended from exceptions to hangs.
+    """
+
     def __init__(self, pipeline: Pipeline, queue_capacity: Optional[int] = None,
-                 optimize: bool = True, trace=False):
+                 optimize: bool = True, trace=False,
+                 max_consecutive_errors: Optional[int] = None,
+                 watchdog: Optional[bool] = None,
+                 stall_budget_s: Optional[float] = None,
+                 queue_stall_budget_s: Optional[float] = None,
+                 watchdog_action: Optional[str] = None):
         self.pipeline = pipeline
         self._optimize = optimize
         # trace=False → NULL_TRACER (hot path pays one attribute load);
@@ -125,6 +175,38 @@ class PipelineRunner:
         self._error_lock = threading.Lock()
         self._started = False
         self._route: Dict[Tuple[str, int], Link] = {}
+        # -- fault-tolerance state -----------------------------------------
+        cfg = get_config()
+        if max_consecutive_errors is None:
+            max_consecutive_errors = cfg.get_int(
+                "runtime", "max_consecutive_errors", 100)
+        self._max_consec = max(0, max_consecutive_errors)
+        # shared run-level counter: reset by ANY successful process();
+        # plain int ops under the GIL — a lost race costs one count,
+        # never a wrong escalation by more than a few buffers
+        self._consec_errors = 0
+        if watchdog is None:
+            watchdog = cfg.get_bool("runtime", "watchdog", True)
+        self._watchdog_enabled = bool(watchdog)
+        if stall_budget_s is None:
+            stall_budget_s = cfg.get_float(
+                "runtime", "stall_budget_s", 30.0)
+        self._stall_budget_s = max(0.01, stall_budget_s)
+        if queue_stall_budget_s is None:
+            queue_stall_budget_s = cfg.get_float(
+                "runtime", "queue_stall_budget_s", self._stall_budget_s)
+        self._queue_stall_budget_s = max(0.01, queue_stall_budget_s)
+        action = watchdog_action or cfg.get(
+            "runtime", "watchdog_action", "warn") or "warn"
+        if action not in ("warn", "fail"):
+            raise PipelineError(
+                f"watchdog_action must be 'warn' or 'fail', got {action!r}")
+        self._watchdog_action = action
+        self._watchdog_thread: Optional[threading.Thread] = None
+        # element name -> monotonic instant its worker entered process()
+        # (or flush()); written/cleared by the worker, read by the
+        # watchdog — GIL-atomic dict ops, no lock needed
+        self._inflight: Dict[str, float] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "PipelineRunner":
@@ -144,6 +226,9 @@ class PipelineRunner:
             # tracer handed down before start() so elements can forward
             # it further (tensor_filter → backend invoke/compile spans)
             e._tracer = self.tracer
+            # teardown signal, so blocking elements (repo puts, injected
+            # delays) can abort instead of riding out their timeouts
+            e._stop_evt = self._stop_evt
             e.start()
         for l in pipe.links:
             self._route[(l.src.name, l.src_pad)] = l
@@ -161,29 +246,59 @@ class PipelineRunner:
         self._started = True
         for t in self._threads:
             t.start()
+        if self._watchdog_enabled:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop,
+                name=f"watchdog:{pipe.name}", daemon=True)
+            self._watchdog_thread.start()
         return self
+
+    #: how long wait() gives remaining workers to drain once a worker
+    #: error is already recorded and no caller deadline bounds the join
+    _error_drain_grace_s = 5.0
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until every element finished (EOS fully propagated)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         for t in self._threads:
-            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            t.join(remaining)
-            if t.is_alive():
-                self.stop()
-                if self._error is not None:
-                    # the hang is a symptom: a worker already failed and
-                    # a peer never drained — surface the root cause, not
-                    # a bare timeout that swallows it
-                    raise StreamError(
-                        f"pipeline {self.pipeline.name!r} failed: "
-                        f"{self._error} (thread {t.name} then did not "
-                        f"finish within {timeout}s)"
-                    ) from self._error
-                raise StreamError(
-                    f"pipeline {self.pipeline.name!r} did not finish within "
-                    f"{timeout}s (thread {t.name} still running)"
-                )
+            while t.is_alive():
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self.stop()
+                        if self._error is not None:
+                            # the hang is a symptom: a worker already
+                            # failed and a peer never drained — surface
+                            # the root cause, not a bare timeout that
+                            # swallows it
+                            raise StreamError(
+                                f"pipeline {self.pipeline.name!r} failed: "
+                                f"{self._error} (thread {t.name} then did "
+                                f"not finish within {timeout}s)"
+                            ) from self._error
+                        raise StreamError(
+                            f"pipeline {self.pipeline.name!r} did not "
+                            f"finish within {timeout}s (thread {t.name} "
+                            f"still running)"
+                        )
+                    t.join(min(0.2, remaining))
+                elif self._error is not None:
+                    # no caller deadline, but the pipeline already
+                    # failed: give the stragglers a bounded grace, then
+                    # leak them (they are daemons) rather than hang the
+                    # caller forever behind a stuck process()
+                    self.stop()
+                    t.join(self._error_drain_grace_s)
+                    if t.is_alive():
+                        log.warning(
+                            "pipeline %r: thread %s still running %.0fs "
+                            "after pipeline failure — leaking it (daemon "
+                            "thread; likely stuck in process())",
+                            self.pipeline.name, t.name,
+                            self._error_drain_grace_s)
+                    break
+                else:
+                    t.join(0.2)
         if self._error is not None:
             raise StreamError(
                 f"pipeline {self.pipeline.name!r} failed: {self._error}"
@@ -210,6 +325,12 @@ class PipelineRunner:
                 e.stop()
             except Exception:  # teardown must not mask the first error
                 log.exception("error stopping %s", e.name)
+        wt = self._watchdog_thread
+        if wt is not None and wt is not threading.current_thread():
+            wt.join(2.0)  # exits on the next poll tick (stop_evt set)
+            if wt.is_alive():
+                log.warning("watchdog thread %s did not stop within 2s; "
+                            "leaking it (daemon thread)", wt.name)
 
     def run(self, timeout: Optional[float] = None) -> None:
         self.start()
@@ -267,6 +388,20 @@ class PipelineRunner:
                 continue
             lines.append(f"  {l.src.name} → {l.dst.name}: "
                          f"peak {d['queue_peak']}/{self._cap}")
+        rob = [(name, d) for name, d in sorted(st.items())
+               if any(d.get(k) for k in
+                      ("errors", "retries", "skipped", "degraded",
+                       "watchdog_warnings", "event_errors"))]
+        if rob:
+            lines.append("")
+            lines.append("robustness (error-policy / watchdog counters):")
+            for name, d in rob:
+                lines.append(
+                    f"  {name}: errors={d['errors']} "
+                    f"retries={d['retries']} skipped={d['skipped']} "
+                    f"degraded={d['degraded']} "
+                    f"watchdog={d['watchdog_warnings']} "
+                    f"event_errors={d['event_errors']}")
         tr = self.tracer
         if tr.active:
             inter = tr.interlatency()
@@ -317,10 +452,164 @@ class PipelineRunner:
                 try:
                     consumed = u.handle_upstream_event(event)
                 except Exception:
+                    # a broken handler must not silently terminate the
+                    # walk: treat the event as NOT consumed so it keeps
+                    # propagating toward the sources, and count the
+                    # failure where it happened
                     log.exception("upstream event failed at %s", u.name)
-                    consumed = True
+                    stats = self._stats.get(u.name)
+                    if stats is not None:
+                        stats.event_errors += 1
+                    consumed = False
                 if not consumed:
                     frontier.append(u)
+
+    # -- error policies ----------------------------------------------------
+    def _process_with_policy(self, elem: Element, stats: ElementStats,
+                             policy, pad: int, item, tr):
+        """Run elem.process under a non-fail error policy.
+
+        Returns the emissions list, or None when the buffer was consumed
+        by the policy (skipped, degraded, or lost to teardown). Raises
+        only for escalation (max_consecutive_errors) — which the worker
+        loop's outer handler turns into pipeline failure — or teardown.
+        """
+        from nnstreamer_tpu.core.errors import CircuitOpenError
+
+        attempts = 0
+        while True:
+            self._inflight[elem.name] = time.monotonic()
+            try:
+                return elem.process(pad, item)
+            except Exception as e:
+                stats.errors += 1
+                if tr.active:
+                    tr.record_error(elem.name, type(e).__name__,
+                                    time.perf_counter(),
+                                    policy=policy.kind, pts=getattr(
+                                        item, "pts", None))
+                self._note_error(elem, e)   # may raise (escalation)
+                # a circuit breaker short-circuit is by definition not
+                # transient — retrying it just burns the backoff budget
+                retryable = (policy.kind == "retry"
+                             and attempts < policy.retries
+                             and not isinstance(e, CircuitOpenError))
+                if retryable:
+                    attempts += 1
+                    stats.retries += 1
+                    delay_s = policy.backoff_ms * (2 ** (attempts - 1)) / 1e3
+                    log.debug(
+                        "element %s: process failed (%s); retry %d/%d "
+                        "in %.0fms", elem.name, e, attempts,
+                        policy.retries, delay_s * 1e3)
+                    if delay_s and self._stop_evt.wait(delay_s):
+                        stats.dropped += 1    # teardown mid-backoff
+                        return None
+                    continue
+                if policy.kind == "degrade":
+                    fb = elem.fallback_src_pad
+                    if fb is not None:
+                        stats.degraded += 1
+                        log.warning(
+                            "element %s: process failed on buffer pts=%s "
+                            "(%s); degrading — routing input to fallback "
+                            "pad %d", elem.name,
+                            getattr(item, "pts", None), e, fb)
+                        self._emit(elem, fb, item)
+                        return None
+                stats.skipped += 1
+                log.warning(
+                    "element %s: process failed on buffer pts=%s (%s); "
+                    "%s — buffer dropped", elem.name,
+                    getattr(item, "pts", None), e,
+                    "retry budget exhausted" if policy.kind == "retry"
+                    else f"error-policy={policy.kind}")
+                return None
+            finally:
+                self._inflight.pop(elem.name, None)
+
+    def _note_error(self, elem: Element, exc: BaseException) -> None:
+        """Track run-level consecutive errors; escalate to failure when
+        the pipeline makes no progress between errors (poison stream)."""
+        self._consec_errors += 1
+        if self._max_consec and self._consec_errors >= self._max_consec:
+            raise StreamError(
+                f"element {elem.name}: {self._consec_errors} consecutive "
+                f"errors with no successful buffer anywhere in the "
+                f"pipeline (max_consecutive_errors={self._max_consec}) — "
+                f"escalating to failure; last error: {exc}"
+            ) from exc
+
+    # -- watchdog ----------------------------------------------------------
+    def _watchdog_loop(self) -> None:
+        """Flags elements stuck in process() beyond the stall budget and
+        input queues pinned at capacity beyond theirs. One warning per
+        incident (per stuck call / per contiguous full period), counted
+        in the element's stats and traced; watchdog_action='fail' also
+        tears the pipeline down with WatchdogStall."""
+        from nnstreamer_tpu.core.errors import WatchdogStall
+
+        budget = self._stall_budget_s
+        q_budget = self._queue_stall_budget_s
+        poll = max(0.02, min(1.0, min(budget, q_budget) / 4.0))
+        tr = self.tracer
+        warned_proc: Dict[str, float] = {}   # name -> stamp already flagged
+        q_full_since: Dict[str, float] = {}
+        warned_q: Dict[str, float] = {}
+        while not self._stop_evt.wait(poll):
+            now = time.monotonic()
+            for name, t0 in list(self._inflight.items()):
+                stalled = now - t0
+                if stalled <= budget or warned_proc.get(name) == t0:
+                    continue
+                warned_proc[name] = t0
+                stats = self._stats.get(name)
+                if stats is not None:
+                    stats.watchdog_warnings += 1
+                log.warning(
+                    "watchdog: element %s has been inside process()/"
+                    "flush() for %.2fs (stall budget %.2fs)",
+                    name, stalled, budget)
+                if tr.active:
+                    tr.record_watchdog(name, "stall", time.perf_counter(),
+                                       stalled_s=round(stalled, 3),
+                                       budget_s=budget)
+                if self._watchdog_action == "fail":
+                    elem = self.pipeline.elements.get(name)
+                    self._fail(elem, WatchdogStall(
+                        f"element {name} exceeded its stall budget: "
+                        f"process() has not returned for {stalled:.2f}s "
+                        f"(budget {budget:.2f}s)"))
+                    return
+            for name, q in self._queues.items():
+                if not q.full():
+                    q_full_since.pop(name, None)
+                    continue
+                since = q_full_since.setdefault(name, now)
+                full_for = now - since
+                if full_for <= q_budget or warned_q.get(name) == since:
+                    continue
+                warned_q[name] = since
+                stats = self._stats.get(name)
+                if stats is not None:
+                    stats.watchdog_warnings += 1
+                log.warning(
+                    "watchdog: input queue of %s has been at capacity "
+                    "(%d) for %.2fs (budget %.2fs) — the element is not "
+                    "draining; upstream is blocked", name, self._cap,
+                    full_for, q_budget)
+                if tr.active:
+                    tr.record_watchdog(name, "queue", time.perf_counter(),
+                                       full_for_s=round(full_for, 3),
+                                       budget_s=q_budget,
+                                       capacity=self._cap)
+                if self._watchdog_action == "fail":
+                    elem = self.pipeline.elements.get(name)
+                    self._fail(elem, WatchdogStall(
+                        f"input queue of element {name} stayed at "
+                        f"capacity ({self._cap}) for {full_for:.2f}s "
+                        f"(budget {q_budget:.2f}s)"))
+                    return
 
     def _fail(self, elem: Element, exc: BaseException) -> None:
         with self._error_lock:
@@ -403,6 +692,7 @@ class PipelineRunner:
         eos_pads = set()
         stats = self._stats[elem.name]
         tr = self.tracer
+        policy = elem.error_policy    # resolved once; immutable per run
         try:
             while not self._stop_evt.is_set():
                 # deadline-aware wait: an element holding half-assembled
@@ -435,8 +725,12 @@ class PipelineRunner:
                     eos_pads.add(pad)
                     if len(eos_pads) >= n_pads:
                         t0 = time.perf_counter()
-                        for sp, b in elem.flush():
-                            self._emit(elem, sp, b)
+                        self._inflight[elem.name] = time.monotonic()
+                        try:
+                            for sp, b in elem.flush():
+                                self._emit(elem, sp, b)
+                        finally:
+                            self._inflight.pop(elem.name, None)
                         if tr.active:
                             tr.record_flush(elem.name, t0,
                                             time.perf_counter())
@@ -447,9 +741,22 @@ class PipelineRunner:
                 t0 = time.perf_counter()
                 if t_enq:
                     stats.record_wait(t0 - t_enq)
-                emissions = elem.process(pad, item)
+                if policy.kind == "fail":
+                    # hot path: identical to the historic fail-fast loop
+                    # plus one watchdog stamp on either side
+                    self._inflight[elem.name] = time.monotonic()
+                    try:
+                        emissions = elem.process(pad, item)
+                    finally:
+                        self._inflight.pop(elem.name, None)
+                else:
+                    emissions = self._process_with_policy(
+                        elem, stats, policy, pad, item, tr)
+                    if emissions is None:
+                        continue      # buffer skipped/degraded/dropped
                 t1 = time.perf_counter()
                 stats.record(t1 - t0)
+                self._consec_errors = 0
                 if tr.active:
                     tr.record_process(elem.name, item, t0, t1)
                 for sp, b in emissions:

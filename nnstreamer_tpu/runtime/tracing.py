@@ -84,6 +84,12 @@ class NullTracer:
     def record_drop(self, name, t):
         pass
 
+    def record_error(self, name, exc_type, t, **args):
+        pass
+
+    def record_watchdog(self, name, kind, t, **args):
+        pass
+
     def backend_span(self, name, kind, t0, t1, **args):
         pass
 
@@ -157,6 +163,20 @@ class Tracer:
 
     def record_drop(self, name: str, t: float) -> None:
         self._append("i", "element", name, "buffer_dropped", t, 0.0, None)
+
+    def record_error(self, name: str, exc_type: str, t: float,
+                     **args) -> None:
+        """A process() exception handled by the element's error policy
+        (args carry policy/outcome: skipped, retried, degraded)."""
+        args = dict(args, exc=exc_type)
+        self._append("i", "error", name, "error", t, 0.0, args)
+
+    def record_watchdog(self, name: str, kind: str, t: float,
+                        **args) -> None:
+        """A watchdog warning: kind is "stall" (process() over budget)
+        or "queue" (input queue at capacity over budget)."""
+        self._append("i", "watchdog", name, f"watchdog_{kind}", t, 0.0,
+                     args or None)
 
     def backend_span(self, name: str, kind: str, t0: float, t1: float,
                      **args) -> None:

@@ -55,6 +55,20 @@ def render() -> str:
         "(`file.c:line`) each element is parity-matched against.",
         "",
     ]
+    from nnstreamer_tpu.graph.pipeline import Element
+
+    parts.append("## Common properties (every element)")
+    parts.append("")
+    parts.append("Resolved alongside each element's own property table "
+                 "(see `docs/robustness.md` for semantics).")
+    parts.append("")
+    rows = ["| property | default | description |", "|---|---|---|"]
+    for prop, pd in Element.COMMON_PROPS.items():
+        doc = (pd.doc or "").replace("|", "\\|")
+        rows.append(f"| `{prop.replace('_', '-')}` | `{str(pd.default)!r}` "
+                    f"| {doc} |")
+    parts.append("\n".join(rows))
+    parts.append("")
     names = sorted(registry.names(PluginKind.ELEMENT))
     parts.append("## Elements")
     parts.append("")
