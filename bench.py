@@ -1304,6 +1304,107 @@ def chaos_smoke() -> dict:
     return out
 
 
+def _swap_arm(prewarm: bool, n_frames: int) -> dict:
+    """One closed-loop run through a store:// pipeline with a hot swap
+    at the halfway frame: per-frame latency before/after the epoch
+    flip, plus the post-flip compile growth that tells whether the
+    swap recompiled on the hot path."""
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements import FakeSink, TensorFilter
+    from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.serving.store import reset_store
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    # two store versions of the same architecture: the swap cost under
+    # measurement is compilation/adoption, which doesn't care that the
+    # weights match
+    store = reset_store()
+    store.register("bench_swap", "zoo://mobilenet_v2")
+    store.register("bench_swap", "zoo://mobilenet_v2")
+
+    pipe = nns.Pipeline("model_swap")
+    src = AppSrc(spec=TensorsSpec.of(
+        TensorInfo((1, 224, 224, 3), DType.FLOAT32)), name="src")
+    stages = [src,
+              TensorBatch(name="batcher", max_batch=8, max_latency_ms=5.0),
+              TensorFilter(name="f", model="store://bench_swap"),
+              TensorUnbatch(name="unbatch"),
+              FakeSink(name="sink", sync_device=True)]
+    for e in stages:
+        pipe.add(e)
+    for a, b in zip(stages, stages[1:]):
+        pipe.link(a, b)
+    sink = pipe.get("sink")
+    frame = np.random.default_rng(0).normal(
+        size=(1, 224, 224, 3)).astype(np.float32)
+
+    runner = nns.PipelineRunner(pipe, queue_capacity=4).start()
+    half = n_frames // 2
+    lats = []
+    cc_at_flip = None
+    try:
+        for i in range(n_frames):
+            if i == half:
+                store.update("bench_swap", prewarm=prewarm)
+                # prewarm compiles happen inside update(), before the
+                # flip — anything after this point is hot-path cost
+                cc_at_flip = pipe.get("f").backend.compile_count
+            t0 = time.perf_counter()
+            src.push(TensorBuffer.of(frame, pts=i))
+            deadline = t0 + 120.0
+            while sink.count <= i and time.perf_counter() < deadline:
+                time.sleep(0.0002)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        src.end()
+        runner.wait(timeout=240)
+    finally:
+        runner.stop()
+    backend = pipe.get("f").backend
+    pre, post = sorted(lats[2:half]), sorted(lats[half:])
+    post_flip_compiles = backend.compile_count - cc_at_flip
+    return {
+        "prewarm": prewarm,
+        "frames": n_frames,
+        "emitted": sink.count,
+        "pre_p50_ms": round(_percentile(pre, 50), 3),
+        "pre_p99_ms": round(_percentile(pre, 99), 3),
+        "post_p50_ms": round(_percentile(post, 50), 3),
+        "post_p99_ms": round(_percentile(post, 99), 3),
+        "post_max_ms": round(post[-1], 3) if post else 0.0,
+        "post_flip_compiles": post_flip_compiles,
+        "swaps_adopted": backend.swap_count,
+        "ok": (sink.count == n_frames
+               and backend.swap_count == 1
+               and (post_flip_compiles == 0 or not prewarm)),
+    }
+
+
+def model_swap() -> dict:
+    """Zero-downtime hot-swap family: p99 closed-loop latency through a
+    mid-stream ModelStore.update() with and without pre-warm. The
+    pre-warmed arm must show no recompile-induced spike (post-flip
+    compile growth must be exactly 0 — the same bucket is a staged
+    cache hit); the unwarmed arm documents the spike being avoided.
+    swap_ok gates on the pre-warmed arm: full conservation, one epoch
+    adoption, zero hot-path compiles after the flip."""
+    n_frames = 96 if _on_tpu() else 16
+    out = {"n_frames": n_frames}
+    warm = _swap_arm(True, n_frames)
+    out["prewarmed"] = warm
+    _family_partial(out)
+    cold = _swap_arm(False, n_frames)
+    out["unwarmed"] = cold
+    out["spike_avoided_ms"] = round(
+        cold["post_max_ms"] - warm["post_max_ms"], 3)
+    out["swap_ok"] = bool(warm["ok"] and cold["ok"])
+    return out
+
+
 #: pipeline configs, each its own subprocess family as well — host-path
 #: configs do per-frame D2H, and running them after anything else in
 #: one process measured 2x drift (label 157 -> 76 FPS across trials)
@@ -1328,6 +1429,7 @@ _FAMILIES = {
     "dyn_batch": lambda: dyn_batch_check(),
     "int8_native": lambda: int8_native_check(),
     "chaos_smoke": lambda: chaos_smoke(),
+    "model_swap": lambda: model_swap(),
 }
 for _d in OFFLOAD_DELAYS:
     _FAMILIES[f"offload_{_d}"] = (
@@ -1422,17 +1524,30 @@ def _enable_compile_cache() -> None:
     XLA compilation (the int8-conv family alone compiles ~220-270s)
     into cache hits, letting the full family set fit the 1500s budget.
     Opt out with BENCH_XLA_CACHE=0; relocate with BENCH_XLA_CACHE_DIR.
+    Routed through serving/compile_cache.py (the [serving] config
+    group), so bench subprocesses share the exact persistent-cache
+    wiring — and bucket manifest — production store:// serving uses.
     """
     if os.environ.get("BENCH_XLA_CACHE", "1") == "0":
         return
     cache_dir = os.environ.get(
         "BENCH_XLA_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "nnstpu_xla"))
+    os.environ.setdefault("NNSTREAMER_TPU_SERVING_COMPILE_CACHE", "1")
+    os.environ.setdefault("NNSTREAMER_TPU_SERVING_COMPILE_CACHE_DIR",
+                          cache_dir)
     try:
-        os.makedirs(cache_dir, exist_ok=True)
+        from nnstreamer_tpu.serving.compile_cache import (
+            maybe_enable_compile_cache,
+        )
+
+        if not maybe_enable_compile_cache():
+            return
         import jax
 
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # bench-specific: only cache compiles worth a second — the
+        # cache exists to amortize the multi-minute conv/int8 families,
+        # not to fill with trivial executables
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           1.0)
     except Exception:
@@ -1482,7 +1597,7 @@ def _ordered_families() -> list:
              "mxu_peak", "batch_sweep", "dyn_batch"]
             + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
             + [f"offload_{d}" for d in OFFLOAD_DELAYS]
-            + ["int8_native", "chaos_smoke"])
+            + ["int8_native", "model_swap", "chaos_smoke"])
 
 
 def _has_unverified(v) -> bool:
@@ -1535,6 +1650,10 @@ def _assemble(family_out: dict, errors: dict, env: dict,
     if chaos:
         out["chaos"] = chaos
         out["chaos_ok"] = bool(chaos.get("chaos_ok"))
+    swap = family_out.get("model_swap")
+    if swap:
+        out["model_swap"] = swap
+        out["swap_ok"] = bool(swap.get("swap_ok"))
     # families that completed but flagged part of their own result as
     # unverified (e.g. int8_native without its interpreter oracle) —
     # surfaced as a count so a "0 errors" run can't silently carry

@@ -8,6 +8,9 @@
     python -m nnstreamer_tpu --stats '...pipeline...'  # per-element stats
     python -m nnstreamer_tpu trace '...pipeline...'    # traced run: report
                                                        #  + Chrome trace JSON
+    python -m nnstreamer_tpu models list               # model store contents
+    python -m nnstreamer_tpu models describe NAME      # versions/stats/swaps
+    python -m nnstreamer_tpu models swap NAME [VER]    # hot swap
 """
 
 from __future__ import annotations
@@ -91,10 +94,54 @@ def _trace_main(argv) -> int:
     return 130 if interrupted else 0
 
 
+def _models_main(argv) -> int:
+    """`models` subcommand: the model-store operator surface —
+    list served names, describe one (versions/aliases/stats/swaps),
+    trigger a hot swap."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu models",
+        description="model store: list / describe NAME / swap NAME [VER]")
+    sub = ap.add_subparsers(dest="cmd")
+    sub.add_parser("list", help="list store models (zoo builtins seed @0)")
+    p_desc = sub.add_parser("describe", help="versions, aliases, stats")
+    p_desc.add_argument("name")
+    p_swap = sub.add_parser("swap",
+                            help="hot-swap NAME to VERSION (default latest)")
+    p_swap.add_argument("name")
+    p_swap.add_argument("version", nargs="?", default=None)
+    p_swap.add_argument("--no-prewarm", action="store_true",
+                        help="skip pre-warming attached backends (the hot "
+                             "path then recompiles on first post-swap use)")
+    args = ap.parse_args(argv)
+
+    from nnstreamer_tpu.models.zoo import list_models
+    from nnstreamer_tpu.serving.store import get_store
+
+    store = get_store()
+    if args.cmd in (None, "list"):
+        seeded = set(store.names())
+        for m in sorted(seeded | set(list_models())):
+            e = store.entry(m)
+            cur, epoch = e.state
+            print(f"store://{m}  current=@{cur} epoch={epoch} "
+                  f"versions={sorted(e.versions)}")
+        return 0
+    if args.cmd == "describe":
+        print(json.dumps(store.describe(args.name), indent=2,
+                         default=float))
+        return 0
+    report = store.update(args.name, args.version,
+                          prewarm=not args.no_prewarm)
+    print(json.dumps(report, indent=2, default=float))
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
         return _trace_main(argv[1:])
+    if argv and argv[0] == "models":
+        return _models_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu",
         description="TPU-native streaming AI pipelines (gst-launch parity)")

@@ -45,6 +45,22 @@ class FilterBackend:
     #: as backend_invoke_failures in stats; breaker short-circuits are
     #: NOT counted — the backend was never touched)
     invoke_failures: int = 0
+    #: store:// serving (serving/store.py): epoch adoptions this backend
+    #: has performed (0 for backends not bound to the model store)
+    swap_count: int = 0
+
+    def version_stats(self) -> Dict[int, dict]:
+        """Per-version serving counters for a store-bound backend
+        ({version: {invokes, errors, p95_us}}); empty otherwise.
+        Surfaced by tensor_filter.extra_stats for canary comparisons."""
+        return {}
+
+    def warm_start(self) -> int:
+        """Off-hot-path warmup hook, called by the owning element's
+        start(): a store-bound backend replays its persistent bucket
+        manifest here (serving/compile_cache.py). Returns the number of
+        buckets compiled; default no-op."""
+        return 0
 
     def open(self, props: Dict[str, Any]) -> None:
         """Load the model described by element properties (fw->open)."""

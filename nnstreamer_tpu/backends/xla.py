@@ -82,6 +82,17 @@ _shared_models: Dict[str, _SharedEntry] = {}
 _shared_lock = threading.Lock()
 
 
+@dataclass
+class _VState:
+    """One store version resident in this backend: bundle + device
+    params. Compiled buckets live in `_dyn_jits` under ("v", version)
+    namespaced keys, so retiring a version is a key sweep."""
+
+    version: int
+    bundle: ModelBundle
+    device_params: Any = None
+
+
 def _next_pow2(n: int, floor: int = 1) -> int:
     v = max(n, floor)
     return 1 << (v - 1).bit_length()
@@ -129,6 +140,26 @@ class XLABackend(FilterBackend):
         # tensor_filter.extra_stats and in backend trace spans
         self.cache_hits = 0
         self.cache_misses = 0
+        # cache namespace generation for non-store models: bumped on any
+        # model change (reload / shared-entry adoption) and prefixed
+        # into every _dyn_jits/_batch_ok key, so a stale bucket compiled
+        # against old weights can never be served by key collision
+        self._gen = 0
+        # store:// serving state (serving/store.py): versions are cache-
+        # namespaced by version number instead of _gen, adoption happens
+        # at invoke boundaries (single worker thread per element ⇒ an
+        # invoke sees exactly one version snapshot, never a torn mix)
+        self._store_entry = None                 # serving.store._Entry
+        self._store_ref = None                   # serving.store.StoreRef
+        self._pinned_version: Optional[int] = None
+        self._vstates: Dict[int, "_VState"] = {}
+        self._adopted_version: Optional[int] = None
+        self.adopted_epoch = -1                  # store barrier reads this
+        self._canary: Optional[Tuple[int, float]] = None
+        self._canary_rng = None
+        self._staged: Dict[int, dict] = {}       # version → prewarmed state
+        self._served: "OrderedDict[tuple, bool]" = OrderedDict()
+        self.swap_count = 0                      # epoch adoptions observed
 
     # -- open / model resolution ------------------------------------------
     def open(self, props: Dict[str, Any]) -> None:
@@ -153,6 +184,9 @@ class XLABackend(FilterBackend):
         self._loader_opts = opts
         accel = props.get("accelerator") or ""
         self._device = self._pick_device(accel)
+        if isinstance(model, str) and model.startswith("store://"):
+            self._open_store(model, props)
+            return
         key = props.get("shared_tensor_filter_key") or None
         self._shared_key = key
         if key is not None:
@@ -197,9 +231,82 @@ class XLABackend(FilterBackend):
             self._device_params = None
         log.info("opened model %s on %s", self._bundle.name or model, self._device)
 
+    def _open_store(self, model: str, props: Dict[str, Any]) -> None:
+        """Bind this backend to a served model in the process-wide
+        ModelStore (serving/store.py): resolve the baseline version,
+        attach as a swap handle, and set up canary routing when the ref
+        carries a split (``store://name@2:0.05``)."""
+        import random as _random
+
+        import jax
+
+        if props.get("shared_tensor_filter_key"):
+            raise BackendError(
+                "store:// models are already process-shared through the "
+                "model store; shared-tensor-filter-key cannot combine "
+                "with a store reference — drop the key")
+        from nnstreamer_tpu.serving.compile_cache import (
+            maybe_enable_compile_cache,
+        )
+        from nnstreamer_tpu.serving.store import get_store, parse_store_ref
+
+        maybe_enable_compile_cache()
+        ref = parse_store_ref(model)
+        entry = get_store().entry(ref.name)
+        self._store_entry = entry
+        self._store_ref = ref
+        # room for two live versions' bucket sets + a staged prewarm
+        self._dyn_cache_max = max(self._dyn_cache_max, 32)
+        cur, epoch = entry.state
+        if ref.version is not None:
+            self._pinned_version = entry.resolve_version(ref.version)
+            base = self._pinned_version
+        else:
+            base = entry.resolve_version(None)
+        self._adopted_version = base
+        self.adopted_epoch = epoch
+        self._vstates[base] = self._make_vstate(base, entry.bundle(base))
+        self._bundle = self._vstates[base].bundle
+        self._device_params = self._vstates[base].device_params
+        if ref.canary_version is not None:
+            cv = entry.resolve_version(ref.canary_version)
+            if cv == base:
+                raise BackendError(
+                    f"canary reference {model!r} routes to the baseline "
+                    f"version @{base} itself; pick a different version "
+                    f"to canary")
+            self._canary = (cv, ref.canary_ratio)
+            self._canary_rng = _random.Random(
+                int(props.get("canary_seed") or 0))
+            self._vstates[cv] = self._make_vstate(cv, entry.bundle(cv))
+        entry.attach(self)
+        log.info("opened store model %s@%d epoch=%d%s on %s", ref.name,
+                 base, epoch,
+                 f" canary=@{self._canary[0]}:{self._canary[1]}"
+                 if self._canary else "", self._device)
+
+    @property
+    def tracks_store_epoch(self) -> bool:
+        """True when this handle follows ``current`` (un-pinned), i.e.
+        participates in the swap barrier."""
+        return self._store_entry is not None and self._pinned_version is None
+
+    def _make_vstate(self, version: int, bundle: ModelBundle) -> _VState:
+        import jax
+
+        return _VState(
+            version=version, bundle=bundle,
+            device_params=jax.device_put(bundle.params, self._device)
+            if bundle.params is not None else None)
+
     def _resolve(self, model) -> ModelBundle:
         if isinstance(model, ModelBundle):
             return model
+        if isinstance(model, str) and model.startswith("store://"):
+            raise BackendError(
+                f"{model!r} resolves through the ModelStore at open(); "
+                f"store refs cannot nest as version sources — register "
+                f"the underlying model instead")
         if callable(model):
             return ModelBundle(
                 fn=lambda params, *xs: model(*xs),
@@ -258,6 +365,12 @@ class XLABackend(FilterBackend):
         self._device_params = None
         self._dyn_jits.clear()
         self._batch_ok.clear()
+        if self._store_entry is not None:
+            # detach the swap handle but keep the entry reference:
+            # version_stats() stays readable for post-stop reports
+            self._store_entry.detach(self)
+            self._vstates.clear()
+            self._staged.clear()
         if self._shared is not None:
             with _shared_lock:
                 self._shared.holders -= 1
@@ -298,12 +411,16 @@ class XLABackend(FilterBackend):
         return self._out_spec
 
     def _abstract_params(self):
+        return self._abstract_of(self._device_params)
+
+    @staticmethod
+    def _abstract_of(params):
         import jax
 
-        if self._device_params is None:
+        if params is None:
             return None
         return jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._device_params
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params
         )
 
     # -- fusion ------------------------------------------------------------
@@ -323,8 +440,8 @@ class XLABackend(FilterBackend):
         self._jitted = None  # recompile with the fused graph
         return True
 
-    def _full_fn(self, count: bool = True):
-        bundle = self._bundle
+    def _full_fn(self, count: bool = True, bundle: ModelBundle = None):
+        bundle = bundle or self._bundle
         pre, post = self._pre, self._post
 
         def full(packed, *xs):
@@ -354,16 +471,244 @@ class XLABackend(FilterBackend):
                 self._bundle = self._shared.bundle
                 self._device_params = self._shared.device_params
                 self._jitted = None
+                self._gen += 1           # new cache namespace
                 self._dyn_jits.clear()
                 self._batch_ok.clear()
                 self._jitted_version = self._shared.version
             return self._shared.device_params
         return self._device_params
 
+    # -- store serving (serving/store.py handle protocol) ------------------
+    def _ns(self, version: Optional[int] = None) -> tuple:
+        """Cache-namespace prefix: every _dyn_jits/_batch_ok key starts
+        with this, so no model change can serve a stale compile by key
+        collision — ("v", version) for store models (retired by version
+        sweep), ("g", generation) otherwise (cleared + bumped on
+        reload/shared adoption)."""
+        if self._store_entry is not None:
+            return ("v", version if version is not None
+                    else self._adopted_version)
+        return ("g", self._gen)
+
+    def _pick_version(self) -> int:
+        """Adopt a flipped epoch, then route this invoke: the pinned
+        version (immune to swaps), the canary version at its seeded
+        ratio, or the tracked current."""
+        e = self._store_entry
+        if self._pinned_version is not None:
+            return self._pinned_version
+        cur, epoch = e.state             # one read = consistent pair
+        if epoch != self.adopted_epoch:
+            self._adopt(cur, epoch)
+        if (self._canary is not None
+                and self._canary_rng.random() < self._canary[1]):
+            return self._canary[0]
+        return self._adopted_version
+
+    def _adopt(self, cur: int, epoch: int) -> None:
+        """Flip this backend to the new current version (runs on the
+        element's single worker thread, at an invoke boundary): install
+        the pre-warmed state staged by `prewarm_version`, retire the
+        outgoing version's compiled buckets, and keep self._bundle /
+        _device_params pointing at the adopted version so negotiation-
+        era paths (eval_shape, flexible invokes) follow along."""
+        old = self._adopted_version
+        staged = self._staged.pop(cur, None)
+        if cur not in self._vstates:
+            if staged is not None:
+                self._vstates[cur] = staged["vstate"]
+            else:                        # un-prewarmed swap: resolve now
+                self._vstates[cur] = self._make_vstate(
+                    cur, self._store_entry.bundle(cur))
+        if staged is not None:
+            for basekey, jitted in staged["jits"].items():
+                self._insert_jit((("v", cur),) + basekey, jitted)
+        live = {cur}
+        if self._canary is not None:
+            live.add(self._canary[0])
+        if self._pinned_version is not None:
+            live.add(self._pinned_version)
+        for v in [v for v in self._vstates if v not in live]:
+            del self._vstates[v]         # drops old device params
+        for cache in (self._dyn_jits, self._batch_ok):
+            for k in [k for k in cache
+                      if k[0][0] == "v" and k[0][1] not in live]:
+                del cache[k]
+        self._jitted = None
+        vs = self._vstates[cur]
+        self._bundle, self._device_params = vs.bundle, vs.device_params
+        self._adopted_version, self.adopted_epoch = cur, epoch
+        self.swap_count += 1
+        self.tracer.record_swap(
+            self.trace_name or "xla", time.perf_counter(),
+            model=self._store_entry.name, from_version=old,
+            to_version=cur, epoch=epoch, prewarmed=staged is not None)
+        log.info("adopted %s@%d epoch=%d (from @%s, prewarmed=%s)",
+                 self._store_entry.name, cur, epoch, old,
+                 staged is not None)
+
+    def prewarm_version(self, version: int, bundle: ModelBundle) -> int:
+        """Compile the incoming version against every bucket this
+        backend has served, OFF the hot path (called from the
+        swap-controller thread, before the epoch flips). The compiled
+        jits are staged — the worker installs them at adoption, so the
+        post-flip hot path only ever takes cache hits. AOT lower().
+        compile() does not populate jit's call cache, so the warmup
+        actually CALLS each jit on zero inputs and blocks. A version
+        that rejects a served bucket raises here, aborting the swap
+        before anything flips. Returns the bucket count compiled."""
+        import jax
+        import numpy as np_
+
+        vs = self._make_vstate(version, bundle)
+        packed = (vs.device_params, getattr(self, "_post_aux", None))
+        jits: Dict[tuple, Any] = {}
+        compiled = 0
+        for basekey in list(self._served):
+            specs = self._bucket_array_specs(basekey)
+            if specs is None:
+                continue             # flexible seq/bat: recompile lazily
+            if (("v", version),) + basekey in self._dyn_jits:
+                continue             # already live (e.g. was the canary)
+            jitted = jax.jit(self._full_fn(bundle=bundle))
+            args = tuple(
+                jax.device_put(np_.zeros(s, dtype=np_.dtype(d)),
+                               self._device) for s, d in specs)
+            try:
+                out = _to_tuple(jitted(packed, *args))
+                for o in out:
+                    getattr(o, "block_until_ready", lambda: None)()
+            except Exception as e:
+                raise BackendError(
+                    f"pre-warm of {self._store_entry.name}@{version} "
+                    f"failed on served bucket {basekey[0]} "
+                    f"{[s for s, _ in specs]}: {e} — swap aborted before "
+                    f"the epoch flip; the serving version is unchanged"
+                ) from e
+            jits[basekey] = jitted
+            compiled += 1
+        self._staged[version] = {"vstate": vs, "jits": jits}
+        return compiled
+
+    def warm_start(self) -> int:
+        """Replay the persistent manifest's bucket set for the bound
+        version (called by tensor_filter.start(), off the hot path):
+        against a warm XLA disk cache these compile as fast loads, so a
+        restarted process serves its first real buffer from cache."""
+        if self._store_entry is None:
+            return 0
+        import jax
+        import numpy as np_
+
+        from nnstreamer_tpu.serving.compile_cache import manifest_buckets
+
+        ver = self._adopted_version
+        vs = self._vstates.get(ver)
+        if vs is None:
+            return 0
+        packed = (vs.device_params, getattr(self, "_post_aux", None))
+        compiled = 0
+        for basekey in manifest_buckets(self._store_entry.name, ver):
+            key = (("v", ver),) + basekey
+            if key in self._dyn_jits:
+                continue
+            specs = self._bucket_array_specs(basekey)
+            if specs is None:
+                continue
+            try:
+                jitted = jax.jit(self._full_fn(bundle=vs.bundle))
+                args = tuple(
+                    jax.device_put(np_.zeros(s, dtype=np_.dtype(d)),
+                                   self._device) for s, d in specs)
+                for o in _to_tuple(jitted(packed, *args)):
+                    getattr(o, "block_until_ready", lambda: None)()
+            except Exception as e:
+                # stale manifest (model changed shape since it was
+                # written): warm start is an optimization, never a gate
+                log.warning("warm-start bucket %s skipped: %s",
+                            basekey[:2], e)
+                continue
+            self._insert_jit(key, jitted)
+            self._served.setdefault(basekey, True)
+            compiled += 1
+        if compiled:
+            log.info("warm start: %d manifest buckets compiled for %s@%d",
+                     compiled, self._store_entry.name, ver)
+        return compiled
+
+    @staticmethod
+    def _bucket_array_specs(basekey: tuple):
+        """(shape, dtype) list to materialize a recorded bucket, or None
+        for kinds that are not replayed (flexible seq/bat)."""
+        kind = basekey[0]
+        if kind == "fix":
+            return list(basekey[1:])
+        if kind == "dynb":
+            return list(basekey[2:])
+        return None
+
+    def _note_bucket(self, version: int, basekey: tuple) -> None:
+        if basekey not in self._served:
+            self._served[basekey] = True
+            self._store_entry.note_bucket(version, basekey)
+
+    def version_stats(self) -> Dict[int, dict]:
+        """Per-version invoke/error/p95 counters of the bound store
+        entry (process-wide across handles), for extra_stats."""
+        if self._store_entry is None:
+            return {}
+        return self._store_entry.stats_dict()
+
+    def _record_invoke(self, version: int, t0: float,
+                       error: bool = False) -> float:
+        dt = time.perf_counter() - t0
+        self._store_entry.record(version, dt, error=error)
+        return dt
+
+    def _invoke_store(self, tensors: ArrayTuple) -> ArrayTuple:
+        """Fixed-shape invoke through the store routing point: pick the
+        version (adopting a flipped epoch first), then run its bucketed
+        jit. Keys carry shape+dtype so the bucket is pre-warmable and
+        manifest-replayable."""
+        import jax
+        import numpy as np_
+
+        ver = self._pick_version()
+        vs = self._vstates[ver]
+        if vs.bundle.host_pre is not None:
+            tensors = tuple(vs.bundle.host_pre(tuple(tensors)))
+        arrs = tuple(t if hasattr(t, "shape") else np_.asarray(t)
+                     for t in tensors)
+        basekey = ("fix",) + tuple(
+            (tuple(a.shape), str(a.dtype)) for a in arrs)
+        self._note_bucket(ver, basekey)
+        packed = (vs.device_params, getattr(self, "_post_aux", None))
+        hits0 = self.cache_hits
+        jitted = self._bucket_jit(
+            (("v", ver),) + basekey,
+            make=lambda: jax.jit(self._full_fn(bundle=vs.bundle)))
+        staged = tuple(jax.device_put(a, self._device) for a in arrs)
+        t0 = time.perf_counter()
+        try:
+            out = _to_tuple(jitted(packed, *staged))
+        except Exception:
+            self._record_invoke(ver, t0, error=True)
+            raise
+        dt = self._record_invoke(ver, t0)
+        tr = self.tracer
+        if tr.active:
+            tr.backend_span(self.trace_name or "xla", "invoke", t0,
+                            t0 + dt, version=ver,
+                            compile="cached" if self.cache_hits > hits0
+                            else "fresh")
+        return out
+
     # -- hot loop ----------------------------------------------------------
     def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
         import jax
 
+        if self._store_entry is not None:
+            return self._invoke_store(tensors)
         if self._bundle.host_pre is not None:
             tensors = tuple(self._bundle.host_pre(tuple(tensors)))
         params = self._packed_params()
@@ -405,6 +750,13 @@ class XLABackend(FilterBackend):
         import jax
         import numpy as np_
 
+        if self._store_entry is not None and self._pinned_version is None:
+            # adopt a flipped epoch at the buffer boundary; flexible
+            # invokes always run the adopted current (no canary split —
+            # per-region shapes make the ratio bookkeeping meaningless)
+            cur, epoch = self._store_entry.state
+            if epoch != self.adopted_epoch:
+                self._adopt(cur, epoch)
         if self._bundle.host_pre is not None:
             raise BackendError(
                 f"model {self._bundle.name!r} has a host-side input "
@@ -437,11 +789,11 @@ class XLABackend(FilterBackend):
             n = len(arrs)
             batched, nb, stacked = self._batch_group(arrs, shape, n)
             if batched is None:       # model can't batch: sequential path
-                jitted = self._bucket_jit(("seq",) + shape)
+                jitted = self._bucket_jit((self._ns(), "seq") + shape)
                 for i, a in zip(idxs, arrs):
                     out[i] = _to_tuple(jitted(params, a))[0]
                 continue
-            jitted = self._bucket_jit(("bat", nb) + shape)
+            jitted = self._bucket_jit((self._ns(), "bat", nb) + shape)
             res = _to_tuple(jitted(params, batched))[0]
             for k, i in enumerate(idxs):
                 out[i] = res[k:k + 1] if not stacked else res[k]
@@ -463,7 +815,7 @@ class XLABackend(FilterBackend):
             batched_shape = (nb,) + shape
             stacked = True
         dt = np_.asarray(arrs[0]).dtype
-        verdict_key = (batched_shape, str(dt))
+        verdict_key = (self._ns(), batched_shape, str(dt))
         ok = self._batch_ok.get(verdict_key)
         if ok is None:
             try:
@@ -497,13 +849,15 @@ class XLABackend(FilterBackend):
         import jax
         import numpy as np_
 
+        if self._store_entry is not None:
+            return self._invoke_batched_store(tensors, n, keepdims)
         if self._bundle.host_pre is not None:
             # host_pre parses per-frame bytes; it has no batched form
             return super().invoke_batched(tensors, n, keepdims)
         nb = _next_pow2(n)
         arrs = [np_.asarray(t) for t in tensors]
         batched_shapes = tuple((nb,) + a.shape[1:] for a in arrs)
-        verdict_key = ("dynb",) + tuple(
+        verdict_key = (self._ns(), "dynb") + tuple(
             (s, str(a.dtype)) for s, a in zip(batched_shapes, arrs))
         ok = self._batch_ok.get(verdict_key)
         if ok is None:
@@ -519,16 +873,10 @@ class XLABackend(FilterBackend):
             self._batch_ok[verdict_key] = ok
         if not ok:
             return super().invoke_batched(tensors, n, keepdims)
-        if nb > n:
-            # repeat the last frame's rows: real data keeps padded lanes
-            # numerically tame (vs zeros hitting e.g. a divide), and the
-            # pad rows are sliced away below before anyone sees them
-            arrs = [np_.concatenate(
-                [a, np_.repeat(a[-1:], nb - n, axis=0)], axis=0)
-                for a in arrs]
+        arrs = self._pad_bucket(arrs, n, nb)
         params = self._packed_params()
         hits0 = self.cache_hits
-        jitted = self._bucket_jit(("dynb", nb) + batched_shapes)
+        jitted = self._bucket_jit((self._ns(), "dynb", nb) + batched_shapes)
         staged = tuple(jax.device_put(a, self._device) for a in arrs)
         tr = self.tracer
         if tr.active:
@@ -542,13 +890,83 @@ class XLABackend(FilterBackend):
             out = _to_tuple(jitted(params, *staged))
         return tuple(o[:n] for o in out)
 
-    def _bucket_jit(self, key: tuple):
+    @staticmethod
+    def _pad_bucket(arrs, n: int, nb: int):
+        """Pad a micro-batch up to its pow2 bucket by repeating the last
+        frame's rows: real data keeps padded lanes numerically tame (vs
+        zeros hitting e.g. a divide), and the pad rows are sliced away
+        before anyone sees them."""
+        import numpy as np_
+
+        if nb <= n:
+            return arrs
+        return [np_.concatenate(
+            [a, np_.repeat(a[-1:], nb - n, axis=0)], axis=0)
+            for a in arrs]
+
+    def _invoke_batched_store(self, tensors, n: int, keepdims=()):
+        """Micro-batched invoke through the store routing point: the
+        whole micro-batch goes to ONE version (canary granularity is
+        the buffer). Bucket keys are version-namespaced and carry
+        shape+dtype so `prewarm_version` can compile the exact set the
+        outgoing version served."""
+        import jax
+        import numpy as np_
+
+        ver = self._pick_version()
+        vs = self._vstates[ver]
+        if vs.bundle.host_pre is not None:
+            return super().invoke_batched(tensors, n, keepdims)
+        nb = _next_pow2(n)
+        arrs = [np_.asarray(t) for t in tensors]
+        pairs = tuple(((nb,) + a.shape[1:], str(a.dtype)) for a in arrs)
+        basekey = ("dynb", nb) + pairs
+        verdict_key = (("v", ver),) + basekey
+        ok = self._batch_ok.get(verdict_key)
+        if ok is None:
+            try:
+                args = [jax.ShapeDtypeStruct(s, np_.dtype(d))
+                        for s, d in pairs]
+                jax.eval_shape(self._full_fn(count=False,
+                                             bundle=vs.bundle),
+                               (self._abstract_of(vs.device_params),
+                                getattr(self, "_post_aux", None)), *args)
+                ok = True
+            except Exception:
+                ok = False
+            self._batch_ok[verdict_key] = ok
+        if not ok:
+            return super().invoke_batched(tensors, n, keepdims)
+        arrs = self._pad_bucket(arrs, n, nb)
+        self._note_bucket(ver, basekey)
+        packed = (vs.device_params, getattr(self, "_post_aux", None))
+        hits0 = self.cache_hits
+        jitted = self._bucket_jit(
+            verdict_key,
+            make=lambda: jax.jit(self._full_fn(bundle=vs.bundle)))
+        staged = tuple(jax.device_put(a, self._device) for a in arrs)
+        t0 = time.perf_counter()
+        try:
+            out = _to_tuple(jitted(packed, *staged))
+        except Exception:
+            self._record_invoke(ver, t0, error=True)
+            raise
+        dt = self._record_invoke(ver, t0)
+        tr = self.tracer
+        if tr.active:
+            tr.backend_span(self.trace_name or "xla", "invoke_batched",
+                            t0, t0 + dt, n=n, bucket=nb, version=ver,
+                            cache="hit" if self.cache_hits > hits0
+                            else "miss")
+        return tuple(o[:n] for o in out)
+
+    def _bucket_jit(self, key: tuple, make=None):
         import jax
 
         jitted = self._dyn_jits.pop(key, None)
         if jitted is None:
             self.cache_misses += 1
-            jitted = jax.jit(self._full_fn())
+            jitted = jax.jit(self._full_fn()) if make is None else make()
             if len(self._dyn_jits) >= self._dyn_cache_max:
                 evicted, _ = self._dyn_jits.popitem(last=False)
                 log.info("dyn-shape cache full: evicted %s", evicted)
@@ -557,6 +975,16 @@ class XLABackend(FilterBackend):
         self._dyn_jits[key] = jitted      # re-insert = LRU touch
         return jitted
 
+    def _insert_jit(self, key: tuple, jitted) -> None:
+        """Install a pre-compiled jit (staged prewarm / manifest replay)
+        without touching the hit/miss counters — these compiles happened
+        off the hot path."""
+        if key in self._dyn_jits:
+            return
+        if len(self._dyn_jits) >= self._dyn_cache_max:
+            self._dyn_jits.popitem(last=False)
+        self._dyn_jits[key] = jitted
+
     def reload(self, model: Any) -> None:
         """Hot model swap (is-updatable analog): double-buffered — the new
         bundle is resolved and staged before the old one is dropped. For a
@@ -564,6 +992,14 @@ class XLABackend(FilterBackend):
         pick it up on their next invoke."""
         import jax
 
+        if self._store_entry is not None:
+            raise BackendError(
+                f"this filter serves {self._store_entry.name!r} through "
+                f"the model store; per-filter reload would fork it from "
+                f"the registry — register the new weights as a version "
+                f"and ModelStore.update({self._store_entry.name!r}, "
+                f"<version>) instead (or `python -m nnstreamer_tpu "
+                f"models swap`)")
         new_bundle = self._resolve(model)
         new_params = (
             jax.device_put(new_bundle.params, self._device)
@@ -578,5 +1014,6 @@ class XLABackend(FilterBackend):
             return
         self._bundle, self._device_params = new_bundle, new_params
         self._jitted = None
+        self._gen += 1               # new cache namespace
         self._dyn_jits.clear()
         self._batch_ok.clear()

@@ -48,6 +48,12 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         "queue_capacity": "4",       # per-link buffer queue depth
         "drop_on_overrun": "0",      # leaky-queue behavior
     },
+    "serving": {
+        # persistent XLA compile cache + bucket manifest for store://
+        # models (serving/compile_cache.py); opt-in
+        "compile_cache": "0",
+        "compile_cache_dir": "~/.cache/nnstreamer_tpu/xla",
+    },
 }
 
 

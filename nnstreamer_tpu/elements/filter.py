@@ -83,6 +83,11 @@ class TensorFilter(Element):
             "accept FLEXIBLE input (per-buffer shapes, bucketed recompile)"),
         "shared_tensor_filter_key": PropDef(
             str, "", "share one device model across filters with this key"),
+        # store:// serving (docs/serving.md): canary splits are routed
+        # per-invoke by a deterministic seeded RNG so a run is exactly
+        # reproducible; the seed is per-filter
+        "canary_seed": PropDef(
+            int, 0, "seed for store:// canary routing (deterministic)"),
         # circuit breaker around backend invokes (docs/robustness.md):
         # after `breaker_threshold` consecutive invoke failures the
         # circuit opens and invokes short-circuit with CircuitOpenError
@@ -202,7 +207,7 @@ class TensorFilter(Element):
 
                 if ext in MODEL_EXTENSIONS:
                     return MODEL_EXTENSIONS[ext]
-            if model.startswith("zoo://"):
+            if model.startswith(("zoo://", "store://")):
                 return "xla"
         if callable(model) or type(model).__name__ == "ModelBundle":
             return "xla"
@@ -359,6 +364,11 @@ class TensorFilter(Element):
             # spans land on this element's trace track
             self.backend.tracer = self._tracer
             self.backend.trace_name = self.name
+            # store-bound backends replay their persistent bucket
+            # manifest here — start() runs before any buffer flows, so
+            # a restarted process compiles its working set off the hot
+            # path (warm against the on-disk XLA cache)
+            self.backend.warm_start()
 
     def stop(self) -> None:
         if self.backend is not None:
@@ -373,6 +383,17 @@ class TensorFilter(Element):
             v = getattr(self.backend, k, None)
             if v is not None:
                 out["backend_" + k] = v
+        # store:// serving: per-version invoke/error/p95 counters +
+        # epoch adoptions, under backend_ keys so report()'s backend
+        # section renders the canary comparison without extra tooling
+        vstats = getattr(self.backend, "version_stats", None)
+        if vstats is not None:
+            for ver, d in vstats().items():
+                for k, v in d.items():
+                    out[f"backend_v{ver}_{k}"] = v
+        swaps = getattr(self.backend, "swap_count", 0)
+        if swaps:
+            out["backend_swaps"] = swaps
         if self._breaker is not None:
             for k, v in self._breaker.stats().items():
                 out["breaker_" + k] = v

@@ -18,10 +18,28 @@ _lock = threading.Lock()
 
 
 def register_model(name: str):
-    """`@register_model("mobilenet_v2")` on a builder(**kwargs)->ModelBundle."""
+    """`@register_model("mobilenet_v2")` on a builder(**kwargs)->ModelBundle.
+
+    Names are unique: a second registration raises (the zoo seeds the
+    model store as version ``@0``, and store versions are immutable —
+    register variants under the store instead, ``ModelStore.register``).
+    """
     def deco(fn):
         with _lock:
+            prev = _builders.get(name)
+            if prev is not None and prev is not fn:
+                raise BackendError(
+                    f"zoo model {name!r} is already registered (builder "
+                    f"{prev.__module__}.{prev.__qualname__}); zoo names "
+                    f"seed the model store as {name!r}@0 and versions "
+                    f"are immutable — register updated weights via "
+                    f"ModelStore.register({name!r}, ...) instead")
             _builders[name] = fn
+        # seed the model store so store://<name> serves this builder as
+        # version @0 (idempotent; lazy import avoids a module cycle)
+        from nnstreamer_tpu.serving.store import get_store
+
+        get_store().seed_zoo(name, fn)
         return fn
     return deco
 

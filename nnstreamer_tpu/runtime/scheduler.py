@@ -434,6 +434,17 @@ class PipelineRunner:
                 kv = " ".join(f"{k[len('backend_'):]}={v}"
                               for k, v in sorted(b.items()))
                 lines.append(f"  {name}: {kv}")
+        swaps = tr.swap_events() if tr.active else []
+        if swaps:
+            lines.append("")
+            lines.append("model swaps (store:// epoch adoptions):")
+            for name, t, args in swaps:
+                lines.append(
+                    f"  {name}: {args.get('model', '?')} "
+                    f"v{args.get('from_version', '?')} → "
+                    f"v{args.get('to_version', '?')} "
+                    f"epoch={args.get('epoch', '?')} "
+                    f"prewarmed={args.get('prewarmed', 0)}")
         return "\n".join(lines)
 
     # -- internals ---------------------------------------------------------

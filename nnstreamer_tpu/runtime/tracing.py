@@ -93,6 +93,9 @@ class NullTracer:
     def backend_span(self, name, kind, t0, t1, **args):
         pass
 
+    def record_swap(self, name, t, **args):
+        pass
+
     def instant(self, name, label, t=None, **args):
         pass
 
@@ -127,6 +130,10 @@ class Tracer:
         self._interlat: Dict[str, Deque[float]] = {}
         # dst element name -> {"peak": max depth ever sampled}
         self._gauges: Dict[str, Dict[str, int]] = {}
+        # model hot-swap adoptions (serving/store.py): kept whole (not
+        # just ring events) so report() can render every swap even after
+        # the event ring wraps
+        self._swaps: List[Tuple[str, float, dict]] = []
 
     # -- scheduler hooks ---------------------------------------------------
     def source_emit(self, name: str, buf, t: float) -> None:
@@ -183,6 +190,17 @@ class Tracer:
         """Backend-side span (compile/invoke) attributed to the owning
         tensor_filter's track; args carry bucket/cache-hit details."""
         self._append("X", "backend", name, kind, t0, t1 - t0, args or None)
+
+    def record_swap(self, name: str, t: float, **args) -> None:
+        """A store-driven model hot swap adopted by `name`'s backend
+        (serving/store.py); args carry model/from_version/to_version/
+        epoch/prewarmed."""
+        self._swaps.append((name, t, dict(args)))
+        self._append("i", "swap", name, "model_swap", t, 0.0,
+                     args or None)
+
+    def swap_events(self) -> List[Tuple[str, float, dict]]:
+        return list(self._swaps)
 
     def instant(self, name: str, label: str, t: Optional[float] = None,
                 **args) -> None:
@@ -259,6 +277,7 @@ class Tracer:
             "queues": self.queue_gauges(),
             "events": len(self._events),
             "events_dropped": self.events_dropped,
+            "swaps": len(self._swaps),
         }
 
     def to_chrome_trace(self, pipeline_name: str = "pipeline") -> dict:

@@ -1,0 +1,24 @@
+"""Serving subsystem: versioned model store + zero-downtime hot swap.
+
+Models become live, versioned pipeline citizens (docs/serving.md):
+
+- ``store://name[@version][:canary_ratio]`` refs resolve through the
+  process-wide :class:`ModelStore` instead of binding a model once at
+  negotiation; zoo builtins seed the store at version ``@0`` so
+  ``zoo://`` and ``store://`` interoperate.
+- ``store.update(name, version)`` is an epoch-based hot swap: the
+  incoming version is pre-warmed off the hot path (same dyn_batch
+  buckets the outgoing version served), the epoch flips atomically, and
+  attached backends adopt the new version at their next invoke boundary
+  — in-flight invokes finish on the old version, new buffers take the
+  new one, and the old version's compiled buckets are retired.
+- A persistent compile cache (``[serving]`` config group) plus a
+  store-level bucket manifest lets restarted processes start warm.
+"""
+from nnstreamer_tpu.serving.store import (  # noqa: F401
+    ModelStore,
+    StoreRef,
+    get_store,
+    parse_store_ref,
+    reset_store,
+)
