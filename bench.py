@@ -82,14 +82,16 @@ class _Bench:
     the strict per-frame variant, like the offload config's two
     clients)."""
 
-    def __init__(self, build, frames_per_push=1, build_lat=None, lag=0):
+    def __init__(self, build, frames_per_push=1, build_lat=None, lag=0,
+                 runner_kwargs=None):
         import nnstreamer_tpu as nns
 
         self.pipe, self.src, self.sink, self.frame = build()
         self.frames_per_push = frames_per_push
         self.build_lat = build_lat
         self.lag = lag          # emissions a pipelined stage may withhold
-        self.runner = nns.PipelineRunner(self.pipe, queue_capacity=4).start()
+        self.runner = nns.PipelineRunner(self.pipe, queue_capacity=4,
+                                         **(runner_kwargs or {})).start()
         self._pts = 0
 
     def _push(self):
@@ -1405,6 +1407,52 @@ def model_swap() -> dict:
     return out
 
 
+def host_path() -> dict:
+    """Host-path tax family (the BENCH_r05 finding: ~34k fps raw device
+    invoke vs ~309 piped_fps). Three measurements, streamed as they
+    land: scheduler wakeup latency vs the old 100 ms poll floor,
+    per-hop overhead through a passthrough chain fused vs unfused, and
+    the piped_fps A/B on the real label config with chain fusion
+    off/on. Reuses tools/profile_hostpath.py (also the tier-1 smoke
+    test) so the bench, the profiler, and the test measure one code
+    path."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "profile_hostpath",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "profile_hostpath.py"))
+    ph = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ph)
+
+    out = {"wakeup_latency": ph.measure_wakeup_latency(n=200)}
+    _family_partial(out)
+    frames = 2000 if _on_tpu() else 1200
+    fused = ph.measure_hop_overhead(4, frames, fused=True)
+    unfused = ph.measure_hop_overhead(4, frames, fused=False)
+    out["hop_overhead"] = {
+        "fused": fused,
+        "unfused": unfused,
+        "fused_speedup": round(
+            unfused["per_frame_us"] / fused["per_frame_us"], 2)
+        if fused["per_frame_us"] else 0.0,
+    }
+    _family_partial(out)
+    # before/after piped_fps: the same label pipeline, fusion off vs on
+    piped = {}
+    for key, enabled in (("fusion_off", False), ("fusion_on", True)):
+        piped[key] = _Bench(
+            _build_label,
+            runner_kwargs={"chain_fusion": enabled}).run()
+        _family_partial({**out, "piped_fps": piped})
+    f_off = piped["fusion_off"].get("fps") or 0.0
+    f_on = piped["fusion_on"].get("fps") or 0.0
+    piped["fps_delta_pct"] = (round((f_on - f_off) / f_off * 100, 1)
+                              if f_off else 0.0)
+    out["piped_fps"] = piped
+    return out
+
+
 #: pipeline configs, each its own subprocess family as well — host-path
 #: configs do per-frame D2H, and running them after anything else in
 #: one process measured 2x drift (label 157 -> 76 FPS across trials)
@@ -1430,6 +1478,7 @@ _FAMILIES = {
     "int8_native": lambda: int8_native_check(),
     "chaos_smoke": lambda: chaos_smoke(),
     "model_swap": lambda: model_swap(),
+    "host_path": lambda: host_path(),
 }
 for _d in OFFLOAD_DELAYS:
     _FAMILIES[f"offload_{_d}"] = (
@@ -1594,7 +1643,7 @@ def _ordered_families() -> list:
     if os.environ.get("BENCH_SELFTEST") == "fake":
         return list(_FAMILIES)
     return (["cfg_label_device", "pallas", "transformer_prefill",
-             "mxu_peak", "batch_sweep", "dyn_batch"]
+             "mxu_peak", "batch_sweep", "dyn_batch", "host_path"]
             + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
             + [f"offload_{d}" for d in OFFLOAD_DELAYS]
             + ["int8_native", "model_swap", "chaos_smoke"])

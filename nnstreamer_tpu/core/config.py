@@ -47,6 +47,14 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
     "runtime": {
         "queue_capacity": "4",       # per-link buffer queue depth
         "drop_on_overrun": "0",      # leaky-queue behavior
+        # scheduler-level chain fusion: run linear chains of cheap
+        # single-in/single-out elements in one worker thread (direct
+        # call-through, no channel hop per element)
+        "chain_fusion": "1",
+        # donate freshly-staged input buffers to bucketed XLA invokes
+        # (HBM churn reduction; ignored on CPU where XLA aliases host
+        # memory anyway)
+        "donate_inputs": "1",
     },
     "serving": {
         # persistent XLA compile cache + bucket manifest for store://

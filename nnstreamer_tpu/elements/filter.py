@@ -59,6 +59,10 @@ class TensorFilter(Element):
     # invoke_batched, bucket-compiled) and forwards batched outputs with
     # the dyn_batch meta intact for tensor_unbatch downstream
     ACCEPTS_DYN_BATCH = True
+    # never chain-fused: the filter's dedicated worker thread is what
+    # lets its device dispatch overlap upstream conversion (the async-
+    # dispatch property the scheduler exists to provide)
+    CHAIN_FUSABLE = False
     PROPS = {
         "framework": PropDef(str, "", "backend name (xla|custom|pallas|…)"),
         "model": PropDef(lambda s: s, None, "model reference (backend-specific)"),
@@ -379,7 +383,8 @@ class TensorFilter(Element):
         stats() row (absent for backends that don't track them)."""
         out = {}
         for k in ("compile_count", "cache_hits", "cache_misses",
-                  "invoke_failures"):
+                  "invoke_failures", "staging_transfers",
+                  "staging_elided", "donated_invokes"):
             v = getattr(self.backend, k, None)
             if v is not None:
                 out["backend_" + k] = v

@@ -101,6 +101,13 @@ class Element:
     #: scheduler starts async D2H copies when queueing buffers toward it,
     #: overlapping transfers with other in-flight frames
     WANTS_HOST: bool = False
+    #: eligible for scheduler-level chain fusion (runtime/scheduler.py):
+    #: linear runs of cheap single-in/single-out elements execute in one
+    #: worker thread with direct call-through instead of a thread+channel
+    #: hop each. Elements whose process() should keep a dedicated thread
+    #: (tensor_filter: device dispatch must overlap upstream conversion)
+    #: set this False.
+    CHAIN_FUSABLE: bool = True
     #: tracing hook surface — the runner assigns the session tracer to
     #: every element before start(); elements emit custom events with
     #: `if self._tracer.active: self._tracer.instant(self.name, ...)`

@@ -1,0 +1,154 @@
+"""Condition-variable MPSC channel — the hot link between elements.
+
+Replaces the per-element ``queue.Queue`` + timeout-poll loops the
+scheduler used to run. ``queue.Queue`` forced two compromises on the
+host path:
+
+- **polling wakeups**: consumers slept in ``get(timeout=0.1)`` and
+  producers retried ``put(timeout=0.1)`` so teardown could be noticed —
+  a 100 ms latency floor on an idle hop and constant spurious wakeups
+  on a busy one;
+- **lost teardown wakeups**: ``stop()`` nudged sleepers with
+  ``put_nowait((None, EOS, 0.0))``, which silently drops on a full
+  queue, leaving the worker to ride out its poll timeout.
+
+``Channel`` fixes both with one lock and two condition variables:
+``put`` wakes the consumer the instant a buffer lands, ``get`` wakes a
+blocked producer the instant a slot frees, and ``close()`` does
+``notify_all`` on both conditions — a teardown wakeup that *cannot* be
+lost, full queue or not. Waits are untimed (or bounded by the caller's
+deadline for timer elements), so an idle pipeline burns zero CPU and an
+enqueue→dequeue handoff costs one lock round-trip instead of up to
+100 ms.
+
+Depth accounting rides along for free: ``put``/``get`` return the
+queue depth observed *under the already-held lock*, so the scheduler's
+always-on ``queue_peak`` high-water mark and the tracer's queuelevel
+gauges no longer pay an extra ``qsize()`` lock acquisition per buffer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Optional, Tuple
+
+
+class _Sentinel:
+    __slots__ = ("_name",)
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __repr__(self):
+        return self._name
+
+
+#: ``get()`` result when the channel was closed (teardown) and empty.
+CLOSED = _Sentinel("CLOSED")
+#: ``get(deadline=...)`` result when the deadline passed with no item.
+TIMED_OUT = _Sentinel("TIMED_OUT")
+
+
+class Channel:
+    """Bounded multi-producer / single-consumer channel.
+
+    - ``put(item)`` blocks while full, returns the post-append depth —
+      or ``None`` when the channel closed while (or before) waiting,
+      meaning the item was **not** delivered.
+    - ``get(deadline=None)`` blocks until an item is available and
+      returns ``(item, depth_after_pop)``; returns ``(CLOSED, 0)`` once
+      the channel is closed *and* drained, or ``(TIMED_OUT, 0)`` when
+      the ``time.perf_counter()``-based deadline expires first.
+    - ``close()`` wakes every waiter on both sides, exactly once each.
+
+    Items already buffered when ``close()`` lands are still handed out
+    (consumers check the runner's stop event themselves); only *new*
+    puts are refused.
+    """
+
+    __slots__ = ("_buf", "_cap", "_closed", "_lock", "_not_empty",
+                 "_not_full", "peak")
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"channel capacity must be >= 1, got "
+                             f"{capacity}")
+        self._buf: deque = deque()
+        self._cap = capacity
+        self._closed = False
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        #: high-water mark, maintained under the put-side lock hold
+        self.peak = 0
+
+    # -- producer side -----------------------------------------------------
+    def put(self, item: Any) -> Optional[int]:
+        with self._not_full:
+            while len(self._buf) >= self._cap and not self._closed:
+                self._not_full.wait()
+            if self._closed:
+                return None
+            self._buf.append(item)
+            depth = len(self._buf)
+            if depth > self.peak:
+                self.peak = depth
+            self._not_empty.notify()
+            return depth
+
+    def try_put(self, item: Any) -> Optional[int]:
+        """Non-blocking put: depth on success, ``None`` when full or
+        closed (leaky-mode / best-effort producers)."""
+        with self._not_full:
+            if self._closed or len(self._buf) >= self._cap:
+                return None
+            self._buf.append(item)
+            depth = len(self._buf)
+            if depth > self.peak:
+                self.peak = depth
+            self._not_empty.notify()
+            return depth
+
+    # -- consumer side -----------------------------------------------------
+    def get(self, deadline: Optional[float] = None) -> Tuple[Any, int]:
+        with self._not_empty:
+            while not self._buf:
+                if self._closed:
+                    return CLOSED, 0
+                if deadline is None:
+                    self._not_empty.wait()
+                else:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0.0:
+                        return TIMED_OUT, 0
+                    self._not_empty.wait(remaining)
+            item = self._buf.popleft()
+            self._not_full.notify()
+            return item, len(self._buf)
+
+    # -- lifecycle / introspection ----------------------------------------
+    def close(self) -> None:
+        """Refuse further puts and wake every waiter (guaranteed
+        teardown wakeup — nothing to lose to a full buffer)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def qsize(self) -> int:
+        return len(self._buf)      # len() is GIL-atomic; no lock needed
+
+    def full(self) -> bool:
+        return len(self._buf) >= self._cap

@@ -10,18 +10,34 @@ tensor_filter_tensorrt.cc:239).
 Dataflow rules:
 - Sources run a pump thread iterating `generate()`.
 - Every buffer delivered to `Element.process(pad, buf)`; emissions are
-  routed by (element, src_pad) → link → destination queue.
+  routed by (element, src_pad) → link → destination channel.
 - EOS: a sentinel per pad; when all sink pads of an element saw EOS, the
   element's `flush()` drains (aggregation windows…), then EOS cascades.
 - Errors: any exception in a worker stops the pipeline and re-raises from
   `wait()` (GST_FLOW_ERROR analog: fail loud, never hang).
-- Backpressure: bounded queues block the producer ([runtime]
+- Backpressure: bounded channels block the producer ([runtime]
   queue_capacity), or drop oldest when an element opts into leaky mode.
+
+Host-path design (docs/performance.md):
+
+- Links are `runtime/channel.py` condition-variable channels, not
+  `queue.Queue`s: consumers wake on enqueue, producers on dequeue —
+  no 100 ms poll floor, no idle CPU, and teardown (`Channel.close()`)
+  wakes every waiter unconditionally. Timer elements (`next_deadline()`)
+  get a deadline-bounded wait instead of a fixed 0.1 s tick.
+- **Chain fusion** ([runtime] chain_fusion, default on): maximal linear
+  runs of cheap single-in/single-out elements with `error-policy=fail`
+  (converter→transform→decoder chains) execute in ONE worker thread
+  with direct call-through — per-frame GIL handoffs drop from
+  O(elements) to O(stages). tensor_filter (CHAIN_FUSABLE=False: its
+  thread is what overlaps device dispatch with upstream conversion),
+  sources/sinks, fan-in/fan-out, non-fail policies and `next_deadline`
+  users keep dedicated threads. Stats, interlatency tracing and
+  EOS/flush ordering stay attributed per element.
 """
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -30,6 +46,7 @@ from nnstreamer_tpu.core.config import get_config
 from nnstreamer_tpu.core.errors import PipelineError, StreamError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.graph.pipeline import Element, Link, Pipeline, SourceElement
+from nnstreamer_tpu.runtime.channel import CLOSED, TIMED_OUT, Channel
 from nnstreamer_tpu.runtime.tracing import NULL_TRACER, Tracer
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 
@@ -43,6 +60,16 @@ class _EOSType:
 
 #: end-of-stream sentinel
 EOS = _EOSType()
+
+
+class _ChainFailure(Exception):
+    """Internal: a fused-chain member's process()/flush() raised; carries
+    the failing element so `_fail` attributes the error correctly."""
+
+    def __init__(self, elem: Element, exc: BaseException):
+        super().__init__(str(exc))
+        self.elem = elem
+        self.exc = exc
 
 
 class ElementStats:
@@ -152,7 +179,8 @@ class PipelineRunner:
                  watchdog: Optional[bool] = None,
                  stall_budget_s: Optional[float] = None,
                  queue_stall_budget_s: Optional[float] = None,
-                 watchdog_action: Optional[str] = None):
+                 watchdog_action: Optional[str] = None,
+                 chain_fusion: Optional[bool] = None):
         self.pipeline = pipeline
         self._optimize = optimize
         # trace=False → NULL_TRACER (hot path pays one attribute load);
@@ -165,7 +193,15 @@ class PipelineRunner:
             self.tracer = NULL_TRACER
         cap = queue_capacity or get_config().get_int("runtime", "queue_capacity", 4)
         self._cap = max(1, cap)
-        self._queues: Dict[str, "queue.Queue"] = {}
+        self._queues: Dict[str, Channel] = {}
+        # chain fusion: head name -> ordered member list, member name ->
+        # head name (built in start(), after transform fusion)
+        if chain_fusion is None:
+            chain_fusion = get_config().get_bool(
+                "runtime", "chain_fusion", True)
+        self._chain_fusion = bool(chain_fusion)
+        self._chains: Dict[str, List[Element]] = {}
+        self._chain_member: Dict[str, str] = {}
         # built in start(), AFTER transform fusion removed elements —
         # fused-away elements must not appear as zero-count stats rows
         self._stats: Dict[str, ElementStats] = {}
@@ -207,6 +243,12 @@ class PipelineRunner:
         # (or flush()); written/cleared by the worker, read by the
         # watchdog — GIL-atomic dict ops, no lock needed
         self._inflight: Dict[str, float] = {}
+        # watchdog incident bookkeeping — pruned the moment an element
+        # (or its queue) recovers, so the dicts stay bounded by the set
+        # of *currently* wedged elements, not everything ever warned
+        self._wd_warned_proc: Dict[str, float] = {}
+        self._wd_q_full_since: Dict[str, float] = {}
+        self._wd_warned_q: Dict[str, float] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "PipelineRunner":
@@ -232,13 +274,23 @@ class PipelineRunner:
             e.start()
         for l in pipe.links:
             self._route[(l.src.name, l.src_pad)] = l
+        self._build_chains()
+        # only elements that receive buffers over a link need a channel:
+        # mid-chain members are fed by direct call-through
         for e in pipe.elements.values():
-            if not isinstance(e, SourceElement):
-                self._queues[e.name] = queue.Queue(maxsize=self._cap)
+            if not isinstance(e, SourceElement) \
+                    and e.name not in self._chain_member:
+                self._queues[e.name] = Channel(self._cap)
         for e in pipe.elements.values():
             if isinstance(e, SourceElement):
                 t = threading.Thread(target=self._pump, args=(e,),
                                      name=f"src:{e.name}", daemon=True)
+            elif e.name in self._chains:
+                t = threading.Thread(target=self._chain_work,
+                                     args=(self._chains[e.name],),
+                                     name=f"chain:{e.name}", daemon=True)
+            elif e.name in self._chain_member:
+                continue
             else:
                 t = threading.Thread(target=self._work, args=(e,),
                                      name=f"elem:{e.name}", daemon=True)
@@ -314,12 +366,11 @@ class PipelineRunner:
                     e.interrupt()
                 except Exception:
                     log.exception("error interrupting %s", e.name)
-        # unblock workers waiting on get()
-        for q in self._queues.values():
-            try:
-                q.put_nowait((None, EOS, 0.0))
-            except queue.Full:
-                pass
+        # unblock workers waiting on get() and producers blocked on a
+        # full channel — close() wakes every waiter unconditionally, so
+        # the wakeup cannot be lost the way put_nowait-on-full used to be
+        for ch in self._queues.values():
+            ch.close()
         for e in self.pipeline.elements.values():
             try:
                 e.stop()
@@ -380,12 +431,18 @@ class PipelineRunner:
                 f"{d['proctime_avg_us']:>9.1f} {d['proctime_max_us']:>9.1f} "
                 f"{d['queue_wait_avg_us']:>9.1f} {d['queue_peak']:>6} "
                 f"{d['dropped']:>5} {d['timer_fires']:>6}")
+        if self._chains:
+            lines.append("")
+            lines.append("fused chains (one worker thread, direct "
+                         "call-through):")
+            for chain in self._chains.values():
+                lines.append("  " + " → ".join(m.name for m in chain))
         lines.append("")
         lines.append(f"queue high-water (capacity {self._cap}):")
         for l in self.pipeline.links:
             d = st.get(l.dst.name)
-            if d is None:
-                continue
+            if d is None or l.dst.name in self._chain_member:
+                continue     # mid-chain links have no queue at all
             lines.append(f"  {l.src.name} → {l.dst.name}: "
                          f"peak {d['queue_peak']}/{self._cap}")
         rob = [(name, d) for name, d in sorted(st.items())
@@ -475,6 +532,179 @@ class PipelineRunner:
                 if not consumed:
                     frontier.append(u)
 
+    # -- chain fusion ------------------------------------------------------
+    def _chain_eligible(self, e: Element) -> bool:
+        """Can `e` run as a member of a fused chain? Only cheap linear
+        call-through elements qualify: exactly one in-link and one
+        out-link (no fan-in/fan-out, which excludes sources and sinks),
+        fail-fast error policy (skip/retry/degrade need the per-element
+        worker's policy loop), no timer deadlines (a fused member cannot
+        be woken independently of the chain head), and not opted out via
+        CHAIN_FUSABLE (tensor_filter: its thread IS the async dispatch
+        overlap)."""
+        if isinstance(e, SourceElement) or not e.CHAIN_FUSABLE:
+            return False
+        if e.error_policy.kind != "fail":
+            return False
+        if len(self.pipeline.links_to(e)) != 1 \
+                or len(self.pipeline.links_from(e)) != 1:
+            return False
+        cls = type(e)
+        if cls.next_deadline is not Element.next_deadline \
+                or cls.on_timer is not Element.on_timer:
+            return False
+        return True
+
+    def _build_chains(self) -> None:
+        """Group maximal linear runs of eligible elements into fused
+        chains. Runs in start() after transform fusion, so fused-away
+        transforms never appear as chain members."""
+        if not self._chain_fusion:
+            return
+        pipe = self.pipeline
+        elig = {e.name for e in pipe.elements.values()
+                if self._chain_eligible(e)}
+        for e in pipe.elements.values():
+            if e.name not in elig:
+                continue
+            # heads are eligible elements whose single upstream is not
+            # eligible (an eligible upstream's only out-link feeds us,
+            # so it extends the same chain and we are mid-chain)
+            if pipe.links_to(e)[0].src.name in elig:
+                continue
+            chain = [e]
+            cur = e
+            while True:
+                nxt = pipe.links_from(cur)[0].dst
+                if nxt.name not in elig:
+                    break
+                chain.append(nxt)
+                cur = nxt
+            if len(chain) < 2:
+                continue          # nothing to fuse with
+            self._chains[e.name] = chain
+            for m in chain[1:]:
+                self._chain_member[m.name] = e.name
+            log.debug("pipeline %r: chain-fused %s (one worker thread)",
+                      pipe.name, " → ".join(m.name for m in chain))
+
+    def fused_chains(self) -> List[List[str]]:
+        """Element-name chains the scheduler fused (after start())."""
+        return [[m.name for m in chain]
+                for chain in self._chains.values()]
+
+    def _chain_work(self, chain: List[Element]) -> None:
+        """Worker loop for a fused chain: one channel read at the head,
+        then direct call-through over every member — no thread or
+        channel hop between them."""
+        head, tail = chain[0], chain[-1]
+        ch = self._queues[head.name]
+        head_stats = self._stats[head.name]
+        tr = self.tracer
+        try:
+            while not self._stop_evt.is_set():
+                msg, depth = ch.get()
+                if msg is CLOSED:     # teardown wakeup
+                    return
+                pad, item, t_enq = msg
+                if tr.active:
+                    tr.dequeue(head.name, depth, time.perf_counter())
+                if item is EOS:
+                    # heads have exactly one in-link, so the first EOS
+                    # completes the chain: flush members in order (each
+                    # flush emission still flows through the rest of the
+                    # chain, preserving unfused EOS/flush ordering)
+                    self._chain_flush(chain)
+                    self._broadcast_eos(tail)
+                    return
+                if t_enq:
+                    head_stats.record_wait(time.perf_counter() - t_enq)
+                self._chain_deliver(chain, 0, pad, item)
+        except _ChainFailure as cf:
+            self._fail(cf.elem, cf.exc)
+            try:
+                self._broadcast_eos(tail)
+            except Exception:
+                pass
+        except Exception as e:
+            self._fail(head, e)
+            try:
+                self._broadcast_eos(tail)
+            except Exception:
+                pass
+
+    def _chain_deliver(self, chain: List[Element], start_idx: int,
+                       pad: int, item) -> None:
+        """Push one buffer through chain[start_idx:] by direct calls.
+        Depth-first over emissions so buffer order at the tail matches
+        the unfused schedule (all descendants of an element's first
+        emission drain before its second). Stats, watchdog stamps and
+        trace spans stay attributed to the member that did the work."""
+        tr = self.tracer
+        last = len(chain) - 1
+        stack = [(start_idx, pad, item)]
+        while stack:
+            i, pad, buf = stack.pop()
+            elem = chain[i]
+            t0 = time.perf_counter()
+            self._inflight[elem.name] = time.monotonic()
+            try:
+                emissions = elem.process(pad, buf)
+            except Exception as exc:
+                raise _ChainFailure(elem, exc) from exc
+            finally:
+                self._inflight.pop(elem.name, None)
+            t1 = time.perf_counter()
+            self._stats[elem.name].record(t1 - t0)
+            self._consec_errors = 0
+            if tr.active:
+                tr.record_process(elem.name, buf, t0, t1)
+            if i == last:
+                for sp, b in emissions:
+                    self._emit(elem, sp, b)
+                continue
+            nxt = chain[i + 1].name
+            pending = []
+            for sp, b in emissions:
+                link = self._route[(elem.name, sp)]
+                if link.dst.name == nxt:
+                    pending.append((i + 1, link.dst_pad, b))
+                else:          # defensive: members have one out-link
+                    self._emit(elem, sp, b)
+            stack.extend(reversed(pending))
+
+    def _chain_flush(self, chain: List[Element]) -> None:
+        """EOS drain for a fused chain: flush members head→tail, each
+        member's flush emissions flowing through the remaining members
+        before those flush — exactly the order the unfused cascade
+        produces."""
+        tr = self.tracer
+        last = len(chain) - 1
+        for i, elem in enumerate(chain):
+            t0 = time.perf_counter()
+            self._inflight[elem.name] = time.monotonic()
+            try:
+                emissions = elem.flush()
+            except Exception as exc:
+                raise _ChainFailure(elem, exc) from exc
+            finally:
+                self._inflight.pop(elem.name, None)
+            if tr.active:
+                t1 = time.perf_counter()
+                tr.record_flush(elem.name, t0, t1)
+                tr.record_eos(elem.name, t1)
+            if i == last:
+                for sp, b in emissions:
+                    self._emit(elem, sp, b)
+                continue
+            nxt = chain[i + 1].name
+            for sp, b in emissions:
+                link = self._route[(elem.name, sp)]
+                if link.dst.name == nxt:
+                    self._chain_deliver(chain, i + 1, link.dst_pad, b)
+                else:
+                    self._emit(elem, sp, b)
+
     # -- error policies ----------------------------------------------------
     def _process_with_policy(self, elem: Element, stats: ElementStats,
                              policy, pad: int, item, tr):
@@ -558,69 +788,90 @@ class PipelineRunner:
         incident (per stuck call / per contiguous full period), counted
         in the element's stats and traced; watchdog_action='fail' also
         tears the pipeline down with WatchdogStall."""
+        poll = max(0.02, min(1.0, min(self._stall_budget_s,
+                                      self._queue_stall_budget_s) / 4.0))
+        while not self._stop_evt.wait(poll):
+            if self._watchdog_scan(time.monotonic()):
+                return
+
+    def _watchdog_scan(self, now: float) -> bool:
+        """One watchdog pass at monotonic instant `now`; True when a
+        watchdog_action='fail' teardown fired (the loop must exit).
+        Separated from the loop so tests can drive it with synthetic
+        clocks; bookkeeping lives on the runner (`_wd_*` dicts) and is
+        pruned the moment an element/queue recovers, so long-running
+        pipelines never grow it monotonically."""
         from nnstreamer_tpu.core.errors import WatchdogStall
 
         budget = self._stall_budget_s
         q_budget = self._queue_stall_budget_s
-        poll = max(0.02, min(1.0, min(budget, q_budget) / 4.0))
         tr = self.tracer
-        warned_proc: Dict[str, float] = {}   # name -> stamp already flagged
-        q_full_since: Dict[str, float] = {}
-        warned_q: Dict[str, float] = {}
-        while not self._stop_evt.wait(poll):
-            now = time.monotonic()
-            for name, t0 in list(self._inflight.items()):
-                stalled = now - t0
-                if stalled <= budget or warned_proc.get(name) == t0:
-                    continue
-                warned_proc[name] = t0
-                stats = self._stats.get(name)
-                if stats is not None:
-                    stats.watchdog_warnings += 1
-                log.warning(
-                    "watchdog: element %s has been inside process()/"
-                    "flush() for %.2fs (stall budget %.2fs)",
-                    name, stalled, budget)
-                if tr.active:
-                    tr.record_watchdog(name, "stall", time.perf_counter(),
-                                       stalled_s=round(stalled, 3),
-                                       budget_s=budget)
-                if self._watchdog_action == "fail":
-                    elem = self.pipeline.elements.get(name)
-                    self._fail(elem, WatchdogStall(
-                        f"element {name} exceeded its stall budget: "
-                        f"process() has not returned for {stalled:.2f}s "
-                        f"(budget {budget:.2f}s)"))
-                    return
-            for name, q in self._queues.items():
-                if not q.full():
-                    q_full_since.pop(name, None)
-                    continue
-                since = q_full_since.setdefault(name, now)
-                full_for = now - since
-                if full_for <= q_budget or warned_q.get(name) == since:
-                    continue
-                warned_q[name] = since
-                stats = self._stats.get(name)
-                if stats is not None:
-                    stats.watchdog_warnings += 1
-                log.warning(
-                    "watchdog: input queue of %s has been at capacity "
-                    "(%d) for %.2fs (budget %.2fs) — the element is not "
-                    "draining; upstream is blocked", name, self._cap,
-                    full_for, q_budget)
-                if tr.active:
-                    tr.record_watchdog(name, "queue", time.perf_counter(),
-                                       full_for_s=round(full_for, 3),
-                                       budget_s=q_budget,
-                                       capacity=self._cap)
-                if self._watchdog_action == "fail":
-                    elem = self.pipeline.elements.get(name)
-                    self._fail(elem, WatchdogStall(
-                        f"input queue of element {name} stayed at "
-                        f"capacity ({self._cap}) for {full_for:.2f}s "
-                        f"(budget {q_budget:.2f}s)"))
-                    return
+        warned_proc = self._wd_warned_proc
+        q_full_since = self._wd_q_full_since
+        warned_q = self._wd_warned_q
+        # prune bookkeeping for recovered elements: a stale warned_proc
+        # entry means that stuck call returned (or a new one started —
+        # a different stamp re-arms the warning anyway)
+        inflight = dict(self._inflight)
+        for name in list(warned_proc):
+            if inflight.get(name) != warned_proc[name]:
+                del warned_proc[name]
+        for name, t0 in inflight.items():
+            stalled = now - t0
+            if stalled <= budget or warned_proc.get(name) == t0:
+                continue
+            warned_proc[name] = t0
+            stats = self._stats.get(name)
+            if stats is not None:
+                stats.watchdog_warnings += 1
+            log.warning(
+                "watchdog: element %s has been inside process()/"
+                "flush() for %.2fs (stall budget %.2fs)",
+                name, stalled, budget)
+            if tr.active:
+                tr.record_watchdog(name, "stall", time.perf_counter(),
+                                   stalled_s=round(stalled, 3),
+                                   budget_s=budget)
+            if self._watchdog_action == "fail":
+                elem = self.pipeline.elements.get(name)
+                self._fail(elem, WatchdogStall(
+                    f"element {name} exceeded its stall budget: "
+                    f"process() has not returned for {stalled:.2f}s "
+                    f"(budget {budget:.2f}s)"))
+                return True
+        for name, ch in self._queues.items():
+            if not ch.full():
+                # recovered: drop the whole incident record so the
+                # dicts stay bounded by currently-wedged queues only
+                q_full_since.pop(name, None)
+                warned_q.pop(name, None)
+                continue
+            since = q_full_since.setdefault(name, now)
+            full_for = now - since
+            if full_for <= q_budget or warned_q.get(name) == since:
+                continue
+            warned_q[name] = since
+            stats = self._stats.get(name)
+            if stats is not None:
+                stats.watchdog_warnings += 1
+            log.warning(
+                "watchdog: input queue of %s has been at capacity "
+                "(%d) for %.2fs (budget %.2fs) — the element is not "
+                "draining; upstream is blocked", name, self._cap,
+                full_for, q_budget)
+            if tr.active:
+                tr.record_watchdog(name, "queue", time.perf_counter(),
+                                   full_for_s=round(full_for, 3),
+                                   budget_s=q_budget,
+                                   capacity=self._cap)
+            if self._watchdog_action == "fail":
+                elem = self.pipeline.elements.get(name)
+                self._fail(elem, WatchdogStall(
+                    f"input queue of element {name} stayed at "
+                    f"capacity ({self._cap}) for {full_for:.2f}s "
+                    f"(budget {q_budget:.2f}s)"))
+                return True
+        return False
 
     def _fail(self, elem: Element, exc: BaseException) -> None:
         with self._error_lock:
@@ -628,11 +879,8 @@ class PipelineRunner:
                 self._error = exc
         log.error("element %s failed: %s", elem.name, exc)
         self._stop_evt.set()
-        for q in self._queues.values():
-            try:
-                q.put_nowait((None, EOS, 0.0))
-            except queue.Full:
-                pass
+        for ch in self._queues.values():
+            ch.close()
 
     def _emit(self, elem: Element, src_pad: int, item) -> None:
         link = self._route.get((elem.name, src_pad))
@@ -645,27 +893,26 @@ class PipelineRunner:
             # start the D2H transfer now; the consumer's to_host() then
             # overlaps with compute of other in-flight frames
             item.prefetch_host()
-        q = self._queues[link.dst.name]
+        ch = self._queues[link.dst.name]
         t_enq = time.perf_counter()
         tr = self.tracer
-        while not self._stop_evt.is_set():
-            try:
-                q.put((link.dst_pad, item, t_enq), timeout=0.1)
-            except queue.Full:
-                continue
-            # queuelevel gauge: the high-water mark is always-on (one
-            # qsize() per enqueue, same spirit as the wait counters);
-            # the full depth time-series is tracer-gated
-            depth = q.qsize()
+        # blocking put: wakes the consumer immediately, parks this
+        # producer without polling while the channel is full, and
+        # returns the post-append depth measured under the channel's
+        # own lock — the always-on queue_peak high-water mark costs no
+        # extra qsize() lock acquisition
+        depth = ch.put((link.dst_pad, item, t_enq))
+        if depth is not None:
             dst_stats = self._stats.get(link.dst.name)
             if dst_stats is not None and depth > dst_stats.queue_peak:
                 dst_stats.queue_peak = depth
             if tr.active:
                 tr.enqueue(link.dst.name, depth, time.perf_counter())
             return
-        # _stop_evt aborted the put loop: the buffer is lost. Count it
-        # so teardown/failure losses are visible in stats() instead of
-        # vanishing silently (EOS is not a payload — no loss to count).
+        # the channel closed (teardown/failure) before the put landed:
+        # the buffer is lost. Count it so teardown/failure losses are
+        # visible in stats() instead of vanishing silently (EOS is not
+        # a payload — no loss to count).
         if item is not EOS:
             stats = self._stats.get(elem.name)
             if stats is not None:
@@ -698,7 +945,7 @@ class PipelineRunner:
                 pass
 
     def _work(self, elem: Element) -> None:
-        q = self._queues[elem.name]
+        ch = self._queues[elem.name]
         n_pads = max(1, len(self.pipeline.links_to(elem)))
         eos_pads = set()
         stats = self._stats[elem.name]
@@ -708,12 +955,12 @@ class PipelineRunner:
             while not self._stop_evt.is_set():
                 # deadline-aware wait: an element holding half-assembled
                 # state (tensor_batch) publishes its next flush instant;
-                # the queue wait shortens to meet it so a partial batch
-                # ships on time even when no further buffer ever arrives
+                # the channel wait is bounded by exactly that instant —
+                # no fixed poll tick — so a partial batch ships on time
+                # even when no further buffer ever arrives, and an idle
+                # element sleeps until woken by an enqueue or teardown
                 deadline = elem.next_deadline()
-                if deadline is None:
-                    timeout = 0.1
-                else:
+                if deadline is not None:
                     now = time.perf_counter()
                     if now >= deadline:
                         stats.timer_fires += 1
@@ -723,16 +970,15 @@ class PipelineRunner:
                             tr.record_timer(elem.name, now,
                                             time.perf_counter())
                         continue
-                    timeout = min(0.1, deadline - now)
-                try:
-                    pad, item, t_enq = q.get(timeout=timeout)
-                except queue.Empty:
+                msg, depth = ch.get(deadline)
+                if msg is CLOSED:     # teardown wakeup (stop()/_fail())
+                    return
+                if msg is TIMED_OUT:  # deadline due — loop fires on_timer
                     continue
+                pad, item, t_enq = msg
                 if tr.active:
-                    tr.dequeue(elem.name, q.qsize(), time.perf_counter())
+                    tr.dequeue(elem.name, depth, time.perf_counter())
                 if item is EOS:
-                    if pad is None:  # teardown wakeup
-                        return
                     eos_pads.add(pad)
                     if len(eos_pads) >= n_pads:
                         t0 = time.perf_counter()

@@ -1,0 +1,239 @@
+#!/usr/bin/env python3
+"""Host-path microbenchmark: wakeup latency + per-hop overhead.
+
+Pure CPU, no model, no accelerator — this measures the *scheduler*, the
+part of `piped_fps` no kernel work can recover (BENCH host-path tax):
+
+- **wakeup latency**: push→render time of a single frame through an
+  otherwise idle `appsrc → fakesink` pipeline. The old timeout-poll
+  scheduler slept in ``q.get(timeout=0.1)``, so an idle hop could cost
+  up to 100 ms; the condition-variable channel (runtime/channel.py)
+  wakes the consumer on enqueue — this number should sit far below the
+  old poll floor.
+- **per-hop overhead**: open-loop frames through
+  ``appsrc → N× passthrough tensor_transform → fakesink``, fused
+  (chain fusion on: the transforms share one worker thread) vs unfused
+  (one thread + channel per element), reported as µs/frame and
+  µs/frame/hop.
+
+Run directly (``python tools/profile_hostpath.py [--json]``) or import
+the ``measure_*`` functions — bench.py's ``host_path`` family and the
+tier-1 smoke test in tests/test_hostpath.py reuse them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: the old scheduler's get/put poll tick — the latency floor this
+#: overhaul removes; kept as the reference line in reports and tests
+OLD_POLL_FLOOR_MS = 100.0
+
+
+def _percentile(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1,
+            int(round(p / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[k]
+
+
+def _frame():
+    import numpy as np
+
+    return np.zeros((1, 64), np.float32)
+
+
+class _EventSink:
+    """fakesink that timestamps each render and sets an event — lets
+    the wakeup measurement block on the actual render instant instead
+    of polling a counter (polling would floor the measurement at the
+    poll interval, the very artifact being measured)."""
+
+    def __new__(cls, name=None):
+        from nnstreamer_tpu.graph.pipeline import SinkElement
+
+        class _Impl(SinkElement):
+            ELEMENT_NAME = "event_sink"
+
+            def __init__(self, name=None):
+                super().__init__(name=name)
+                self.count = 0
+                self.t_render = 0.0
+                self.evt = threading.Event()
+
+            def render(self, buf):
+                self.t_render = time.perf_counter()
+                self.count += 1
+                self.evt.set()
+
+        return _Impl(name=name)
+
+
+def build_passthrough(n_transforms: int, sink_cls=None):
+    """appsrc → n_transforms× identity tensor_transform → fakesink.
+
+    Every transform is arithmetic add:0.0 — negligible compute, so the
+    measured time is almost entirely scheduler hop overhead."""
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements import FakeSink, TensorTransform
+    from nnstreamer_tpu.elements.sources import AppSrc
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    pipe = nns.Pipeline("hostpath")
+    src = AppSrc(spec=TensorsSpec.of(
+        TensorInfo((1, 64), DType.FLOAT32)), name="src")
+    stages = [src]
+    for i in range(n_transforms):
+        stages.append(TensorTransform(name=f"t{i}", mode="arithmetic",
+                                      option="add:0.0"))
+    sink = (sink_cls or FakeSink)(name="sink")
+    stages.append(sink)
+    for e in stages:
+        pipe.add(e)
+    for a, b in zip(stages, stages[1:]):
+        pipe.link(a, b)
+    return pipe, src, sink
+
+
+def measure_wakeup_latency(n: int = 200, warmup: int = 20) -> dict:
+    """Closed-loop push→render latency (ms) on an idle pipeline —
+    the enqueue→dequeue wakeup cost, twice (appsrc pump + sink hop)."""
+    from nnstreamer_tpu.runtime.scheduler import PipelineRunner
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    pipe, src, sink = build_passthrough(0, sink_cls=_EventSink)
+    runner = PipelineRunner(pipe, optimize=False).start()
+    frame = _frame()
+    lats = []
+    try:
+        for i in range(warmup + n):
+            sink.evt.clear()
+            t0 = time.perf_counter()
+            src.push(TensorBuffer.of(frame, pts=i))
+            if not sink.evt.wait(10.0):
+                raise RuntimeError(
+                    f"wakeup measurement stalled at frame {i} "
+                    f"(sink at {sink.count})")
+            if i >= warmup:
+                lats.append((sink.t_render - t0) * 1e3)
+        src.end()
+        runner.wait(30)
+    finally:
+        runner.stop()
+    lats.sort()
+    return {
+        "n": n,
+        "p50_ms": round(_percentile(lats, 50), 4),
+        "p95_ms": round(_percentile(lats, 95), 4),
+        "max_ms": round(lats[-1], 4),
+        "old_poll_floor_ms": OLD_POLL_FLOOR_MS,
+    }
+
+
+def measure_hop_overhead(n_transforms: int = 4, n_frames: int = 2000,
+                         fused: bool = True, repeats: int = 3) -> dict:
+    """Open-loop per-frame host cost through a passthrough chain.
+
+    Best-of-`repeats` (scheduler noise is one-sided: interference only
+    ever adds time). `fused=False` pins chain_fusion off so the same
+    graph runs one thread + channel per element — the A/B the host_path
+    bench family reports."""
+    from nnstreamer_tpu.runtime.scheduler import PipelineRunner
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    hops = n_transforms + 1            # link count src→…→sink
+    best_us = float("inf")
+    for _ in range(repeats):
+        pipe, src, sink = build_passthrough(n_transforms)
+        runner = PipelineRunner(pipe, optimize=False,
+                                chain_fusion=fused).start()
+        frame = _frame()
+        pts = 0
+        try:
+            for _ in range(64):        # warm the path
+                src.push(TensorBuffer.of(frame, pts=pts))
+                pts += 1
+            while sink.count < 64:
+                time.sleep(0.0002)
+            t0 = time.perf_counter()
+            for _ in range(n_frames):
+                src.push(TensorBuffer.of(frame, pts=pts))
+                pts += 1
+            target = 64 + n_frames
+            while sink.count < target:
+                if runner._error is not None:
+                    raise RuntimeError(
+                        f"pipeline failed: {runner._error}")
+                time.sleep(0.0002)
+            dt = time.perf_counter() - t0
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        best_us = min(best_us, dt / n_frames * 1e6)
+    return {
+        "transforms": n_transforms,
+        "hops": hops,
+        "frames": n_frames,
+        "fused": bool(fused),
+        "per_frame_us": round(best_us, 2),
+        "per_hop_us": round(best_us / hops, 2),
+    }
+
+
+def profile(n_frames: int = 2000, n_wakeup: int = 200) -> dict:
+    """The full host-path picture (what `host_path` in bench.py ships)."""
+    fused = measure_hop_overhead(4, n_frames, fused=True)
+    unfused = measure_hop_overhead(4, n_frames, fused=False)
+    speedup = (unfused["per_frame_us"] / fused["per_frame_us"]
+               if fused["per_frame_us"] else 0.0)
+    return {
+        "wakeup_latency": measure_wakeup_latency(n_wakeup),
+        "hop_overhead": {
+            "fused": fused,
+            "unfused": unfused,
+            "fused_speedup": round(speedup, 2),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--frames", type=int, default=2000,
+                    help="open-loop frames per hop-overhead run")
+    ap.add_argument("--wakeups", type=int, default=200,
+                    help="samples for the wakeup-latency measurement")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of text")
+    args = ap.parse_args(argv)
+    res = profile(args.frames, args.wakeups)
+    if args.json:
+        print(json.dumps(res, indent=2))
+        return 0
+    w = res["wakeup_latency"]
+    print(f"wakeup latency (push→render, idle pipeline, n={w['n']}):")
+    print(f"  p50 {w['p50_ms']:.3f} ms   p95 {w['p95_ms']:.3f} ms   "
+          f"max {w['max_ms']:.3f} ms   (old poll floor: "
+          f"{w['old_poll_floor_ms']:.0f} ms)")
+    h = res["hop_overhead"]
+    for label in ("fused", "unfused"):
+        r = h[label]
+        print(f"{label:>8}: {r['per_frame_us']:8.1f} µs/frame over "
+              f"{r['hops']} hops ({r['per_hop_us']:.1f} µs/hop)")
+    print(f"chain-fusion speedup: {h['fused_speedup']:.2f}x")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
