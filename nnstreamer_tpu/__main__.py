@@ -11,6 +11,8 @@
     python -m nnstreamer_tpu models list               # model store contents
     python -m nnstreamer_tpu models describe NAME      # versions/stats/swaps
     python -m nnstreamer_tpu models swap NAME [VER]    # hot swap
+    python -m nnstreamer_tpu llm --requests 8          # continuous-batching
+                                                       #  LLM serving demo
 """
 
 from __future__ import annotations
@@ -136,12 +138,94 @@ def _models_main(argv) -> int:
     return 0
 
 
+def _llm_main(argv) -> int:
+    """`llm` subcommand: push N synthetic prompts through an
+    appsrc → tensor_llm → tensor_sink pipeline and stream tokens as
+    they arrive — the smallest end-to-end serving loop."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu llm",
+        description="continuous-batching LLM serving demo (tensor_llm)")
+    ap.add_argument("--model", default="store://transformer",
+                    help="store:// ref or zoo name")
+    ap.add_argument("--requests", type=int, default=8,
+                    help="synthetic prompts to serve")
+    ap.add_argument("--max-new-tokens", type=int, default=24)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--num-blocks", type=int, default=64)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--scheduling", default="continuous",
+                    choices=("continuous", "static"))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--stats", action="store_true",
+                    help="print engine stats JSON at the end")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements import AppSrc, TensorLLM, TensorSink
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+    from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+
+    src = AppSrc(name="src", spec=TensorsSpec(
+        tensors=(), format=TensorFormat.FLEXIBLE))
+    llm = TensorLLM(
+        name="llm", model=args.model, max_batch=args.max_batch,
+        num_blocks=args.num_blocks, block_size=args.block_size,
+        max_len=args.max_len, max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature, scheduling=args.scheduling)
+
+    def on_chunk(buf):
+        m = buf.meta["llm"]
+        toks = " ".join(str(int(t)) for t in np.asarray(buf.tensors[0]))
+        tail = ""
+        if m["done"]:
+            ft = m.get("first_token_ms")
+            tail = (f"   [done: {m['n_tokens']} tokens, "
+                    f"first token {ft:.1f} ms]" if ft is not None
+                    else "   [done]")
+        print(f"{m['request_id']:>8s}  {toks}{tail}")
+
+    sink = TensorSink(name="sink", new_data=on_chunk)
+    pipe = nns.Pipeline()
+    for e in (src, llm, sink):
+        pipe.add(e)
+    pipe.link(src, llm)
+    pipe.link(llm, sink)
+    runner = nns.PipelineRunner(pipe)
+    runner.start()
+    rng = np.random.default_rng(args.seed)
+    vocab = 256
+    try:
+        for i in range(args.requests):
+            plen = int(rng.integers(1, 17))
+            prompt = rng.integers(0, vocab, size=plen).astype(np.int32)
+            src.push(TensorBuffer(
+                tensors=(prompt,), pts=i,
+                meta={"llm": {"request_id": f"req{i}",
+                              "seed": int(args.seed) + i}}))
+        src.end()
+        runner.wait(None)
+    except KeyboardInterrupt:
+        print("interrupted", file=sys.stderr)
+        return 130
+    finally:
+        runner.stop()
+    if args.stats:
+        print(json.dumps(llm.extra_stats(), indent=2, default=float))
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
         return _trace_main(argv[1:])
     if argv and argv[0] == "models":
         return _models_main(argv[1:])
+    if argv and argv[0] == "llm":
+        return _llm_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu",
         description="TPU-native streaming AI pipelines (gst-launch parity)")

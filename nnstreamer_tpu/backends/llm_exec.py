@@ -1,0 +1,382 @@
+"""Paged-LLM executor: bucketed, version-namespaced prefill/decode jits.
+
+The LLM engine's device half. Owns the paged KV pools and a jit cache
+keyed ``(namespace, kind, bucket)`` where namespace is ``("v", version)``
+for ``store://`` models and ``("g", 0)`` otherwise — the same
+namespacing discipline as the XLA filter backend (backends/xla.py), so
+model-store hot swap composes: the store's swap controller calls
+``prewarm_version`` on this handle before the epoch flips, and the
+engine adopts at a step boundary (one scheduler thread ⇒ a step sees
+exactly one version snapshot).
+
+Buckets:
+- prefill: prompt length padded to pow2 (``("llmp", S)`` in the
+  compile-cache manifest — replayed by ``warm_start`` so a restarted
+  server compiles its prompt working set off the hot path);
+- decode: active-row count padded to pow2 (``("llmd", B)``), padding
+  rows write to the scratch block.
+
+Weights are passed as jit *arguments* (not closed over), so a same-
+shape hot swap is served by the already-compiled executable — the
+version namespace exists for accounting and for swaps that change
+widths, which compile fresh under their own keys.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.llm.paged_cache import SCRATCH_BLOCK, PagedKVCache
+from nnstreamer_tpu.runtime.tracing import NULL_TRACER
+
+log = get_logger("backends.llm")
+
+
+def _derive_dims(params: dict, n_heads: int) -> dict:
+    """Model dims from the transformer params pytree itself (the only
+    honest source — a store version may differ from element props)."""
+    try:
+        d_model = int(params["embed"].shape[1])
+        vocab = int(params["head"].shape[1])
+        n_layers = len(params["blocks"])
+        hd = d_model // n_heads
+        n_kv = (int(params["blocks"][0]["wqkv"].shape[1]) - d_model) \
+            // 2 // hd
+    except (KeyError, IndexError, AttributeError, TypeError) as e:
+        raise BackendError(
+            f"tensor_llm needs transformer-family params "
+            f"(embed/blocks/ln_f/head pytree, models/transformer.py); "
+            f"could not read dims: {e}") from e
+    if hd * n_heads != d_model:
+        raise BackendError(
+            f"n_heads={n_heads} does not divide d_model={d_model}")
+    return {"d_model": d_model, "vocab": vocab, "n_layers": n_layers,
+            "head_dim": hd, "n_kv": n_kv}
+
+
+class PagedLLMExecutor:
+    """Device executor for the continuous-batching engine.
+
+    `model` is a ``store://name[@version]`` ref (tracked or pinned, zoo
+    builtins seed as @0) or a raw transformer params dict. One instance
+    per engine; all methods run on the engine's single scheduler
+    thread.
+    """
+
+    def __init__(self, model="store://transformer", *, n_heads: int = 4,
+                 dtype=None, block_size: int = 16, num_blocks: int = 64,
+                 max_len: int = 128, tracer=NULL_TRACER,
+                 name: str = "llm"):
+        import jax.numpy as jnp
+
+        self.name = name
+        self.tracer = tracer
+        self.n_heads = int(n_heads)
+        self.dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.float32
+        self.max_len = int(max_len)
+        self._entry = None
+        self._pinned: Optional[int] = None
+        self._version: Optional[int] = None
+        self.adopted_epoch = -1
+        self.swap_count = 0
+        if isinstance(model, str):
+            from nnstreamer_tpu.serving.store import (
+                get_store, parse_store_ref)
+
+            if model.startswith("zoo://"):
+                model = "store://" + model[len("zoo://"):]
+            ref = parse_store_ref(model)
+            self._entry = get_store().entry(ref.name)
+            if ref.version is not None:
+                self._pinned = self._entry.resolve_version(ref.version)
+                self._version = self._pinned
+            else:
+                cur, epoch = self._entry.state
+                self._version, self.adopted_epoch = cur, epoch
+            self.params = self._entry.bundle(self._version).params
+            self._entry.attach(self)
+        elif isinstance(model, dict):
+            self.params = model
+        else:
+            raise BackendError(
+                f"tensor_llm model must be a store:// ref or a params "
+                f"dict, got {type(model).__name__}")
+        dims = _derive_dims(self.params, self.n_heads)
+        self.__dict__.update(dims)
+        bs = int(block_size)
+        self.max_blocks = max(1, -(-self.max_len // bs))
+        self.cache = PagedKVCache(
+            num_blocks=int(num_blocks), block_size=bs,
+            n_layers=self.n_layers, n_kv=self.n_kv,
+            head_dim=self.head_dim)
+        #: (ns, kind, bucket) → jitted callable
+        self._jits: Dict[tuple, Any] = {}
+        self.compile_count = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.prefills = 0
+        self.decode_steps = 0
+
+    # -- store integration -------------------------------------------------
+    def _ns(self, version: Optional[int] = None) -> tuple:
+        if self._entry is not None:
+            return ("v", version if version is not None
+                    else self._version)
+        return ("g", 0)
+
+    @property
+    def tracks_store_epoch(self) -> bool:
+        return self._entry is not None and self._pinned is None
+
+    def maybe_adopt(self) -> None:
+        """Adopt a flipped store epoch at a step boundary. In-flight
+        sequences keep their old-version KV (documented serving
+        tradeoff, docs/llm_serving.md) — retiring them instead would
+        turn every swap into a latency spike for every live request."""
+        if not self.tracks_store_epoch:
+            return
+        cur, epoch = self._entry.state        # one read = consistent
+        if epoch == self.adopted_epoch:
+            return
+        old = self._version
+        self.params = self._entry.bundle(cur).params
+        dims = _derive_dims(self.params, self.n_heads)
+        if dims["n_layers"] != self.n_layers or dims["n_kv"] != self.n_kv \
+                or dims["head_dim"] != self.head_dim:
+            # pool-incompatible geometry cannot serve in-flight
+            # sequences; refuse the adoption loudly rather than corrupt
+            raise BackendError(
+                f"store swap {self._entry.name}@{old} → @{cur} changes "
+                f"cache geometry (layers/kv-heads/head-dim); restart the "
+                f"tensor_llm element to serve it")
+        self.__dict__.update(dims)
+        for k in [k for k in self._jits
+                  if k[0][0] == "v" and k[0][1] not in
+                  (cur, self._pinned)]:
+            del self._jits[k]
+        self._version, self.adopted_epoch = cur, epoch
+        self.swap_count += 1
+        self.tracer.record_swap(
+            self.name, time.perf_counter(), model=self._entry.name,
+            from_version=old, to_version=cur, epoch=epoch,
+            prewarmed=True)
+        log.info("llm %s adopted %s@%d epoch=%d", self.name,
+                 self._entry.name, cur, epoch)
+
+    def _note_bucket(self, bucket_key: tuple) -> None:
+        if self._entry is not None and self._version is not None:
+            self._entry.note_bucket(self._version, bucket_key)
+
+    # -- jit cache ---------------------------------------------------------
+    def _get_jit(self, kind: str, bucket: int, version=None):
+        import jax
+
+        from nnstreamer_tpu.llm.paged_model import (
+            paged_decode_step, paged_prefill)
+
+        key = (self._ns(version), kind, bucket)
+        jitted = self._jits.get(key)
+        if jitted is not None:
+            self.cache_hits += 1
+            return jitted, False
+        self.cache_misses += 1
+        fn = paged_prefill if kind == "prefill" else paged_decode_step
+        jitted = jax.jit(fn, static_argnames=("n_heads", "dtype"),
+                         donate_argnums=(4, 5))
+        self._jits[key] = jitted
+        return jitted, True
+
+    def _span(self, kind: str, t0: float, t1: float, **args) -> None:
+        if self.tracer.active:
+            self.tracer.backend_span(self.name, kind, t0, t1, **args)
+
+    # -- prefill -----------------------------------------------------------
+    def prefill(self, prompt: np.ndarray, block_table: List[int]):
+        """One prompt through the bucketed full-sequence prefill; its
+        KV lands in the pool blocks of `block_table`. Returns last-token
+        logits as a host (vocab,) f32 array."""
+        from nnstreamer_tpu.backends.xla import _next_pow2
+
+        plen = int(prompt.shape[0])
+        s_b = _next_pow2(plen, 8)
+        bs = self.cache.block_size
+        ids = np.zeros((1, s_b), np.int32)
+        ids[0, :plen] = prompt
+        blk_idx = np.full((s_b,), SCRATCH_BLOCK, np.int32)
+        pos = np.arange(plen)
+        blk_idx[:plen] = np.asarray(block_table, np.int32)[pos // bs]
+        blk_off = (np.arange(s_b) % bs).astype(np.int32)
+        jitted, fresh = self._get_jit("prefill", s_b)
+        t0 = time.perf_counter()
+        logits, self.cache.k, self.cache.v = jitted(
+            self.params, ids, blk_idx, blk_off, self.cache.k,
+            self.cache.v, np.int32(plen - 1), n_heads=self.n_heads,
+            dtype=self.dtype)
+        out = np.asarray(logits)
+        t1 = time.perf_counter()
+        if fresh:
+            self.compile_count += 1
+            self._span("compile", t0, t1, what="llm_prefill", bucket=s_b)
+            self._note_bucket(("llmp", s_b))
+        else:
+            self._span("invoke", t0, t1, what="llm_prefill", bucket=s_b,
+                       plen=plen)
+        self.prefills += 1
+        return out
+
+    # -- decode ------------------------------------------------------------
+    def decode(self, cur: List[int], tables: List[List[int]],
+               pos: List[int]) -> np.ndarray:
+        """One decode step for `len(cur)` live rows (bucketed to pow2;
+        padding rows write to the scratch block). Returns host logits
+        (n, vocab) f32 for the live rows only."""
+        from nnstreamer_tpu.backends.xla import _next_pow2
+
+        n = len(cur)
+        b_b = _next_pow2(n, 1)
+        cur_a = np.zeros((b_b,), np.int32)
+        cur_a[:n] = cur
+        tab_a = np.full((b_b, self.max_blocks), SCRATCH_BLOCK, np.int32)
+        for i, t in enumerate(tables):
+            tab_a[i, :len(t)] = t
+        pos_a = np.zeros((b_b,), np.int32)
+        pos_a[:n] = pos
+        jitted, fresh = self._get_jit("decode", b_b)
+        t0 = time.perf_counter()
+        logits, self.cache.k, self.cache.v = jitted(
+            self.params, cur_a, tab_a, pos_a, self.cache.k,
+            self.cache.v, n_heads=self.n_heads, dtype=self.dtype)
+        out = np.asarray(logits)[:n]
+        t1 = time.perf_counter()
+        if fresh:
+            self.compile_count += 1
+            self._span("compile", t0, t1, what="llm_decode", bucket=b_b)
+            self._note_bucket(("llmd", b_b))
+        else:
+            self._span("invoke", t0, t1, what="llm_decode", bucket=b_b,
+                       rows=n)
+        self.decode_steps += 1
+        return out
+
+    # -- warm paths --------------------------------------------------------
+    def _warm_compile(self, kind: str, bucket: int, version=None,
+                      params=None) -> bool:
+        """Compile one bucket off the hot path by running the jit on
+        DUMMY inputs whose every write targets the scratch block — by
+        construction that corrupts nothing (scratch absorbs garbage by
+        design), and unlike `.lower().compile()` a real invocation
+        populates the jit's dispatch cache, so the first *served*
+        request is a cache hit, not a second compile. Returns whether a
+        fresh executable was built."""
+        import jax
+
+        key = (self._ns(version), kind, bucket)
+        if key in self._jits:
+            return False
+        jitted, _ = self._get_jit(kind, bucket, version)
+        params = self.params if params is None else params
+        t0 = time.perf_counter()
+        if kind == "prefill":
+            ids = np.zeros((1, bucket), np.int32)
+            blk = np.full((bucket,), SCRATCH_BLOCK, np.int32)
+            off = (np.arange(bucket)
+                   % self.cache.block_size).astype(np.int32)
+            logits, self.cache.k, self.cache.v = jitted(
+                params, ids, blk, off, self.cache.k, self.cache.v,
+                np.int32(0), n_heads=self.n_heads, dtype=self.dtype)
+        else:
+            cur = np.zeros((bucket,), np.int32)
+            tab = np.full((bucket, self.max_blocks), SCRATCH_BLOCK,
+                          np.int32)
+            pos = np.zeros((bucket,), np.int32)
+            logits, self.cache.k, self.cache.v = jitted(
+                params, cur, tab, pos, self.cache.k, self.cache.v,
+                n_heads=self.n_heads, dtype=self.dtype)
+        jax.block_until_ready(logits)
+        self.compile_count += 1
+        self._span("compile", t0, time.perf_counter(),
+                   what=f"llm_{kind}_warm", bucket=bucket)
+        return True
+
+    def prewarm_buckets(self, *, max_batch: int,
+                        max_prompt: int) -> int:
+        """Eagerly compile every bucket a serving run can hit: decode
+        pow2 buckets up to `max_batch`, prefill pow2 buckets up to
+        `max_prompt`. Start-time cost, zero hot-path compiles after."""
+        from nnstreamer_tpu.backends.xla import _next_pow2
+
+        compiled = 0
+        b, top_b = 1, _next_pow2(max(1, max_batch), 1)
+        while b <= top_b:
+            compiled += int(self._warm_compile("decode", b))
+            b *= 2
+        s, top_s = 8, _next_pow2(
+            min(max(1, max_prompt), self.max_len), 8)
+        while s <= top_s:
+            compiled += int(self._warm_compile("prefill", s))
+            s *= 2
+        return compiled
+
+    def warm_start(self) -> int:
+        """Replay the persistent manifest's prefill/decode buckets for
+        the bound version (element start(), off the hot path)."""
+        if self._entry is None:
+            return 0
+        from nnstreamer_tpu.serving.compile_cache import manifest_buckets
+
+        compiled = 0
+        for bk in manifest_buckets(self._entry.name, self._version):
+            try:
+                if bk[0] == "llmp":
+                    compiled += int(self._warm_compile("prefill", bk[1]))
+                elif bk[0] == "llmd":
+                    compiled += int(self._warm_compile("decode", bk[1]))
+            except Exception as e:    # warm start is never a gate
+                log.warning("llm warm_start bucket %s failed: %s", bk, e)
+        return compiled
+
+    def prewarm_version(self, version: int, bundle) -> int:
+        """Swap-controller hook (serving/store.py update()): compile the
+        incoming version's executables for every bucket this executor
+        has served, before the epoch flips."""
+        params = getattr(bundle, "params", bundle)
+        dims = _derive_dims(params, self.n_heads)
+        if dims["n_layers"] != self.n_layers or dims["n_kv"] != self.n_kv \
+                or dims["head_dim"] != self.head_dim:
+            raise BackendError(
+                f"incoming {self._entry.name}@{version} changes cache "
+                f"geometry; tensor_llm cannot hot-swap it over live "
+                f"paged state — swap aborted")
+        served = [(k[1], k[2]) for k in list(self._jits)]
+        compiled = 0
+        for kind, bucket in served:
+            if self._warm_compile(kind, bucket, version=version):
+                compiled += 1
+        return compiled
+
+    def close(self) -> None:
+        if self._entry is not None:
+            try:
+                self._entry.detach(self)
+            except Exception:
+                pass
+        self._jits.clear()
+
+    def stats(self) -> dict:
+        out = {
+            "compile_count": self.compile_count,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "swap_count": self.swap_count,
+        }
+        if self._entry is not None:
+            out["store"] = f"{self._entry.name}@{self._version}"
+        return out

@@ -15,6 +15,7 @@ from nnstreamer_tpu.elements import (  # noqa: F401
     filter as filter_element,
     iio,
     ipc,
+    llm,
     mqtt,
     repo,
     routing,
@@ -55,6 +56,7 @@ from nnstreamer_tpu.elements.decoder import TensorDecoder, register_decoder
 from nnstreamer_tpu.elements.fault import TensorFault
 from nnstreamer_tpu.elements.filter import TensorFilter
 from nnstreamer_tpu.elements.ipc import IpcSink, IpcSrc
+from nnstreamer_tpu.elements.llm import TensorLLM
 from nnstreamer_tpu.elements.repo import REPO, TensorRepoSink, TensorRepoSrc
 from nnstreamer_tpu.elements.routing import (
     Join, Queue, Tee, TensorDemux, TensorMerge, TensorMux, TensorSplit)
@@ -86,6 +88,7 @@ __all__ = [
     "TensorFault",
     "TensorFilter",
     "TensorIf",
+    "TensorLLM",
     "TensorMerge",
     "TensorMux",
     "TensorRate",

@@ -1,0 +1,238 @@
+"""tensor_llm: continuous-batching LLM generation as a pipeline element.
+
+One buffer in = one generation request (a 1-D int32 prompt); buffers
+out = incremental token chunks per request, so downstream sees tokens
+as they are produced, not when the request finishes. The element wraps
+`llm.engine.LLMEngine` and rides the scheduler's timer contract
+(next_deadline/on_timer — the same machinery tensor_batch uses for its
+deadline flush): process() only *queues* a request and arms a short
+admission window; the engine steps inside on_timer(). That shape is
+load-bearing: each timer fire runs exactly one serving quantum
+(admit + prefill + one decode step for the whole in-flight batch) and
+then yields the deadline back, so newly arriving prompts are read off
+the input channel *between* decode steps and merge into the next one —
+continuous batching, not run-to-completion.
+
+Per-request knobs ride `buf.meta["llm"]` (request_id, max_new_tokens,
+temperature, top_k, seed, eos_id), defaulting to element properties.
+Output buffers carry `meta["llm"]` with the request id, done flag and,
+on the final chunk, the request's latency summary; first-token and
+inter-token latency are also recorded in the tracer per request.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.core.registry import register_element
+from nnstreamer_tpu.graph.pipeline import (
+    Element, Emission, PropDef, StreamSpec)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+
+log = get_logger("elements.llm")
+
+
+@register_element("tensor_llm")
+class TensorLLM(Element):
+    """Continuous-batching generation over a paged KV cache.
+
+    Properties:
+    - model: ``store://name[@version]`` ref (hot-swappable via the model
+      store unless pinned) or a zoo name; default store://transformer.
+    - scheduling: "continuous" (default) or "static" — the A/B baseline
+      where a batch admits only from empty and runs to completion.
+    - block_size / num_blocks: paged KV pool geometry (block 0 is the
+      padding scratch block; capacity = (num_blocks-1) * block_size
+      token slots).
+    - max_batch: decode-batch slot ceiling.
+    - max_len: per-sequence ceiling (prompt + generated tokens).
+    - admit_window_ms: how long a serving step waits for co-arriving
+      prompts before the next decode step runs.
+    - stream_chunk: emit every N tokens (1 = stream each token).
+    - eos_id: stop token (-1 disables); max_new_tokens: token budget.
+    """
+
+    ELEMENT_NAME = "tensor_llm"
+    NUM_SINK_PADS = 1
+    NUM_SRC_PADS = 1
+    WANTS_HOST = True
+    PROPS = {
+        "model": PropDef(str, "store://transformer",
+                         "store:// ref or zoo model name"),
+        "n_heads": PropDef(int, 4, "attention heads (must match model)"),
+        "dtype": PropDef(str, "float32", "activation dtype"),
+        "block_size": PropDef(int, 16, "KV block size in token slots"),
+        "num_blocks": PropDef(int, 64,
+                              "KV pool size in blocks (incl. scratch)"),
+        "max_batch": PropDef(int, 8, "decode-batch slot ceiling"),
+        "max_len": PropDef(int, 128,
+                           "per-sequence prompt+output ceiling"),
+        "max_new_tokens": PropDef(
+            int, 32, "default token budget per request"),
+        "temperature": PropDef(
+            float, 0.0, "default sampling temperature (0 = greedy)"),
+        "eos_id": PropDef(int, -1, "default stop token (-1 = disabled)"),
+        "scheduling": PropDef(
+            str, "continuous", "continuous | static (A/B baseline)"),
+        "admit_window_ms": PropDef(
+            float, 0.5, "admission window between decode steps"),
+        "stream_chunk": PropDef(
+            int, 1, "tokens per output buffer (1 = per-token)"),
+        "warm_start": PropDef(
+            int, 1, "replay manifest prefill buckets at start()"),
+        "prewarm": PropDef(
+            int, 0, "eagerly compile all decode buckets and prefill "
+                    "buckets up to this prompt length at start() "
+                    "(0 = compile lazily on first use)"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.engine = None
+        self._deadline: Optional[float] = None
+        # per-request emission state, engine-thread only
+        self._chunks: Dict[str, List[int]] = {}
+        self._req_seq = 0
+        self.requests_in = 0
+        self.chunks_out = 0
+        self.warm_compiles = 0
+
+    # -- negotiation -------------------------------------------------------
+    def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
+        spec = self.expect_tensors(in_specs[0], 0)
+        sched = self.props["scheduling"]
+        if sched not in ("continuous", "static"):
+            self.fail_negotiation(
+                f"scheduling must be 'continuous' or 'static', "
+                f"got {sched!r}")
+        if spec.format == TensorFormat.STATIC:
+            for t in spec.tensors:
+                if np.dtype(t.dtype) != np.int32:
+                    self.fail_negotiation(
+                        f"tensor_llm consumes int32 token-id prompts, "
+                        f"got {t.dtype}")
+        # prompts vary per request and chunks vary per step: both sides
+        # of this element are inherently FLEXIBLE streams
+        return [TensorsSpec(tensors=(), format=TensorFormat.FLEXIBLE,
+                            rate=spec.rate)]
+
+    def start(self) -> None:
+        from nnstreamer_tpu.llm.engine import LLMEngine
+
+        import jax.numpy as jnp
+
+        model = self.props["model"]
+        if isinstance(model, str) and "://" not in model:
+            model = f"store://{model}"
+        self.engine = LLMEngine(
+            model,
+            n_heads=int(self.props["n_heads"]),
+            dtype=jnp.dtype(self.props["dtype"]),
+            block_size=int(self.props["block_size"]),
+            num_blocks=int(self.props["num_blocks"]),
+            max_batch=int(self.props["max_batch"]),
+            max_len=int(self.props["max_len"]),
+            static_batching=self.props["scheduling"] == "static",
+            tracer=self._tracer,
+            name=self.name)
+        if int(self.props["warm_start"]):
+            self.warm_compiles = self.engine.executor.warm_start()
+        if int(self.props["prewarm"]) > 0:
+            self.warm_compiles += self.engine.prewarm(
+                int(self.props["prewarm"]))
+
+    def stop(self) -> None:
+        if self.engine is not None:
+            self.engine.executor.close()
+
+    # -- dataflow ----------------------------------------------------------
+    def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        meta = buf.meta.get("llm") if isinstance(buf.meta, dict) else None
+        meta = meta if isinstance(meta, dict) else {}
+        prompt = np.asarray(buf.tensors[0]).reshape(-1)
+        req_id = meta.get("request_id")
+        if req_id is None:
+            self._req_seq += 1
+            req_id = f"{self.name}-{self._req_seq}"
+        eos = meta.get("eos_id", int(self.props["eos_id"]))
+        self.engine.submit(
+            prompt,
+            req_id=str(req_id),
+            max_new_tokens=int(meta.get(
+                "max_new_tokens", self.props["max_new_tokens"])),
+            temperature=float(meta.get(
+                "temperature", self.props["temperature"])),
+            top_k=int(meta.get("top_k", 0)),
+            seed=int(meta.get("seed", 0)),
+            eos_id=None if eos is None or int(eos) < 0 else int(eos),
+            pts=buf.pts)
+        self.requests_in += 1
+        if self._deadline is None:
+            # arm the admission window; co-arriving prompts land in the
+            # same first step (the scheduler reads the channel until the
+            # deadline, then fires on_timer)
+            self._deadline = time.perf_counter() + self._window_s()
+        return []
+
+    def _window_s(self) -> float:
+        # a non-positive window would starve the input channel (an
+        # always-past deadline makes the scheduler fire timers forever
+        # without reading input) — clamp to one scheduler-visible tick
+        return max(0.05, float(self.props["admit_window_ms"])) * 1e-3
+
+    def next_deadline(self) -> Optional[float]:
+        return self._deadline
+
+    def on_timer(self) -> List[Emission]:
+        if self.engine is None or not self.engine.has_work:
+            self._deadline = None
+            return []
+        events = self.engine.step()
+        self._deadline = (time.perf_counter() + self._window_s()
+                          if self.engine.has_work else None)
+        return self._emit(events)
+
+    def flush(self) -> List[Emission]:
+        """EOS: no more requests will arrive — run the engine dry."""
+        self._deadline = None
+        if self.engine is None or not self.engine.has_work:
+            return []
+        return self._emit(self.engine.drain())
+
+    # -- emission ----------------------------------------------------------
+    def _emit(self, events) -> List[Emission]:
+        chunk = max(1, int(self.props["stream_chunk"]))
+        out: List[Emission] = []
+        for ev in events:
+            req = ev.request
+            pend = self._chunks.setdefault(req.req_id, [])
+            pend.extend(ev.tokens)
+            if len(pend) < chunk and not ev.done:
+                continue
+            del self._chunks[req.req_id]
+            meta = {"llm": {
+                "request_id": req.req_id,
+                "done": ev.done,
+                "n_tokens": len(req.tokens),
+            }}
+            if ev.done:
+                meta["llm"].update(req.summary())
+            out.append((0, TensorBuffer(
+                tensors=(np.asarray(pend, np.int32),),
+                pts=req.pts, meta=meta)))
+            self.chunks_out += 1
+        return out
+
+    # -- stats -------------------------------------------------------------
+    def extra_stats(self) -> dict:
+        stats = {"requests_in": self.requests_in,
+                 "chunks_out": self.chunks_out,
+                 "warm_compiles": self.warm_compiles}
+        if self.engine is not None:
+            stats.update(self.engine.stats())
+        return stats

@@ -1,0 +1,124 @@
+"""Paged prefill / decode-step math (pure jax, jitted by llm_exec).
+
+Parity contract (the acceptance gate): at temperature 0 the paged
+engine's tokens must equal `transformer.generate`'s token-for-token.
+Both functions here therefore mirror `transformer._step_impl`'s cached
+attention exactly — the same f32 einsum pair, the same -1e30 additive
+mask, softmax in f32 — over a *gathered* KV axis instead of a
+contiguous ring. Masked positions (padding, unwritten or stale block
+slots) contribute exp(-1e30-…) = exactly 0.0 attention weight, and a
+0.0 weight times any finite stale value is exactly 0.0 in the value
+contraction, so gathering `max_blocks * block_size` slots instead of a
+dense `max_len` window changes no bits of the surviving terms.
+
+Shapes:
+- k/v pool: (L, num_blocks, block_size, n_kv, hd)  — PagedKVCache
+- prefill:  ids (1, S_b) padded prompt; per-position (block, offset)
+  scatter targets (padding targets the scratch block)
+- decode:   one token per sequence row; per-row block tables
+  (B_b, max_blocks) and positions (B_b,) (padding rows → scratch)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models.transformer import (
+    _expand_kv, _mlp, apply_seq_kv, rmsnorm)
+
+
+def _rope_rows(x, pos):
+    """Rotary embedding with a PER-ROW position: x (B, 1, H, D),
+    pos (B,). Same f32 angle math as `transformer.rope`, broadcast over
+    the batch instead of the sequence axis — row b's values are bit-
+    identical to rope(x[b:b+1], pos[b:b+1])."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # (B, half)
+    cos = jnp.cos(ang)[:, None, None, :]
+    sin = jnp.sin(ang)[:, None, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def paged_prefill(params, ids, blk_idx, blk_off, k_pool, v_pool, last_idx,
+                  *, n_heads=4, dtype=jnp.float32):
+    """Bucketed prompt prefill: full-sequence forward + KV scatter.
+
+    ids (1, S_b) int32 — the prompt padded to its pow2 bucket;
+    blk_idx/blk_off (S_b,) int32 — per-position pool write targets
+    (padding positions point at the scratch block); last_idx — index of
+    the final real prompt token. Returns (last-token logits (vocab,),
+    k_pool, v_pool). Pools are donated by the caller's jit.
+    """
+    logits, ks, vs = apply_seq_kv(params, ids, n_heads=n_heads,
+                                  dtype=dtype)
+    # ks/vs: (L, 1, S_b, n_kv, hd) → scatter each position into its
+    # (block, offset) slot across all layers at once
+    k_pool = k_pool.at[:, blk_idx, blk_off].set(
+        ks[:, 0].astype(k_pool.dtype))
+    v_pool = v_pool.at[:, blk_idx, blk_off].set(
+        vs[:, 0].astype(v_pool.dtype))
+    return logits[0, last_idx], k_pool, v_pool
+
+
+def paged_decode_step(params, cur, tables, pos, k_pool, v_pool,
+                      *, n_heads=4, dtype=jnp.float32):
+    """One decode step for a bucketed batch over the paged pool.
+
+    cur (B_b,) int32 current tokens; tables (B_b, max_blocks) int32
+    per-sequence block tables; pos (B_b,) int32 write positions.
+    Returns (logits (B_b, vocab) f32, k_pool, v_pool).
+
+    Mirrors `transformer._step_impl` with three serving deltas: the
+    cache axis is gathered through the block tables, positions are
+    per-row (sequences at different depths share one step), and there
+    is no ring wrap — admission enforces prompt+new <= table capacity.
+    """
+    b = cur.shape[0]
+    n_layers, _, block_size, _, _ = k_pool.shape
+    max_blocks = tables.shape[1]
+    kv_len = max_blocks * block_size
+    rows = jnp.arange(b)
+    write_blk = tables[rows, pos // block_size]      # (B,)
+    write_off = pos % block_size
+    x = params["embed"][cur][:, None, :].astype(dtype)   # (B,1,D)
+    # attend over positions <= pos[b] (same inclusive window as
+    # _step_impl's `arange(max_len) <= p`)
+    mask = (jnp.arange(kv_len)[None, None, None, :] <=
+            pos[:, None, None, None])
+    for li, blk in enumerate(params["blocks"]):
+        h = rmsnorm(x, blk["ln1"].astype(dtype))
+        d = x.shape[-1]
+        hd = d // n_heads
+        qkv = h @ blk["wqkv"].astype(dtype)
+        kv_dim = (qkv.shape[-1] - d) // 2
+        n_kv = kv_dim // hd
+        q = qkv[..., :d].reshape(b, 1, n_heads, hd)
+        k = qkv[..., d:d + kv_dim].reshape(b, 1, n_kv, hd)
+        v = qkv[..., d + kv_dim:].reshape(b, 1, n_kv, hd)
+        q, k = _rope_rows(q, pos), _rope_rows(k, pos)
+        k_pool = k_pool.at[li, write_blk, write_off].set(
+            k[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[li, write_blk, write_off].set(
+            v[:, 0].astype(v_pool.dtype))
+        # gather this batch's KV through the block tables:
+        # (B, max_blocks, block_size, n_kv, hd) → (B, kv_len, n_kv, hd)
+        kc = k_pool[li][tables].reshape(b, kv_len, n_kv, hd)
+        vc = v_pool[li][tables].reshape(b, kv_len, n_kv, hd)
+        kcx = _expand_kv(kc, n_heads).astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       kcx) * hd ** -0.5                # (B,H,1,kv_len)
+        s = jnp.where(mask, s, -1e30)
+        pattn = jax.nn.softmax(s, axis=-1)
+        vcx = _expand_kv(vc, n_heads).astype(jnp.float32)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", pattn, vcx).astype(dtype)
+        x = x + attn.reshape(b, 1, -1) @ blk["wo"].astype(dtype)
+        h = rmsnorm(x, blk["ln2"].astype(dtype))
+        x = x + _mlp(blk, h, dtype)
+    x = rmsnorm(x, params["ln_f"].astype(dtype))
+    logits = (x[:, 0] @ params["head"].astype(dtype)).astype(jnp.float32)
+    return logits, k_pool, v_pool

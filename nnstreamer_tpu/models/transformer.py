@@ -157,6 +157,51 @@ def apply_seq(params, ids, *, n_heads=4, dtype=jnp.float32,
     return (x @ params["head"].astype(dtype)).astype(jnp.float32)
 
 
+def apply_seq_kv(params, ids, *, n_heads=4, dtype=jnp.float32):
+    """Full-sequence forward that ALSO returns every layer's rope'd K/V.
+
+    (B, S) int32 → (logits (B, S, vocab) f32,
+                    k (L, B, S, n_kv, D), v (L, B, S, n_kv, D))
+
+    This is the prefill path of the continuous-batching LLM engine
+    (llm/engine.py): one bucketed forward computes the prompt's whole KV
+    set, which then lands in the paged cache, instead of `generate()`'s
+    per-token `_step_jit` loop. The attention here is deliberately
+    formulated EXACTLY like `_step_impl`'s cached attention — the same
+    f32 einsums ("bqhd,bkhd->bhqk" / "bhqk,bkhd->bqhd"), the same -1e30
+    additive mask, softmax in f32 — rather than reusing `apply_seq`'s
+    kernel dispatch: masked positions then contribute exact 0.0 terms in
+    both paths, so the paged engine's tokens match `generate()`
+    token-for-token at temperature 0 (tests/test_llm.py parity gate).
+    """
+    b, s = ids.shape
+    x = params["embed"][ids].astype(dtype)
+    pos = jnp.arange(s)
+    causal = (jnp.arange(s)[None, :] <=
+              jnp.arange(s)[:, None])[None, None, :, :]   # (1,1,Sq,Sk)
+    ks, vs = [], []
+    for blk in params["blocks"]:
+        h = rmsnorm(x, blk["ln1"].astype(dtype))
+        q, k, v = _qkv(blk, h, n_heads, dtype)
+        q, k = rope(q, pos), rope(k, pos)
+        ks.append(k)
+        vs.append(v)
+        hd = x.shape[-1] // n_heads
+        kcx = _expand_kv(k, n_heads).astype(jnp.float32)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        kcx) * hd ** -0.5
+        sc = jnp.where(causal, sc, -1e30)
+        pattn = jax.nn.softmax(sc, axis=-1)
+        vcx = _expand_kv(v, n_heads).astype(jnp.float32)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", pattn, vcx).astype(dtype)
+        x = x + attn.reshape(b, s, -1) @ blk["wo"].astype(dtype)
+        h = rmsnorm(x, blk["ln2"].astype(dtype))
+        x = x + _mlp(blk, h, dtype)
+    x = rmsnorm(x, params["ln_f"].astype(dtype))
+    logits = (x @ params["head"].astype(dtype)).astype(jnp.float32)
+    return logits, jnp.stack(ks, axis=0), jnp.stack(vs, axis=0)
+
+
 def init_cache(*, batch=1, max_len=128, d_model=64, n_heads=4, n_layers=2,
                n_kv_heads=None, dtype=jnp.float32):
     """KV cache as TWO stacked tensors (pipeline-friendly state):

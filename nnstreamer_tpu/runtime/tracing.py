@@ -96,6 +96,9 @@ class NullTracer:
     def record_swap(self, name, t, **args):
         pass
 
+    def record_llm_request(self, name, req_id, t, **args):
+        pass
+
     def instant(self, name, label, t=None, **args):
         pass
 
@@ -134,6 +137,9 @@ class Tracer:
         # just ring events) so report() can render every swap even after
         # the event ring wraps
         self._swaps: List[Tuple[str, float, dict]] = []
+        # retired LLM requests (llm/engine.py): same keep-whole
+        # rationale as swaps
+        self._llm_requests: List[Tuple[str, str, float, dict]] = []
 
     # -- scheduler hooks ---------------------------------------------------
     def source_emit(self, name: str, buf, t: float) -> None:
@@ -201,6 +207,19 @@ class Tracer:
 
     def swap_events(self) -> List[Tuple[str, float, dict]]:
         return list(self._swaps)
+
+    def record_llm_request(self, name: str, req_id: str, t: float,
+                           **args) -> None:
+        """One retired LLM request (llm/engine.py); args carry the
+        request summary: prompt_len/n_tokens/first_token_ms/itl_p50_ms/
+        finish_reason. Kept whole like swaps so per-request serving
+        latency survives ring wrap."""
+        self._llm_requests.append((name, req_id, t, dict(args)))
+        self._append("i", "llm", name, "llm_request", t, 0.0,
+                     dict(args, req_id=req_id))
+
+    def llm_requests(self) -> List[Tuple[str, str, float, dict]]:
+        return list(self._llm_requests)
 
     def instant(self, name: str, label: str, t: Optional[float] = None,
                 **args) -> None:
@@ -278,6 +297,7 @@ class Tracer:
             "events": len(self._events),
             "events_dropped": self.events_dropped,
             "swaps": len(self._swaps),
+            "llm_requests": len(self._llm_requests),
         }
 
     def to_chrome_trace(self, pipeline_name: str = "pipeline") -> dict:

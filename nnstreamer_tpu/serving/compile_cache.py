@@ -95,6 +95,10 @@ def _manifest_path() -> Optional[str]:
 
 def _bucket_to_json(bk: tuple) -> Optional[dict]:
     kind = bk[0]
+    if kind in ("llmp", "llmd"):
+        # LLM serving buckets (backends/llm_exec.py): prefill prompt
+        # bucket / decode batch bucket — one pow2 int, no tensor pairs
+        return {"kind": kind, "n": int(bk[1])}
     if kind == "dynb":
         nb, pairs = bk[1], bk[2:]
     elif kind == "fix":
@@ -110,6 +114,8 @@ def _bucket_to_json(bk: tuple) -> Optional[dict]:
 
 def _bucket_from_json(obj: dict) -> Optional[tuple]:
     try:
+        if obj["kind"] in ("llmp", "llmd"):
+            return (str(obj["kind"]), int(obj["n"]))
         pairs = tuple((tuple(t["shape"]), str(t["dtype"]))
                       for t in obj["tensors"])
         if obj["kind"] == "dynb":

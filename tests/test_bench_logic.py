@@ -239,6 +239,64 @@ def test_sigkill_mid_run_leaves_parseable_snapshot():
     assert snaps[-1].get("partial") is True
 
 
+def test_partial_file_persisted_and_disableable(tmp_path):
+    """Every snapshot is also atomically mirrored to BENCH_PARTIAL_PATH
+    (round-4 regression: BENCH_r04 hit the driver's `timeout -k` with rc
+    124 and shipped NOTHING — stdout dies with the terminal, a file
+    survives). Empty path disables the mirror."""
+    part = tmp_path / "part.json"
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        env=_env(BENCH_BUDGET_S=30, BENCH_FAMILY_TIMEOUT_S=2,
+                 BENCH_SELFTEST_HANG_S=0, BENCH_SELFTEST_STEP_S=0.01,
+                 BENCH_PARTIAL_PATH=part),
+        timeout=60)
+    with open(part) as f:
+        saved = json.load(f)
+    # the mirror carries the same cumulative artifact as stdout
+    final = _snapshots(proc.stdout)[-1]
+    assert saved["families"].get("fast_a") == {"v": 1}
+    assert saved["families"] == final["families"]
+    # no stray tmp file left behind by the atomic-replace dance
+    assert list(tmp_path.iterdir()) == [part]
+
+    off = tmp_path / "off.json"
+    subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        env=_env(BENCH_BUDGET_S=30, BENCH_FAMILY_TIMEOUT_S=2,
+                 BENCH_SELFTEST_HANG_S=0, BENCH_SELFTEST_STEP_S=0.01,
+                 BENCH_PARTIAL_PATH=""),
+        timeout=60)
+    assert not off.exists()
+
+
+def test_sigterm_partial_file_written_signal_safely(tmp_path):
+    """SIGTERM mid-hang: the handler's os.write path leaves a parseable
+    partial file even though normal emission never ran again."""
+    part = tmp_path / "term.json"
+    proc = subprocess.Popen(
+        [sys.executable, BENCH], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+        env=_env(BENCH_BUDGET_S=120, BENCH_FAMILY_TIMEOUT_S=60,
+                 BENCH_SELFTEST_HANG_S=600, BENCH_SELFTEST_STEP_S=0.3,
+                 BENCH_PARTIAL_PATH=part))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        d = _snapshots(line)
+        if d and "fast_b" in d[-1].get("families_done", []):
+            proc.send_signal(signal.SIGTERM)
+            break
+    proc.communicate(timeout=30)
+    assert proc.returncode == 3
+    with open(part) as f:
+        saved = json.load(f)
+    assert saved["families"].get("fast_b") == {"v": 2}
+    assert saved["errors"]["bench"] == "terminated by SIGTERM"
+
+
 def test_sigterm_emits_final_snapshot():
     """SIGTERM (what `timeout` sends first): the handler reaps the
     in-flight child and prints a final cumulative snapshot before

@@ -1,0 +1,359 @@
+"""Continuous-batching LLM serving (nnstreamer_tpu/llm, tensor_llm).
+
+The gate that matters: paged decode must equal `transformer.generate`
+token-for-token at temperature 0 — the paged formulation (gathered KV,
+per-row positions, scratch-block padding) is only a serving layout
+change, never a numerics change. Around it: block-allocator
+invariants, admission under a full pool (queue, never crash), EOS /
+max-token retirement returning blocks, the manifest round-trip for LLM
+buckets, and the tier-1 smoke pushing concurrent requests through the
+tensor_llm element.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.elements import AppSrc, TensorLLM, TensorSink
+from nnstreamer_tpu.llm import BlockAllocator, LLMEngine
+from nnstreamer_tpu.models.transformer import generate, init_params
+from nnstreamer_tpu.serving.store import get_store, reset_store
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(vocab=61, d_model=32, n_layers=2, n_heads=4,
+                       n_kv_heads=2, seed=0)
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    """Shared continuous engine (module scope amortizes jit compiles)."""
+    return LLMEngine(params, n_heads=4, block_size=4, num_blocks=32,
+                     max_batch=4, max_len=64)
+
+
+def _ref(params, prompt, n):
+    return np.asarray(
+        generate(params, np.asarray(prompt)[None, :], n,
+                 n_heads=4, max_len=64))[0, len(prompt):]
+
+
+# -- block allocator ---------------------------------------------------------
+
+def test_allocator_alloc_free_invariants():
+    a = BlockAllocator(8)            # 1 scratch + 7 usable
+    assert a.total == 7 and a.free == 7 and a.used == 0
+    got = a.alloc(3, owner="r1")
+    assert len(got) == 3 and 0 not in got        # scratch never granted
+    assert a.used == 3 and a.high_water == 3
+    # all-or-nothing: 5 > 4 free -> None, nothing consumed
+    assert a.alloc(5) is None
+    assert a.free == 4 and a.failed_allocs == 1
+    a.free_blocks(got)
+    assert a.free == 7 and a.used == 0
+    assert a.high_water == 3                     # high-water sticks
+    # freed blocks are reusable
+    again = a.alloc(7)
+    assert sorted(set(again)) == sorted(again) and len(again) == 7
+
+
+def test_allocator_double_free_raises():
+    a = BlockAllocator(4)
+    got = a.alloc(2)
+    a.free_blocks(got)
+    with pytest.raises(ValueError):
+        a.free_blocks(got)
+    with pytest.raises(ValueError):
+        a.free_blocks([0])           # scratch was never granted
+
+
+def test_allocator_rejects_degenerate_pool():
+    with pytest.raises(ValueError):
+        BlockAllocator(1)            # scratch only: nothing allocatable
+
+
+def test_allocator_stats_utilization():
+    a = BlockAllocator(11)
+    a.alloc(5)
+    s = a.stats()
+    assert s["blocks_total"] == 10 and s["blocks_used"] == 5
+    assert s["utilization"] == 0.5
+
+
+# -- manifest round-trip -----------------------------------------------------
+
+def test_llm_bucket_manifest_roundtrip():
+    from nnstreamer_tpu.serving.compile_cache import (
+        _bucket_from_json, _bucket_to_json)
+
+    for bk in (("llmp", 16), ("llmd", 4)):
+        jb = _bucket_to_json(bk)
+        assert jb is not None
+        assert _bucket_from_json(jb) == bk
+    # the existing kinds still round-trip (no regression)
+    fix = ("fix", ((1, 3), "float32"))
+    assert _bucket_from_json(_bucket_to_json(fix)) == fix
+
+
+# -- decode parity vs transformer.generate -----------------------------------
+
+def test_paged_parity_single_request(engine, params):
+    prompt = np.array([5, 17, 3], np.int32)
+    req = engine.submit(prompt, max_new_tokens=8)
+    engine.drain()
+    assert req.finish_reason == "length"
+    assert np.array_equal(np.array(req.tokens), _ref(params, prompt, 8))
+
+
+def test_paged_parity_interleaved_lengths(engine, params):
+    """Concurrent requests with different prompt lengths interleave in
+    one continuous batch; each stream must still match its own
+    single-sequence generate() bit-for-bit."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 61, size=n).astype(np.int32)
+               for n in (1, 4, 7, 11)]
+    reqs = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    engine.drain()
+    for p, r in zip(prompts, reqs):
+        assert np.array_equal(np.array(r.tokens), _ref(params, p, 6)), \
+            f"plen={len(p)}"
+    # every retirement returned its blocks
+    assert engine.cache.allocator.used == 0
+
+
+def test_paged_parity_staggered_admission(engine, params):
+    """A request admitted mid-flight (merged into a running decode
+    batch) produces the same tokens as one served alone."""
+    a = engine.submit(np.array([9, 2, 40, 11], np.int32),
+                      max_new_tokens=10)
+    engine.step()                    # a is prefilled + decoding
+    b = engine.submit(np.array([33, 1], np.int32), max_new_tokens=5)
+    engine.drain()
+    assert np.array_equal(np.array(a.tokens),
+                          _ref(params, a.prompt, 10))
+    assert np.array_equal(np.array(b.tokens),
+                          _ref(params, b.prompt, 5))
+
+
+# -- admission / retirement --------------------------------------------------
+
+def test_admission_queues_when_pool_full(params):
+    """More requests than the pool can hold: latecomers queue (never
+    crash) and complete as retirements free blocks."""
+    eng = LLMEngine(params, n_heads=4, block_size=4, num_blocks=8,
+                    max_batch=8, max_len=16)
+    # each request needs ceil((2+6)/4)=2 blocks; pool has 7 usable ->
+    # at most 3 resident; 6 requests => queueing is guaranteed
+    reqs = [eng.submit(np.array([i + 1, i + 2], np.int32),
+                       max_new_tokens=6) for i in range(6)]
+    eng.drain()
+    assert all(r.finish_reason == "length" for r in reqs)
+    assert all(len(r.tokens) == 6 for r in reqs)
+    assert eng.admission_blocked > 0
+    assert eng.cache.allocator.failed_allocs > 0
+    assert eng.cache.allocator.used == 0
+    for r in reqs:                   # queueing must not corrupt streams
+        assert np.array_equal(
+            np.array(r.tokens),
+            np.asarray(generate(params, r.prompt[None, :], 6,
+                                n_heads=4, max_len=16))[0, 2:])
+
+
+def test_submit_rejects_unservable_request(params):
+    eng = LLMEngine(params, n_heads=4, block_size=4, num_blocks=8,
+                    max_batch=2, max_len=16)
+    with pytest.raises(BackendError):
+        eng.submit(np.arange(10, dtype=np.int32), max_new_tokens=20)
+    with pytest.raises(BackendError):
+        eng.submit(np.array([], np.int32))
+    with pytest.raises(BackendError):
+        eng.submit(np.array([1], np.int32), max_new_tokens=0)
+
+
+def test_eos_retires_and_frees_blocks(engine, params):
+    """Run once to learn a token the model actually emits, then rerun
+    with that token as eos_id: the request must stop AT the eos token
+    and return its blocks."""
+    prompt = np.array([12, 30], np.int32)
+    probe = engine.submit(prompt, max_new_tokens=8)
+    engine.drain()
+    eos = probe.tokens[3]            # a token known to appear mid-stream
+    req = engine.submit(prompt, max_new_tokens=8, eos_id=eos)
+    engine.drain()
+    assert req.finish_reason == "eos"
+    assert req.tokens[-1] == eos
+    assert len(req.tokens) == probe.tokens.index(eos) + 1
+    assert engine.cache.allocator.used == 0
+
+
+def test_static_batching_runs_to_completion(params):
+    """static mode: nothing is admitted while a batch is in flight; the
+    tokens still match generate()."""
+    eng = LLMEngine(params, n_heads=4, block_size=4, num_blocks=32,
+                    max_batch=2, max_len=64, static_batching=True)
+    reqs = [eng.submit(np.array([7 * (i + 1)], np.int32),
+                       max_new_tokens=4) for i in range(3)]
+    eng.step()                       # admits exactly max_batch
+    assert len(eng.active) == 2 and len(eng.queue) == 1
+    eng.step()
+    assert len(eng.queue) == 1       # no top-up mid-batch
+    eng.drain()
+    for r in reqs:
+        assert np.array_equal(np.array(r.tokens),
+                              _ref(params, r.prompt, 4))
+
+
+# -- store integration -------------------------------------------------------
+
+def test_store_hot_swap_adopts_new_weights(params):
+    """tensor_llm's executor rides the model-store epoch contract: after
+    update(), the next step serves the new version's weights."""
+    reset_store()
+    try:
+        store = get_store()
+        from nnstreamer_tpu.backends.xla import ModelBundle
+
+        p2 = init_params(vocab=61, d_model=32, n_layers=2, n_heads=4,
+                         n_kv_heads=2, seed=9)
+        store.register("llm_swap_t", ModelBundle(fn=None, params=params))
+        eng = LLMEngine("store://llm_swap_t", n_heads=4, block_size=4,
+                        num_blocks=32, max_batch=4, max_len=64)
+        prompt = np.array([3, 44, 8], np.int32)
+        r1 = eng.submit(prompt, max_new_tokens=5)
+        eng.drain()
+        assert np.array_equal(np.array(r1.tokens), _ref(params, prompt, 5))
+        store.register("llm_swap_t", ModelBundle(fn=None, params=p2))
+        store.update("llm_swap_t")
+        r2 = eng.submit(prompt, max_new_tokens=5)
+        eng.drain()
+        assert eng.executor.swap_count == 1
+        assert np.array_equal(np.array(r2.tokens), _ref(p2, prompt, 5))
+    finally:
+        reset_store()
+
+
+def test_tracer_records_llm_requests(params):
+    from nnstreamer_tpu.runtime.tracing import Tracer
+
+    tr = Tracer()
+    eng = LLMEngine(params, n_heads=4, block_size=4, num_blocks=32,
+                    max_batch=4, max_len=64, tracer=tr, name="e")
+    eng.submit(np.array([1, 2], np.int32), max_new_tokens=3)
+    eng.drain()
+    recs = tr.llm_requests()
+    assert len(recs) == 1
+    name, req_id, t, args = recs[0]
+    assert name == "e" and args["n_tokens"] == 3
+    assert args["first_token_ms"] is not None
+    assert tr.summary()["llm_requests"] == 1
+
+
+# -- tensor_llm element (tier-1 smoke) ---------------------------------------
+
+def _llm_pipeline(params, **llm_props):
+    reset_store()
+    from nnstreamer_tpu.backends.xla import ModelBundle
+
+    get_store().register("llm_el_t", ModelBundle(fn=None, params=params))
+    src = AppSrc(name="src", spec=TensorsSpec(
+        tensors=(), format=TensorFormat.FLEXIBLE))
+    llm = TensorLLM(name="llm", model="store://llm_el_t", block_size=4,
+                    num_blocks=32, max_batch=4, max_len=64, **llm_props)
+    sink = TensorSink(name="sink")
+    pipe = nns.Pipeline()
+    for e in (src, llm, sink):
+        pipe.add(e)
+    pipe.link(src, llm)
+    pipe.link(llm, sink)
+    return pipe, src, llm, sink
+
+
+def test_tensor_llm_smoke_concurrent_requests(params):
+    """Tier-1 smoke: 4 concurrent requests through the element; every
+    request terminates with exactly its token budget, streamed
+    incrementally, matching generate()."""
+    budgets = {"r0": 3, "r1": 6, "r2": 2, "r3": 5}
+    pipe, src, llm, sink = _llm_pipeline(params)
+    runner = nns.PipelineRunner(pipe)
+    runner.start()
+    try:
+        rng = np.random.default_rng(11)
+        prompts = {}
+        for rid, budget in budgets.items():
+            p = rng.integers(0, 61, size=int(rng.integers(1, 9))) \
+                .astype(np.int32)
+            prompts[rid] = p
+            src.push(TensorBuffer(
+                tensors=(p,), pts=0,
+                meta={"llm": {"request_id": rid,
+                              "max_new_tokens": budget}}))
+        src.end()
+        runner.wait(120)
+    finally:
+        runner.stop()
+    got = {}
+    finals = {}
+    for b in sink.results:
+        m = b.meta["llm"]
+        got.setdefault(m["request_id"], []).extend(
+            int(t) for t in np.asarray(b.tensors[0]))
+        if m["done"]:
+            finals[m["request_id"]] = m
+    assert set(got) == set(budgets)
+    for rid, budget in budgets.items():
+        assert len(got[rid]) == budget, rid
+        assert finals[rid]["finish_reason"] == "length"
+        assert np.array_equal(np.array(got[rid]),
+                              _ref(params, prompts[rid], budget))
+    stats = llm.extra_stats()
+    assert stats["finished"] == 4
+    assert stats["cache"]["blocks_used"] == 0
+    reset_store()
+
+
+def test_tensor_llm_element_properties_registered():
+    from nnstreamer_tpu.core.registry import PluginKind, registry
+
+    cls = registry.get(PluginKind.ELEMENT, "tensor_llm")
+    assert cls is TensorLLM
+    for prop in ("model", "scheduling", "block_size", "num_blocks",
+                 "max_batch", "max_new_tokens", "admit_window_ms"):
+        assert prop in cls.PROPS
+
+
+@pytest.mark.slow
+def test_tensor_llm_open_loop_arrivals(params):
+    """Open-loop Poisson arrivals through the element (the llm_serve
+    bench family's shape, scaled down): every request completes and
+    continuous batching keeps the pool bounded."""
+    pipe, src, llm, sink = _llm_pipeline(params, prewarm=8)
+    runner = nns.PipelineRunner(pipe)
+    runner.start()
+    try:
+        rng = np.random.default_rng(5)
+        arrivals = np.cumsum(rng.exponential(0.01, size=10))
+        t0 = time.perf_counter()
+        for i, t_arr in enumerate(arrivals):
+            dt = t_arr - (time.perf_counter() - t0)
+            if dt > 0:
+                time.sleep(dt)
+            src.push(TensorBuffer(
+                tensors=(rng.integers(0, 61, size=3).astype(np.int32),),
+                pts=i, meta={"llm": {"request_id": f"q{i}",
+                                     "max_new_tokens": 4}}))
+        src.end()
+        runner.wait(120)
+    finally:
+        runner.stop()
+    done = [b.meta["llm"] for b in sink.results if b.meta["llm"]["done"]]
+    assert len(done) == 10
+    stats = llm.extra_stats()
+    assert stats["cache"]["blocks_high_water"] <= \
+        stats["cache"]["blocks_total"]
+    reset_store()
