@@ -183,6 +183,9 @@ class _Bench:
             "fps": round(fps, 2),
             "p50_ms": round(_percentile(lats, 50), 3),
             "p99_ms": round(_percentile(lats, 99), 3),
+            # composed filter→…→filter device segments in this config
+            # (0 = no adjacent-filter runs; see [runtime] device_segments)
+            "device_segments": len(self.runner.device_segments()),
             # per-stage trajectory for future perf PRs: the untraced
             # runner's always-on counters (tracing stays off so fps/lat
             # numbers remain comparable across rounds)
@@ -1484,7 +1487,58 @@ def host_path() -> dict:
     piped["fps_delta_pct"] = (round((f_on - f_off) / f_off * 100, 1)
                               if f_off else 0.0)
     out["piped_fps"] = piped
+    _family_partial(out)
+    # raw vs piped: the same model invoked straight on the backend with
+    # no scheduler in the way — the denominator of the 100x host-path
+    # gap (BENCH_r05: ~34k fps raw vs ~309 piped). piped_over_raw → 1.0
+    # as segment compilation + async dispatch close the gap.
+    out["raw_invoke"] = _raw_invoke_fps()
+    raw_fps = out["raw_invoke"].get("fps") or 0.0
+    ratio = round(f_on / raw_fps, 4) if raw_fps else 0.0
+    out["piped_over_raw"] = ratio
+    # env-tunable regression gate (BENCH_HOSTPATH_RATIO_GATE pattern ==
+    # BENCH_ENV_D2H_GATE_MS: <=0 disables). Off by default — the ratio
+    # only means something on real accelerator runs; CI sets the bar.
+    gate = float(os.environ.get("BENCH_HOSTPATH_RATIO_GATE", "0"))
+    if gate > 0:
+        out["ratio_gate"] = gate
+        out["ratio_gate_ok"] = ratio >= gate
+        if not out["ratio_gate_ok"]:
+            out["errors"] = {"ratio_gate": (
+                f"piped_over_raw {ratio} below the "
+                f"BENCH_HOSTPATH_RATIO_GATE={gate} floor — the host "
+                f"path is re-opening the raw-vs-piped gap")}
     return out
+
+
+def _raw_invoke_fps(iters: int = None) -> dict:
+    """Raw async device invoke FPS of the label model (one frame per
+    invoke, block once at the end) — what the chip does with zero
+    scheduler/host overhead."""
+    import jax
+    import numpy as np
+
+    from nnstreamer_tpu.backends.xla import XLABackend
+
+    model = (MOBILENET_TFLITE if os.path.exists(MOBILENET_TFLITE)
+             else "zoo://mobilenet_v2")
+    if iters is None:
+        iters = 512 if _on_tpu() else 16
+    be = XLABackend()
+    try:
+        be.open({"model": model, "custom": ""})
+        frame = np.random.default_rng(0).integers(
+            0, 256, (1, 224, 224, 3), np.uint8)
+        out = be.invoke((frame,))
+        jax.block_until_ready(tuple(out))          # compile outside
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = be.invoke((frame,))
+        jax.block_until_ready(tuple(out))
+        dt = time.perf_counter() - t0
+    finally:
+        be.close()
+    return {"fps": round(iters / dt, 2), "frames": iters}
 
 
 # -- LLM serving (docs/llm_serving.md) ---------------------------------------

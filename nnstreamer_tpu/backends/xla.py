@@ -39,7 +39,7 @@ from nnstreamer_tpu.backends.base import (
     FilterBackend,
     register_backend,
 )
-from nnstreamer_tpu.core.errors import BackendError
+from nnstreamer_tpu.core.errors import BackendError, SegmentStageError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.tensor.dtypes import DType
 from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
@@ -170,6 +170,16 @@ class XLABackend(FilterBackend):
         self._staged: Dict[int, dict] = {}       # version → prewarmed state
         self._served: "OrderedDict[tuple, bool]" = OrderedDict()
         self.swap_count = 0                      # epoch adoptions observed
+        # composed device segment (graph/optimize.py fuse_segments):
+        # downstream member filters' models trace into THIS backend's
+        # jits as (mid_chain_fn | None, member XLABackend, member name)
+        # stages — one dispatch runs the whole run. _seg_ps/_seg_sig are
+        # the per-invoke member-params snapshot + cache-key signature
+        # (refreshed by _seg_begin at every invoke boundary, which is
+        # where member store epochs are adopted).
+        self._segment: List[tuple] = []
+        self._seg_ps: tuple = ()
+        self._seg_sig: tuple = ()
 
     # -- open / model resolution ------------------------------------------
     def open(self, props: Dict[str, Any]) -> None:
@@ -458,18 +468,106 @@ class XLABackend(FilterBackend):
         self._jitted = None  # recompile with the fused graph
         return True
 
+    def compose_segment(self, stages) -> bool:
+        """Accept a device segment (graph/optimize.py fuse_segments):
+        `stages` is [(mid_chain_fn | None, member_backend, member_name)]
+        in dataflow order. Accepting means every member's model traces
+        into this backend's jits between the head model and the fused
+        post chain — the whole run becomes ONE dispatch, intermediates
+        never leave HBM. Declines (→ host-side member invokes in the
+        element, bit-identical) when a member can't ride one trace:
+        non-XLA backend, different device, a host-side input stage, or
+        per-invoke canary routing (the route changes within a buffer
+        stream, which a single trace can't express)."""
+        for mid, mb, mname in stages:
+            if not isinstance(mb, XLABackend):
+                log.info("segment declined: member %s is not XLA", mname)
+                return False
+            if mb._device != self._device:
+                log.info("segment declined: member %s is on %s, head on "
+                         "%s", mname, mb._device, self._device)
+                return False
+            if mb._canary is not None:
+                log.info("segment declined: member %s has canary "
+                         "routing", mname)
+                return False
+            if any(vs.bundle.host_pre is not None
+                   for vs in mb._vstates.values()) or (
+                    mb._bundle is not None
+                    and mb._bundle.host_pre is not None):
+                log.info("segment declined: member %s model has a "
+                         "host-side input stage", mname)
+                return False
+        self._segment = list(stages)
+        self._jitted = None
+        self._seg_begin()          # initial member params/sig snapshot
+        return True
+
+    def _seg_begin(self) -> None:
+        """Segment-invoke boundary: adopt flipped member store epochs,
+        snapshot member device params (the jit's third packed argument)
+        and the cache-key signature. A signature change — any member
+        swapped versions — retires the single-path jit; bucketed keys
+        carry the signature, so stale compiles simply stop matching."""
+        if not self._segment:
+            return
+        ps: List[Any] = []
+        sig: List[tuple] = []
+        for _, mb, _ in self._segment:
+            if mb._store_entry is not None:
+                ver = mb._pick_version()
+                ps.append(mb._vstates[ver].device_params)
+                sig.append(("v", ver))
+            else:
+                ps.append(mb._current_params())
+                sig.append(mb._ns())
+        sig_t = tuple(sig)
+        if sig_t != self._seg_sig:
+            self._jitted = None
+            self._seg_sig = sig_t
+        self._seg_ps = tuple(ps)
+
+    def _seg_suffix(self) -> tuple:
+        """Cache-key suffix naming every member's version/generation —
+        appended (at the END, so _adopt's leading-("v",…) sweeps keep
+        working) to every bucketed key and batchability verdict."""
+        return (("seg",) + self._seg_sig,) if self._segment else ()
+
+    def _with_seg(self, packed: tuple) -> tuple:
+        """Extend a manually-built (params, aux) packed with the member
+        params snapshot (prewarm/warm-start paths)."""
+        return packed + ((self._seg_ps,) if self._segment else ())
+
     def _full_fn(self, count: bool = True, bundle: ModelBundle = None):
         bundle = bundle or self._bundle
         pre, post = self._pre, self._post
+        seg = list(self._segment)
 
         def full(packed, *xs):
-            params, aux = packed
+            params, aux = packed[0], packed[1]
+            # member params ride as a jit ARGUMENT (same rule as
+            # _post_aux: embedded literals poison downstream compiles);
+            # eval_shape callers pass the 2-tuple form and fall back to
+            # the concrete member params, which eval_shape tolerates
+            segp = packed[2] if len(packed) > 2 else None
             if count:
                 # trace-time side effect: counts compilations, not invokes
                 self.compile_count += 1
             if pre is not None:
                 xs = pre(xs)
             out = _to_tuple(bundle.fn(params, *xs))
+            for i, (mid, mb, mname) in enumerate(seg):
+                try:
+                    if mid is not None:
+                        out = _to_tuple(mid(out))
+                    mp = segp[i] if segp is not None else mb._device_params
+                    out = _to_tuple(mb._bundle.fn(mp, *out))
+                except SegmentStageError:
+                    raise
+                except Exception as e:
+                    # trace-time failure inside a member stage: name the
+                    # member element, not the surviving head
+                    raise SegmentStageError(mname, e) from e
             if post is not None:
                 out = post(out) if aux is None else post(out, aux)
             return out
@@ -477,8 +575,10 @@ class XLABackend(FilterBackend):
         return full
 
     def _packed_params(self):
-        """(model params, post-chain aux) — the jit's first argument."""
-        return (self._current_params(), getattr(self, "_post_aux", None))
+        """(model params, post-chain aux[, member params]) — the jit's
+        first argument. Callers must have run _seg_begin this invoke."""
+        base = (self._current_params(), getattr(self, "_post_aux", None))
+        return base + ((self._seg_ps,) if self._segment else ())
 
     def _current_params(self):
         """Device params, following shared-entry swaps (hot reload)."""
@@ -540,7 +640,8 @@ class XLABackend(FilterBackend):
                     cur, self._store_entry.bundle(cur))
         if staged is not None:
             for basekey, jitted in staged["jits"].items():
-                self._insert_jit((("v", cur),) + basekey, jitted)
+                self._insert_jit(
+                    (("v", cur),) + basekey + self._seg_suffix(), jitted)
         live = {cur}
         if self._canary is not None:
             live.add(self._canary[0])
@@ -578,15 +679,23 @@ class XLABackend(FilterBackend):
         import jax
         import numpy as np_
 
+        from nnstreamer_tpu.runtime.sync import device_sync
+
         vs = self._make_vstate(version, bundle)
-        packed = (vs.device_params, getattr(self, "_post_aux", None))
+        # NOTE: runs on the swap-controller thread — must NOT call
+        # _seg_begin() (worker-owned state); _with_seg reads the last
+        # snapshot, which is fine because member params travel as jit
+        # ARGUMENTS (the compiled jit serves any same-shaped seg params)
+        packed = self._with_seg(
+            (vs.device_params, getattr(self, "_post_aux", None)))
         jits: Dict[tuple, Any] = {}
         compiled = 0
         for basekey in list(self._served):
             specs = self._bucket_array_specs(basekey)
             if specs is None:
                 continue             # flexible seq/bat: recompile lazily
-            if (("v", version),) + basekey in self._dyn_jits:
+            if (("v", version),) + basekey + self._seg_suffix() \
+                    in self._dyn_jits:
                 continue             # already live (e.g. was the canary)
             jitted = jax.jit(self._full_fn(bundle=bundle))
             args = tuple(
@@ -594,8 +703,7 @@ class XLABackend(FilterBackend):
                                self._device) for s, d in specs)
             try:
                 out = _to_tuple(jitted(packed, *args))
-                for o in out:
-                    getattr(o, "block_until_ready", lambda: None)()
+                device_sync(out, self.tracer, self.trace_name)
             except Exception as e:
                 raise BackendError(
                     f"pre-warm of {self._store_entry.name}@{version} "
@@ -618,16 +726,19 @@ class XLABackend(FilterBackend):
         import jax
         import numpy as np_
 
+        from nnstreamer_tpu.runtime.sync import device_sync
         from nnstreamer_tpu.serving.compile_cache import manifest_buckets
 
+        self._seg_begin()        # single-threaded (tensor_filter.start)
         ver = self._adopted_version
         vs = self._vstates.get(ver)
         if vs is None:
             return 0
-        packed = (vs.device_params, getattr(self, "_post_aux", None))
+        packed = self._with_seg(
+            (vs.device_params, getattr(self, "_post_aux", None)))
         compiled = 0
         for basekey in manifest_buckets(self._store_entry.name, ver):
-            key = (("v", ver),) + basekey
+            key = (("v", ver),) + basekey + self._seg_suffix()
             if key in self._dyn_jits:
                 continue
             specs = self._bucket_array_specs(basekey)
@@ -638,8 +749,8 @@ class XLABackend(FilterBackend):
                 args = tuple(
                     jax.device_put(np_.zeros(s, dtype=np_.dtype(d)),
                                    self._device) for s, d in specs)
-                for o in _to_tuple(jitted(packed, *args)):
-                    getattr(o, "block_until_ready", lambda: None)()
+                device_sync(_to_tuple(jitted(packed, *args)),
+                            self.tracer, self.trace_name)
             except Exception as e:
                 # stale manifest (model changed shape since it was
                 # written): warm start is an optimization, never a gate
@@ -725,10 +836,11 @@ class XLABackend(FilterBackend):
         basekey = ("fix",) + tuple(
             (tuple(a.shape), str(a.dtype)) for a in arrs)
         self._note_bucket(ver, basekey)
-        packed = (vs.device_params, getattr(self, "_post_aux", None))
+        packed = self._with_seg(
+            (vs.device_params, getattr(self, "_post_aux", None)))
         hits0 = self.cache_hits
         jitted = self._bucket_jit(
-            (("v", ver),) + basekey,
+            (("v", ver),) + basekey + self._seg_suffix(),
             make=lambda: jax.jit(self._full_fn(bundle=vs.bundle)))
         staged, _ = self._stage(arrs)
         t0 = time.perf_counter()
@@ -750,6 +862,7 @@ class XLABackend(FilterBackend):
     def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
         import jax
 
+        self._seg_begin()
         if self._store_entry is not None:
             return self._invoke_store(tensors)
         if self._bundle.host_pre is not None:
@@ -893,6 +1006,7 @@ class XLABackend(FilterBackend):
         import jax
         import numpy as np_
 
+        self._seg_begin()
         if self._store_entry is not None:
             return self._invoke_batched_store(tensors, n, keepdims)
         if self._bundle.host_pre is not None:
@@ -905,7 +1019,8 @@ class XLABackend(FilterBackend):
                 for t in tensors]
         batched_shapes = tuple((nb,) + tuple(a.shape[1:]) for a in arrs)
         verdict_key = (self._ns(), "dynb") + tuple(
-            (s, str(a.dtype)) for s, a in zip(batched_shapes, arrs))
+            (s, str(a.dtype)) for s, a in zip(batched_shapes, arrs)) \
+            + self._seg_suffix()
         ok = self._batch_ok.get(verdict_key)
         if ok is None:
             try:
@@ -927,7 +1042,8 @@ class XLABackend(FilterBackend):
         # donation: only when every device buffer was staged right here
         # (we own them all); the donating variant is its own cache entry
         donate = self._donate and fresh
-        key = (self._ns(), "dynb", nb) + batched_shapes
+        key = (self._ns(), "dynb", nb) + batched_shapes \
+            + self._seg_suffix()
         if donate:
             self.donated_invokes += 1
             dn = tuple(range(1, 1 + len(staged)))
@@ -988,7 +1104,7 @@ class XLABackend(FilterBackend):
         pairs = tuple(((nb,) + tuple(a.shape[1:]), str(a.dtype))
                       for a in arrs)
         basekey = ("dynb", nb) + pairs
-        verdict_key = (("v", ver),) + basekey
+        verdict_key = (("v", ver),) + basekey + self._seg_suffix()
         ok = self._batch_ok.get(verdict_key)
         if ok is None:
             try:
@@ -1006,7 +1122,8 @@ class XLABackend(FilterBackend):
             return super().invoke_batched(tensors, n, keepdims)
         arrs = self._pad_bucket(arrs, n, nb)
         self._note_bucket(ver, basekey)
-        packed = (vs.device_params, getattr(self, "_post_aux", None))
+        packed = self._with_seg(
+            (vs.device_params, getattr(self, "_post_aux", None)))
         hits0 = self.cache_hits
         staged, fresh = self._stage(arrs)
         donate = self._donate and fresh

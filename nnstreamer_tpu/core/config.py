@@ -55,6 +55,16 @@ _DEFAULTS: Dict[str, Dict[str, str]] = {
         # (HBM churn reduction; ignored on CPU where XLA aliases host
         # memory anyway)
         "donate_inputs": "1",
+        # graph-level device segments: lower maximal linear
+        # transform → filter [→ transform → filter]* [→ decoder(device)]
+        # runs into ONE bucketed jit (graph/optimize.py fuse_segments)
+        # so tensors stay in HBM with one dispatch per segment
+        "device_segments": "1",
+        # bounded async-dispatch window: max unresolved device results a
+        # DEVICE_RESIDENT element may have in flight before the worker
+        # blocks on the oldest (caps HBM held by live buffers); 0 = sync
+        # after every dispatch
+        "max_inflight": "8",
     },
     "serving": {
         # persistent XLA compile cache + bucket manifest for store://

@@ -35,6 +35,17 @@ class BackendError(NNStreamerTPUError):
     """A filter backend (XLA / custom / pallas) failed to open or invoke."""
 
 
+class SegmentStageError(BackendError):
+    """A member stage of a composed device segment failed (trace or
+    host-fallback invoke). Carries the *member element's* name so the
+    owning head filter can attribute the failure to the element the
+    user wrote, not the surviving head."""
+
+    def __init__(self, member: str, exc: BaseException):
+        super().__init__(f"segment stage {member!r} failed: {exc}")
+        self.member = member
+
+
 class StreamError(NNStreamerTPUError):
     """Runtime dataflow failure (the GST_FLOW_ERROR analog)."""
 

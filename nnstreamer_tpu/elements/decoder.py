@@ -138,6 +138,9 @@ class TensorDecoder(Element):
         self._inflight: List = []     # frames awaiting D2H completion
         if self.props["device"]:
             self.WANTS_HOST = False   # keep payloads on device
+            # device decode emits unresolved jax arrays — eligible for
+            # the scheduler's async-dispatch window (no per-result sync)
+            self.DEVICE_RESIDENT = True
         # pipelined host decode (max_in_flight>1) keeps WANTS_HOST=True:
         # the scheduler's enqueue-side prefetch_host starts the copy as
         # early as possible; this element merely defers the blocking

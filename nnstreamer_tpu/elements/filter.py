@@ -29,7 +29,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu.backends.base import CircuitBreaker, FilterBackend, get_backend
 from nnstreamer_tpu.core.config import get_config
-from nnstreamer_tpu.core.errors import BackendError, CircuitOpenError, PipelineError
+from nnstreamer_tpu.core.errors import (
+    BackendError, CircuitOpenError, PipelineError, SegmentStageError)
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.graph.pipeline import Element, Emission, PropDef, StreamSpec
@@ -63,6 +64,10 @@ class TensorFilter(Element):
     # lets its device dispatch overlap upstream conversion (the async-
     # dispatch property the scheduler exists to provide)
     CHAIN_FUSABLE = False
+    # invoke is non-blocking — outputs leave as unresolved jax arrays,
+    # so the scheduler may pipeline dispatches behind a bounded window
+    # instead of syncing per buffer ([runtime] max_inflight)
+    DEVICE_RESIDENT = True
     PROPS = {
         "framework": PropDef(str, "", "backend name (xla|custom|pallas|…)"),
         "model": PropDef(lambda s: s, None, "model reference (backend-specific)"),
@@ -124,6 +129,15 @@ class TensorFilter(Element):
         self._flexible = False
         self._dyn_batched = 0                 # dyn_batch of the input stream
         self._batch_keepdims: List[bool] = []
+        # device segment (graph/optimize.py fuse_segments): downstream
+        # filters absorbed into this head as (mid_programs, element)
+        self._members: List[Tuple[list, "TensorFilter"]] = []
+        # negotiated per-member host-fallback stages:
+        # (mid chain_fn | None, member, member batch keepdims)
+        self._member_stages: List[Tuple[Optional[Callable],
+                                        "TensorFilter", List[bool]]] = []
+        self._segment_in_backend = False
+        self._forced_syncs = 0                # host syncs this element forced
 
     # -- combination parsing ----------------------------------------------
     @staticmethod
@@ -168,6 +182,21 @@ class TensorFilter(Element):
             return tuple(out) if isinstance(out, (tuple, list)) else (out,)
 
         self._post = post
+
+    def absorb_member(self, mid_programs, member: "TensorFilter") -> None:
+        """Absorb a downstream tensor_filter (plus the transform chain
+        connecting it) removed by `graph/optimize.fuse_segments`. The
+        member's model traces into this head's jit at negotiation time
+        (`XLABackend.compose_segment`); a declining backend gets the
+        member invoked host-side per buffer instead."""
+        self._members.append((list(mid_programs or []), member))
+
+    def segment_name(self) -> str:
+        """head+member1+member2… — the trace/report identity of the
+        composed segment (empty string when no members)."""
+        if not self._members:
+            return ""
+        return "+".join([self.name] + [m.name for _, m in self._members])
 
     def _host_decoder_aux(self):
         """Device-resident aux for the host-side fused-decoder fallback,
@@ -303,6 +332,13 @@ class TensorFilter(Element):
                 model_out = self.backend.set_input_info(model_sees)
             except BackendError as e:
                 self.fail_negotiation(str(e))
+        # device segment: chain member negotiation — each member sees the
+        # previous stage's output spec after its connecting transform
+        # chain, reusing every member-side validation (model-info checks,
+        # overrides, backend open) exactly as if it were still in the
+        # graph. The currency stays per-frame (dyn_batch stripped above).
+        if self._members:
+            model_out = self._negotiate_members(model_out, spec.rate)
         # fused post-chain spec transfer
         model_out = transfer_spec(self._post_programs, model_out)
         if self._fused_decoder is not None:
@@ -340,6 +376,46 @@ class TensorFilter(Element):
             out = replace(out, dyn_batch=self._dyn_batched)
         return [out]
 
+    def _negotiate_members(self, model_out: TensorsSpec, rate) -> TensorsSpec:
+        """Chain member negotiation through the segment, then offer the
+        backend the composed trace. Returns the last member's output
+        spec (the segment's spec currency for the post chain/decoder)."""
+        from nnstreamer_tpu.graph.optimize import chain_fn, transfer_spec
+
+        self._member_stages = []
+        cur = model_out
+        for mids, m in self._members:
+            cur = transfer_spec(mids, cur)
+            keep = [len(t.shape) >= 1 and t.shape[0] == 1
+                    for t in cur.tensors]
+            [cur] = m.negotiate([cur.with_rate(rate)])
+            self._member_stages.append((chain_fn(mids), m, keep))
+        compose = getattr(self.backend, "compose_segment", None)
+        self._segment_in_backend = bool(compose is not None and compose(
+            [(fn, m.backend, m.name) for fn, m, _ in self._member_stages]))
+        if not self._segment_in_backend:
+            log.info(
+                "segment %s: backend declined composition; member invokes "
+                "run host-side (results identical)", self.segment_name())
+        return cur
+
+    def _apply_segment_host(self, outputs, n=None, keepdims=None):
+        """Declined-composition fallback: run each member's connecting
+        chain + model invoke host-side, in dataflow order. One dispatch
+        per member instead of one per segment, but bit-identical."""
+        for fn, m, keep in self._member_stages:
+            if fn is not None:
+                outputs = fn(outputs)
+            try:
+                if n is None:
+                    outputs = m.backend.invoke(outputs)
+                else:
+                    outputs = m.backend.invoke_batched(outputs, n, keep)
+            except Exception as e:
+                m.backend.invoke_failures += 1
+                raise SegmentStageError(m.name, e) from e
+        return outputs
+
     def _subset_spec(self, spec: TensorsSpec) -> TensorsSpec:
         idxs = self._in_combination
         if any(i >= spec.num_tensors for i in idxs):
@@ -365,18 +441,33 @@ class TensorFilter(Element):
                 self.props["breaker_cooldown_ms"] / 1e3)
         if self.backend is not None:
             # hand the runner's tracer down so backend compile/invoke
-            # spans land on this element's trace track
+            # spans land on this element's trace track — for a composed
+            # segment the track carries the joined member names, so
+            # report() shows the segment instead of vanished elements
             self.backend.tracer = self._tracer
-            self.backend.trace_name = self.name
+            self.backend.trace_name = self.segment_name() or self.name
             # store-bound backends replay their persistent bucket
             # manifest here — start() runs before any buffer flows, so
             # a restarted process compiles its working set off the hot
             # path (warm against the on-disk XLA cache)
             self.backend.warm_start()
+        for _, m, _ in self._member_stages:
+            if m.backend is not None:
+                m.backend.tracer = self._tracer
+                m.backend.trace_name = m.name   # swaps keep member identity
+                if not self._segment_in_backend:
+                    # host fallback invokes the member backend directly —
+                    # warm its manifest like any standalone filter;
+                    # composed members only feed params/fns into the
+                    # head's jit, so their own buckets never compile
+                    m.backend.warm_start()
 
     def stop(self) -> None:
         if self.backend is not None:
             self.backend.close()
+        for _, m in self._members:
+            if m.backend is not None:
+                m.backend.close()
 
     def extra_stats(self) -> dict:
         """Backend compile/cache counters merged into this element's
@@ -402,6 +493,16 @@ class TensorFilter(Element):
         if self._breaker is not None:
             for k, v in self._breaker.stats().items():
                 out["breaker_" + k] = v
+        if self._members:
+            out["segment"] = self.segment_name()
+            out["segment_size"] = 1 + len(self._members)
+            out["segment_composed"] = int(self._segment_in_backend)
+            mswaps = sum(getattr(m.backend, "swap_count", 0) or 0
+                         for _, m in self._members)
+            if mswaps:
+                out["backend_swaps"] = out.get("backend_swaps", 0) + mswaps
+        if self._forced_syncs:
+            out["forced_syncs"] = self._forced_syncs
         return out
 
     def _invoke_guarded(self, invoke, *args):
@@ -422,6 +523,32 @@ class TensorFilter(Element):
         br.record_success()
         return out
 
+    def _invoke_segment(self, inputs):
+        """One segment invoke: a composed backend runs every member
+        inside the head's jit (one dispatch); otherwise members run
+        host-side after the head. Guarded as ONE unit by the breaker —
+        the head's policy/breaker governs the whole segment."""
+        outputs = self.backend.invoke(inputs)
+        if self._member_stages and not self._segment_in_backend:
+            outputs = self._apply_segment_host(outputs)
+        return outputs
+
+    def _invoke_segment_batched(self, inputs, n, keepdims):
+        outputs = self.backend.invoke_batched(inputs, n, keepdims)
+        if self._member_stages and not self._segment_in_backend:
+            outputs = self._apply_segment_host(outputs, n, keepdims)
+        return outputs
+
+    def _sync_outputs(self, outputs):
+        """latency-mode=sync: one whole-tuple forced host sync per
+        buffer (runtime/sync.py — counted by the tracer and surfaced as
+        the `forced_syncs` stat)."""
+        from nnstreamer_tpu.runtime.sync import device_sync
+
+        device_sync(tuple(outputs), self._tracer, self.name)
+        self._forced_syncs += 1
+        return outputs
+
     # -- hot loop (reference §3.2) -----------------------------------------
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
         if self._flexible:
@@ -435,9 +562,15 @@ class TensorFilter(Element):
         if self._pre is not None and not self._fused_in_backend:
             inputs = self._pre(inputs)
         try:
-            outputs = self._invoke_guarded(self.backend.invoke, inputs)
+            outputs = self._invoke_guarded(self._invoke_segment, inputs)
         except CircuitOpenError:
             raise   # keep the type — error policies never retry these
+        except SegmentStageError as e:
+            self.backend.invoke_failures += 1
+            raise BackendError(
+                f"tensor_filter {self.name}: segment member {e.member!r} "
+                f"failed on frame pts={buf.pts}: {e}"
+            ) from e
         except Exception as e:
             self.backend.invoke_failures += 1
             raise BackendError(
@@ -450,7 +583,7 @@ class TensorFilter(Element):
             outputs = self._post(outputs) if self._fused_decoder is None \
                 else self._post(outputs, self._host_decoder_aux())
         if self.props["latency_mode"] == "sync":
-            outputs = tuple(_block(o) for o in outputs)
+            outputs = tuple(self._sync_outputs(outputs))
         dt = time.perf_counter() - t0
         self._lat_window.append(dt)
         self._invoke_count += 1
@@ -479,9 +612,16 @@ class TensorFilter(Element):
             inputs = self._pre(inputs)
         try:
             outputs = self._invoke_guarded(
-                self.backend.invoke_batched, inputs, n, self._batch_keepdims)
+                self._invoke_segment_batched, inputs, n,
+                self._batch_keepdims)
         except CircuitOpenError:
             raise
+        except SegmentStageError as e:
+            self.backend.invoke_failures += 1
+            raise BackendError(
+                f"tensor_filter {self.name}: segment member {e.member!r} "
+                f"failed on buffer pts={buf.pts} occupancy={n}: {e}"
+            ) from e
         except Exception as e:
             self.backend.invoke_failures += 1
             raise BackendError(
@@ -492,7 +632,7 @@ class TensorFilter(Element):
             outputs = self._post(outputs) if self._fused_decoder is None \
                 else self._post(outputs, self._host_decoder_aux())
         if self.props["latency_mode"] == "sync":
-            outputs = tuple(_block(o) for o in outputs)
+            outputs = tuple(self._sync_outputs(outputs))
         self._lat_window.append(time.perf_counter() - t0)
         self._invoke_count += n   # throughput prop counts FRAMES
         return [(0, buf.with_tensors(outputs))]
@@ -519,7 +659,7 @@ class TensorFilter(Element):
         if self._post is not None and not self._fused_in_backend:
             outputs = [self._post((o,))[0] for o in outputs]
         if self.props["latency_mode"] == "sync":
-            outputs = [_block(o) for o in outputs]
+            outputs = list(self._sync_outputs(tuple(outputs)))
         self._lat_window.append(time.perf_counter() - t0)
         self._invoke_count += 1
         return [(0, buf.with_tensors(tuple(outputs)))]
@@ -551,7 +691,12 @@ class TensorFilter(Element):
 
 
 def _block(x):
-    return x.block_until_ready() if hasattr(x, "block_until_ready") else x
+    """Compat shim — every host sync routes through runtime/sync.py so
+    forced syncs are counted in one place."""
+    from nnstreamer_tpu.runtime.sync import device_sync
+
+    device_sync((x,))
+    return x
 
 
 def np_shape(x):

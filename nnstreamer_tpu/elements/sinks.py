@@ -15,6 +15,7 @@ from typing import List
 
 from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.graph.pipeline import PropDef, SinkElement, prop_bool
+from nnstreamer_tpu.runtime.sync import device_sync
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 
 
@@ -139,7 +140,7 @@ class FakeSink(SinkElement):
 
     def render(self, buf: TensorBuffer) -> None:
         if self.props["sync_device"]:
-            for t in buf.tensors:
-                if hasattr(t, "block_until_ready"):
-                    t.block_until_ready()
+            # one whole-tuple sync per buffer (not a per-tensor loop):
+            # a single runtime round-trip, counted by the tracer
+            device_sync(buf.tensors, self._tracer, self.name)
         self.count += 1

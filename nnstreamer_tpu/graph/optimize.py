@@ -1,4 +1,4 @@
-"""Graph optimization: transform→filter fusion.
+"""Graph optimization: transform→filter fusion + device segments.
 
 The north-star optimization (BASELINE.json): linear chains of
 `tensor_transform` elements adjacent to a `tensor_filter` are removed from
@@ -7,6 +7,13 @@ traces them into the *same* jit computation as the model. Pre/post
 elementwise work then fuses with the model's HLO — no per-element hops, no
 extra HBM round trips. The reference instead runs each transform as a
 separate GstBaseTransform pass with its own memcpy (gsttensor_transform.c).
+
+`fuse_segments` goes one level further (profiled-segment execution on
+TPUs, arXiv 2503.01025): maximal linear runs of
+transform → filter → transform → filter … → decoder(device=true) collapse
+into ONE surviving head filter whose backend traces every member model
+(and the connecting transform chains) into a single bucketed jit — one
+dispatch per segment, tensors resident in HBM end-to-end.
 
 Fusion is semantics-preserving: negotiation runs after rewriting, and a
 backend that declines fusion gets the chains applied host-side by the
@@ -78,6 +85,101 @@ def _jnp():
     import jax.numpy as jnp
 
     return jnp
+
+
+def _segment_head_ok(f) -> bool:
+    """Can `f` anchor a multi-filter device segment? The head survives in
+    the graph, keeps its own props/policy (which then govern the whole
+    segment), and its backend hosts the composed jit."""
+    return (
+        f._framework_name() == "xla"
+        # dynamic shapes / output rerouting change the tuple contract the
+        # composed trace relies on
+        and not f.props.get("invoke_dynamic")
+        and not f.props.get("output_combination")
+    )
+
+
+def _segment_member_ok(pipe: Pipeline, e) -> bool:
+    """Can `e` be absorbed into an upstream head's segment? Members
+    vanish from the graph, so anything that gives a member independent
+    runtime behavior (its own error policy, breaker, sync latency
+    timing, combination routing, manual reload) keeps it separate."""
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    return (
+        isinstance(e, TensorFilter)
+        and len(pipe.links_to(e)) == 1
+        and len(pipe.links_from(e)) == 1
+        and e.error_policy.kind == "fail"
+        and e._framework_name() == "xla"
+        and not e.props.get("invoke_dynamic")
+        and not e.props.get("input_combination")
+        and not e.props.get("output_combination")
+        and e.props.get("latency_mode") != "sync"
+        and not e.props.get("breaker_threshold")
+        and not e.props.get("shared_tensor_filter_key")
+        and not e.props.get("is_updatable")
+        and not e._members
+    )
+
+
+def fuse_segments(pipe: Pipeline) -> int:
+    """Collapse filter→transform→filter runs into the upstream filter.
+
+    For each eligible head filter, repeatedly: walk the downstream
+    linear run of fusable transforms; if it lands on an eligible member
+    filter, splice the transforms + member out of the graph and hand
+    them to the head (`TensorFilter.absorb_member`). The head's backend
+    then traces member models (+ connecting chains) into one jit
+    (`XLABackend.compose_segment`); a declining backend gets the member
+    invokes applied host-side by the head, so results are identical.
+
+    Run BEFORE `fuse_transforms`: the head's pre chain, the post chain
+    trailing the *last* member, and a trailing device decoder are all
+    absorbed by the ordinary transform pass afterwards.
+
+    → number of elements removed from the graph.
+    """
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    removed = 0
+    for f in [e for e in list(pipe.elements.values())
+              if isinstance(e, TensorFilter)]:
+        # upstream heads run first (insertion order ≈ dataflow order for
+        # parse_launch); a filter absorbed earlier is gone from the graph
+        if f.name not in pipe.elements or not _segment_head_ok(f):
+            continue
+        while True:
+            mids: List = []
+            cur = f
+            ok = True
+            while True:
+                out_links = pipe.links_from(cur)
+                if len(out_links) != 1:
+                    ok = False
+                    break
+                nxt = out_links[0].dst
+                if _is_fusable_transform(pipe, nxt):
+                    mids.append(nxt)
+                    cur = nxt
+                    continue
+                break
+            if not ok:
+                break
+            member = pipe.links_from(cur)[0].dst
+            if not _segment_member_ok(pipe, member):
+                break   # transforms (if any) stay for fuse_transforms
+            for t in mids:
+                _remove_linear_element(pipe, t)
+            _remove_linear_element(pipe, member)
+            f.absorb_member([t.program for t in mids], member)
+            removed += 1 + len(mids)
+            log.info(
+                "segment: absorbed filter %s (+%d transform(s)) into %s",
+                member.name, len(mids), f.name,
+            )
+    return removed
 
 
 def fuse_transforms(pipe: Pipeline) -> int:

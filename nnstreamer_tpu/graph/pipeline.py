@@ -108,6 +108,13 @@ class Element:
     #: (tensor_filter: device dispatch must overlap upstream conversion)
     #: set this False.
     CHAIN_FUSABLE: bool = True
+    #: element's outputs may stay as unresolved device arrays: the
+    #: scheduler does NOT block on results before enqueueing them
+    #: downstream, letting JAX's async engine pipeline invokes. A
+    #: bounded in-flight window ([runtime] max_inflight) caps live HBM.
+    #: Set by tensor_filter and device-mode tensor_decoder; host-bound
+    #: elements (sinks, wire encoders) stay False and are sync points.
+    DEVICE_RESIDENT: bool = False
     #: tracing hook surface — the runner assigns the session tracer to
     #: every element before start(); elements emit custom events with
     #: `if self._tracer.active: self._tracer.instant(self.name, ...)`

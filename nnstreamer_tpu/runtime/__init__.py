@@ -5,7 +5,9 @@ from nnstreamer_tpu.runtime.tracing import NULL_TRACER, NullTracer, Tracer
 from nnstreamer_tpu.runtime.scheduler import EOS, PipelineRunner, run_pipeline
 from nnstreamer_tpu.runtime.input_pipeline import (
     DeviceFeeder, prefetch_to_device)
+from nnstreamer_tpu.runtime.sync import device_sync, forced_sync_count
 
 __all__ = ["PipelineRunner", "run_pipeline", "EOS",
            "Tracer", "NullTracer", "NULL_TRACER",
-           "DeviceFeeder", "prefetch_to_device"]
+           "DeviceFeeder", "prefetch_to_device",
+           "device_sync", "forced_sync_count"]

@@ -34,12 +34,24 @@ Host-path design (docs/performance.md):
   sources/sinks, fan-in/fan-out, non-fail policies and `next_deadline`
   users keep dedicated threads. Stats, interlatency tracing and
   EOS/flush ordering stay attributed per element.
+- **Device segments** ([runtime] device_segments, default on): before
+  transform fusion, maximal filter→transform→filter runs collapse into
+  one surviving head filter whose backend traces every member model into
+  a single bucketed jit (`graph/optimize.fuse_segments`) — one dispatch
+  per segment, tensors resident in HBM end-to-end.
+- **Async dispatch window** ([runtime] max_inflight, default 8): a
+  DEVICE_RESIDENT element's worker enqueues unresolved device arrays
+  downstream without blocking, then bounds the number of in-flight
+  dispatches by syncing the OLDEST emitted output once the window
+  overflows. Host-bound elements (WANTS_HOST sinks/encoders) stay the
+  pipeline's sync points; EOS drains the window before propagating.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from nnstreamer_tpu.core.config import get_config
@@ -47,6 +59,7 @@ from nnstreamer_tpu.core.errors import PipelineError, StreamError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.graph.pipeline import Element, Link, Pipeline, SourceElement
 from nnstreamer_tpu.runtime.channel import CLOSED, TIMED_OUT, Channel
+from nnstreamer_tpu.runtime.sync import device_sync
 from nnstreamer_tpu.runtime.tracing import NULL_TRACER, Tracer
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 
@@ -180,7 +193,9 @@ class PipelineRunner:
                  stall_budget_s: Optional[float] = None,
                  queue_stall_budget_s: Optional[float] = None,
                  watchdog_action: Optional[str] = None,
-                 chain_fusion: Optional[bool] = None):
+                 chain_fusion: Optional[bool] = None,
+                 device_segments: Optional[bool] = None,
+                 max_inflight: Optional[int] = None):
         self.pipeline = pipeline
         self._optimize = optimize
         # trace=False → NULL_TRACER (hot path pays one attribute load);
@@ -200,6 +215,18 @@ class PipelineRunner:
             chain_fusion = get_config().get_bool(
                 "runtime", "chain_fusion", True)
         self._chain_fusion = bool(chain_fusion)
+        # device segments: fuse filter→transform→filter runs into one
+        # composed jit before transform fusion (graph/optimize)
+        if device_segments is None:
+            device_segments = get_config().get_bool(
+                "runtime", "device_segments", True)
+        self._device_segments = bool(device_segments)
+        # async-dispatch window depth for DEVICE_RESIDENT elements
+        # (0 = sync after every dispatch)
+        if max_inflight is None:
+            max_inflight = get_config().get_int(
+                "runtime", "max_inflight", 8)
+        self._max_inflight = max(0, max_inflight)
         self._chains: Dict[str, List[Element]] = {}
         self._chain_member: Dict[str, str] = {}
         # built in start(), AFTER transform fusion removed elements —
@@ -257,8 +284,14 @@ class PipelineRunner:
         pipe = self.pipeline
         if not pipe._negotiated:
             if self._optimize:
-                from nnstreamer_tpu.graph.optimize import fuse_transforms
+                from nnstreamer_tpu.graph.optimize import (fuse_segments,
+                                                           fuse_transforms)
 
+                # segments first: the head's pre chain, the post chain
+                # behind the last member and a trailing device decoder
+                # are then absorbed by the ordinary transform pass
+                if self._device_segments:
+                    fuse_segments(pipe)
                 fuse_transforms(pipe)
             pipe.negotiate()
         for name in pipe.elements:
@@ -437,6 +470,15 @@ class PipelineRunner:
                          "call-through):")
             for chain in self._chains.values():
                 lines.append("  " + " → ".join(m.name for m in chain))
+        segs = self.device_segments()
+        if segs:
+            lines.append("")
+            lines.append("device segments (one composed dispatch per "
+                         "segment):")
+            for s in segs:
+                lines.append(
+                    f"  {s['segment']}: {s['size']} filters, "
+                    f"{'composed jit' if s['composed'] else 'host fallback'}")
         lines.append("")
         lines.append(f"queue high-water (capacity {self._cap}):")
         for l in self.pipeline.links:
@@ -476,6 +518,17 @@ class PipelineRunner:
                         f"  {name + mark:<22} {r['n']:>6} "
                         f"{r['p50_ms']:>8.3f} {r['p95_ms']:>8.3f} "
                         f"{r['p99_ms']:>8.3f} {r['max_ms']:>8.3f}")
+            forced = tr.forced_syncs()
+            gauges = tr.inflight_gauges()
+            if forced or gauges:
+                lines.append("")
+                lines.append("async dispatch (forced syncs / in-flight "
+                             "window peaks):")
+                for name, n in sorted(forced.items()):
+                    lines.append(f"  {name}: forced_syncs={n}")
+                for name, g in sorted(gauges.items()):
+                    lines.append(f"  {name}: inflight_peak={g['peak']} "
+                                 f"(window {self._max_inflight})")
             if tr.events_dropped:
                 lines.append("")
                 lines.append(f"note: event ring wrapped, "
@@ -592,6 +645,25 @@ class PipelineRunner:
         """Element-name chains the scheduler fused (after start())."""
         return [[m.name for m in chain]
                 for chain in self._chains.values()]
+
+    def device_segments(self) -> List[dict]:
+        """Device segments formed by `fuse_segments` (after start()):
+        one dict per surviving head filter with absorbed members —
+        {head, segment (joined member names), size, composed} where
+        composed=False means the backend declined and the member stages
+        run host-side (bit-identical results, no single-dispatch win)."""
+        out = []
+        for e in self.pipeline.elements.values():
+            seg = getattr(e, "segment_name", None)
+            if seg is None or not seg():
+                continue
+            out.append({
+                "head": e.name,
+                "segment": seg(),
+                "size": 1 + len(e._members),
+                "composed": bool(e._segment_in_backend),
+            })
+        return out
 
     def _chain_work(self, chain: List[Element]) -> None:
         """Worker loop for a fused chain: one channel read at the head,
@@ -951,6 +1023,12 @@ class PipelineRunner:
         stats = self._stats[elem.name]
         tr = self.tracer
         policy = elem.error_policy    # resolved once; immutable per run
+        # async-dispatch window (DEVICE_RESIDENT elements): outputs are
+        # emitted downstream UNRESOLVED — XLA's async engine pipelines
+        # the dispatches — and this worker blocks only on the OLDEST
+        # emitted output once more than max_inflight are live, bounding
+        # HBM held by in-flight results without a per-result sync
+        window = deque() if elem.DEVICE_RESIDENT else None
         try:
             while not self._stop_evt.is_set():
                 # deadline-aware wait: an element holding half-assembled
@@ -988,6 +1066,16 @@ class PipelineRunner:
                                 self._emit(elem, sp, b)
                         finally:
                             self._inflight.pop(elem.name, None)
+                        if window:
+                            # drain the async window before EOS
+                            # propagates: nothing downstream of the EOS
+                            # sentinel is still unresolved
+                            while window:
+                                device_sync(window.popleft(),
+                                            forced=False)
+                            if tr.active:
+                                tr.record_inflight(
+                                    elem.name, 0, time.perf_counter())
                         if tr.active:
                             tr.record_flush(elem.name, t0,
                                             time.perf_counter())
@@ -1018,6 +1106,15 @@ class PipelineRunner:
                     tr.record_process(elem.name, item, t0, t1)
                 for sp, b in emissions:
                     self._emit(elem, sp, b)
+                    if window is not None and isinstance(b, TensorBuffer) \
+                            and b.on_device:
+                        window.append(b.tensors)
+                if window:
+                    while len(window) > self._max_inflight:
+                        device_sync(window.popleft(), forced=False)
+                    if tr.active:
+                        tr.record_inflight(elem.name, len(window),
+                                           time.perf_counter())
         except Exception as e:
             self._fail(elem, e)
             try:

@@ -99,6 +99,12 @@ class NullTracer:
     def record_llm_request(self, name, req_id, t, **args):
         pass
 
+    def record_forced_sync(self, name, t):
+        pass
+
+    def record_inflight(self, name, depth, t):
+        pass
+
     def instant(self, name, label, t=None, **args):
         pass
 
@@ -140,6 +146,10 @@ class Tracer:
         # retired LLM requests (llm/engine.py): same keep-whole
         # rationale as swaps
         self._llm_requests: List[Tuple[str, str, float, dict]] = []
+        # element name -> count of forced host syncs (runtime/sync.py)
+        self._forced: Dict[str, int] = {}
+        # element name -> {"peak": max async in-flight depth sampled}
+        self._inflight: Dict[str, Dict[str, int]] = {}
 
     # -- scheduler hooks ---------------------------------------------------
     def source_emit(self, name: str, buf, t: float) -> None:
@@ -221,6 +231,33 @@ class Tracer:
     def llm_requests(self) -> List[Tuple[str, str, float, dict]]:
         return list(self._llm_requests)
 
+    def record_forced_sync(self, name: str, t: float) -> None:
+        """A semantic host sync (runtime/sync.py device_sync with
+        forced=True): a sink draining results, a filter in
+        latency_mode=sync, or backend warm-up. These are the host-path
+        tax async dispatch exists to remove — count per element."""
+        self._forced[name] = self._forced.get(name, 0) + 1
+        self._append("i", "sync", name, "forced_sync", t, 0.0, None)
+
+    def forced_syncs(self) -> Dict[str, int]:
+        return dict(self._forced)
+
+    def record_inflight(self, name: str, depth: int, t: float) -> None:
+        """Async-dispatch window gauge: number of unresolved device
+        results a DEVICE_RESIDENT element holds in flight (sampled after
+        the window drain, so the recorded peak never exceeds
+        [runtime] max_inflight)."""
+        g = self._inflight.get(name)
+        if g is None:
+            g = self._inflight[name] = {"peak": 0}
+        if depth > g["peak"]:
+            g["peak"] = depth
+        self._append("C", "inflight", name, "inflight_dispatch", t, 0.0,
+                     depth)
+
+    def inflight_gauges(self) -> Dict[str, dict]:
+        return {name: dict(g) for name, g in self._inflight.items()}
+
     def instant(self, name: str, label: str, t: Optional[float] = None,
                 **args) -> None:
         if t is None:
@@ -298,6 +335,8 @@ class Tracer:
             "events_dropped": self.events_dropped,
             "swaps": len(self._swaps),
             "llm_requests": len(self._llm_requests),
+            "forced_syncs": dict(self._forced),
+            "inflight": self.inflight_gauges(),
         }
 
     def to_chrome_trace(self, pipeline_name: str = "pipeline") -> dict:
@@ -329,7 +368,9 @@ class Tracer:
                 if args:
                     ev["args"] = dict(args)
             elif ph == "C":
-                ev = {"ph": "C", "cat": cat, "name": f"queue:{name}",
+                track = ("inflight" if cat == "inflight"
+                         else "queue")
+                ev = {"ph": "C", "cat": cat, "name": f"{track}:{name}",
                       "pid": 0, "tid": 0, "ts": us,
                       "args": {"depth": args}}
             else:  # "i" instant, scoped to the element's thread track

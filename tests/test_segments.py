@@ -1,0 +1,484 @@
+"""Device-resident segment compilation + async dispatch.
+
+Covers graph/optimize.fuse_segments (filter→transform→filter runs
+collapsing into one head filter), backends/xla.compose_segment (one
+bucketed jit per segment, member params as jit arguments), the host
+fallback when the backend declines composition (bit-identical results),
+the scheduler's DEVICE_RESIDENT bounded in-flight window, chaos
+conservation with segments in the graph, member store:// hot-swap
+adoption at segment-invoke boundaries, and the forced_syncs /
+inflight_dispatch observability surface.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import PipelineRunner, TensorBuffer, parse_launch
+from nnstreamer_tpu.graph.optimize import fuse_segments
+from nnstreamer_tpu.serving import compile_cache
+from nnstreamer_tpu.serving.store import reset_store
+
+
+def _v_double(x):
+    return (x * 2.0,)
+
+
+def _v_inc(x):
+    return (x + 1.0,)
+
+
+def _v_inc100(x):
+    return (x + 100.0,)
+
+
+def _v_neg(x):
+    return (-x,)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store():
+    store = reset_store()
+    compile_cache.reset()
+    yield store
+    reset_store()
+    compile_cache.reset()
+
+
+def _push_frames(src, n, shape=(4,), start=0):
+    for i in range(start, start + n):
+        src.push(TensorBuffer.of(np.full(shape, float(i), np.float32),
+                                 pts=i))
+
+
+def _vals(sink):
+    return [float(np.asarray(b.tensors[0]).ravel()[0])
+            for b in sink.results]
+
+
+def _wait_for(cond, timeout=15.0, what="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        assert time.monotonic() - t0 < timeout, f"timed out: {what}"
+        time.sleep(0.01)
+
+
+def _two_filter_pipe(store, mid_transform=True):
+    store.register("seg_m1", _v_double)
+    store.register("seg_m2", _v_inc)
+    mid = ("tensor_transform mode=arithmetic option=mul:0.5 ! "
+           if mid_transform else "")
+    return parse_launch(
+        "appsrc name=src dims=4 types=float32 ! "
+        "tensor_filter name=f1 model=store://seg_m1 ! "
+        + mid +
+        "tensor_filter name=f2 model=store://seg_m2 ! tensor_sink name=out")
+
+
+# -- discovery / graph splice ------------------------------------------------
+
+class TestFuseSegments:
+    def test_filter_transform_filter_splices(self, _fresh_store):
+        pipe = _two_filter_pipe(_fresh_store)
+        removed = fuse_segments(pipe)
+        assert removed == 2                     # transform + member filter
+        assert set(pipe.elements) == {"src", "f1", "out"}
+        f1 = pipe.get("f1")
+        assert f1.segment_name() == "f1+f2"
+        # spliced link: f1 feeds the sink directly now
+        (out_link,) = pipe.links_from(f1)
+        assert out_link.dst.name == "out"
+
+    def test_three_filter_run_one_head(self, _fresh_store):
+        store = _fresh_store
+        store.register("seg_a", _v_double)
+        store.register("seg_b", _v_inc)
+        store.register("seg_c", _v_neg)
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter name=fa model=store://seg_a ! "
+            "tensor_filter name=fb model=store://seg_b ! "
+            "tensor_transform mode=arithmetic option=add:3.0 ! "
+            "tensor_filter name=fc model=store://seg_c ! "
+            "tensor_sink name=out")
+        fuse_segments(pipe)
+        assert set(pipe.elements) == {"src", "fa", "out"}
+        assert pipe.get("fa").segment_name() == "fa+fb+fc"
+
+    def test_member_with_own_policy_stays(self, _fresh_store):
+        store = _fresh_store
+        store.register("seg_m1", _v_double)
+        store.register("seg_m2", _v_inc)
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter name=f1 model=store://seg_m1 ! "
+            "tensor_filter name=f2 model=store://seg_m2 "
+            "error-policy=skip ! tensor_sink name=out")
+        assert fuse_segments(pipe) == 0
+        assert "f2" in pipe.elements
+        assert pipe.get("f1").segment_name() == ""
+
+    def test_mid_transform_with_policy_blocks_run(self, _fresh_store):
+        store = _fresh_store
+        store.register("seg_m1", _v_double)
+        store.register("seg_m2", _v_inc)
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter name=f1 model=store://seg_m1 ! "
+            "tensor_transform mode=arithmetic option=mul:0.5 "
+            "error-policy=skip ! "
+            "tensor_filter name=f2 model=store://seg_m2 ! "
+            "tensor_sink name=out")
+        # a mid transform with its own error policy must keep its own
+        # element (its failures are policied there), so no run forms
+        assert fuse_segments(pipe) == 0
+        assert "f2" in pipe.elements
+
+    def test_runner_fuses_by_default_and_reports(self, _fresh_store):
+        pipe = _two_filter_pipe(_fresh_store)
+        runner = PipelineRunner(pipe, trace=True)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, 3)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        segs = runner.device_segments()
+        assert segs == [{"head": "f1", "segment": "f1+f2", "size": 2,
+                         "composed": True}]
+        st = runner.stats()["f1"]
+        assert st["segment"] == "f1+f2"
+        assert st["segment_size"] == 2
+        assert st["segment_composed"] == 1
+        # fused-away members never show up as stats rows
+        assert "f2" not in runner.stats()
+        rep = runner.report()
+        assert "device segments" in rep
+        assert "f1+f2" in rep
+
+    def test_device_segments_off_keeps_elements(self, _fresh_store):
+        pipe = _two_filter_pipe(_fresh_store)
+        runner = PipelineRunner(pipe, device_segments=False)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, 3)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        assert runner.device_segments() == []
+        assert "f2" in runner.stats()
+
+
+# -- numerical parity --------------------------------------------------------
+
+class TestSegmentParity:
+    def _run(self, n=16, **runner_kwargs):
+        store = reset_store()
+        pipe = _two_filter_pipe(store)
+        runner = PipelineRunner(pipe, **runner_kwargs)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, n)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        return [np.asarray(b.tensors[0]) for b in sink.results], runner
+
+    def test_bit_identical_on_vs_off(self):
+        on, r_on = self._run(device_segments=True)
+        off, r_off = self._run(device_segments=False)
+        assert r_on.device_segments() and not r_off.device_segments()
+        assert len(on) == len(off) == 16
+        for a, b in zip(on, off):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b)      # bitwise, not allclose
+
+    def test_one_compile_per_bucket(self, _fresh_store):
+        pipe = _two_filter_pipe(_fresh_store)
+        runner = PipelineRunner(pipe)
+        runner.start()
+        src = pipe.get("src")
+        try:
+            _push_frames(src, 10)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        # ONE composed jit serves both models for the steady bucket
+        assert runner.stats()["f1"]["backend_compile_count"] == 1
+
+    def test_decline_falls_back_host_side_identical(self, _fresh_store):
+        """A member whose backend declines composition (canary routing
+        needs per-invoke version picks) still fuses in the graph; the
+        head applies member stages host-side, bit-identical."""
+        store = _fresh_store
+        store.register("seg_m1", _v_double)
+        store.register("seg_m2", _v_inc)
+        store.register("seg_m2", _v_inc100)   # v2 exists; canary ref
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter name=f1 model=store://seg_m1 ! "
+            "tensor_filter name=f2 model=store://seg_m2@2:0.01 ! "
+            "tensor_sink name=out")
+        runner = PipelineRunner(pipe)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, 8)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        st = runner.stats()["f1"]
+        assert st["segment"] == "f1+f2"
+        assert st["segment_composed"] == 0       # backend declined
+        assert _vals(sink) == [i * 2.0 + 1.0 for i in range(8)]
+
+
+# -- async dispatch window ---------------------------------------------------
+
+class TestAsyncDispatch:
+    def test_source_order_retirement_at_sink(self, _fresh_store):
+        pipe = _two_filter_pipe(_fresh_store)
+        runner = PipelineRunner(pipe, max_inflight=4)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, 32)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        # retirement at the sink is source order, even with up to 4
+        # unresolved dispatches in flight
+        assert [b.pts for b in sink.results] == list(range(32))
+        assert _vals(sink) == [i * 2.0 * 0.5 + 1.0 for i in range(32)]
+
+    def test_eos_drains_window_and_gauge_bounded(self, _fresh_store):
+        pipe = _two_filter_pipe(_fresh_store)
+        runner = PipelineRunner(pipe, trace=True, max_inflight=2)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, 24)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        assert len(sink.results) == 24
+        assert sink.eos.is_set()
+        gauges = runner.tracer.inflight_gauges()
+        assert gauges, "DEVICE_RESIDENT filter never recorded its window"
+        assert all(g["peak"] <= 2 for g in gauges.values()), gauges
+        # the EOS drain records the window returning to 0
+        depths = [ev[6] for ev in runner.tracer.events()
+                  if ev[1] == "inflight"]
+        assert depths and depths[-1] == 0
+
+    def test_max_inflight_zero_syncs_every_dispatch(self, _fresh_store):
+        pipe = _two_filter_pipe(_fresh_store)
+        runner = PipelineRunner(pipe, trace=True, max_inflight=0)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, 6)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        assert _vals(sink) == [i * 2.0 * 0.5 + 1.0 for i in range(6)]
+        assert all(g["peak"] == 0
+                   for g in runner.tracer.inflight_gauges().values())
+
+
+# -- chaos: conservation with segments in the graph --------------------------
+
+class TestChaosWithSegments:
+    def test_conservation_with_upstream_faults(self, _fresh_store):
+        store = _fresh_store
+        store.register("seg_m1", _v_double)
+        store.register("seg_m2", _v_inc)
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_fault name=flt mode=raise probability=0.15 seed=11 "
+            "error-policy=skip ! "
+            "tensor_filter name=f1 model=store://seg_m1 ! "
+            "tensor_filter name=f2 model=store://seg_m2 ! "
+            "tensor_sink name=out")
+        runner = PipelineRunner(pipe)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, 60)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        assert runner.device_segments()          # segment really formed
+        st = runner.stats()["flt"]
+        assert st["errors"] > 0
+        # no buffer lost in flight: emitted + skipped == generated
+        assert len(sink.results) + st["skipped"] == 60
+        assert sink.eos.is_set()
+
+    def test_segment_failure_attributed_to_member(self, _fresh_store):
+        from nnstreamer_tpu.core.errors import StreamError
+
+        store = _fresh_store
+        armed = {"on": False}     # negotiation traces fine; runtime fails
+
+        def boom(x):
+            if armed["on"]:
+                raise RuntimeError("member model exploded")
+            return (x + 1.0,)
+
+        store.register("seg_m1", _v_double)
+        store.register("seg_boom", boom)
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter name=f1 model=store://seg_m1 ! "
+            "tensor_filter name=f2 model=store://seg_boom ! "
+            "tensor_sink name=out")
+        runner = PipelineRunner(pipe)
+        with pytest.raises(StreamError, match="f2"):
+            runner.start()
+            armed["on"] = True
+            src = pipe.get("src")
+            try:
+                _push_frames(src, 2)
+                src.end()
+                runner.wait(30)
+            finally:
+                runner.stop()
+
+
+# -- member hot swap ---------------------------------------------------------
+
+class TestMemberSwap:
+    def test_member_adopts_at_segment_boundary(self, _fresh_store):
+        store = _fresh_store
+        store.register("seg_m1", _v_double)
+        store.register("seg_m2", _v_inc)
+        store.register("seg_m2", _v_inc100)
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter name=f1 model=store://seg_m1 ! "
+            "tensor_filter name=f2 model=store://seg_m2 ! "
+            "tensor_sink name=out")
+        runner = PipelineRunner(pipe, trace=True)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            for _ in range(8):
+                src.push(TensorBuffer.of(np.ones((4,), np.float32)))
+            _wait_for(lambda: len(sink.results) >= 8, what="v1 frames")
+            store.update("seg_m2", wait_s=None)
+            for _ in range(8):
+                src.push(TensorBuffer.of(np.ones((4,), np.float32)))
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        vals = _vals(sink)
+        assert len(vals) == 16
+        # 1*2 + 1 before the flip, 1*2 + 100 after — never a blend,
+        # adoption lands exactly at a segment-invoke boundary
+        assert set(vals) == {3.0, 102.0}
+        flip = vals.index(102.0)
+        assert all(v == 3.0 for v in vals[:flip])
+        assert all(v == 102.0 for v in vals[flip:])
+        # the member's swap shows on the head's stats row
+        assert runner.stats()["f1"]["backend_swaps"] == 1
+
+
+# -- forced-sync observability -----------------------------------------------
+
+class TestForcedSyncs:
+    def test_latency_mode_sync_counts_forced_syncs(self, _fresh_store):
+        store = _fresh_store
+        store.register("seg_solo", _v_double)
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter name=f model=store://seg_solo "
+            "latency-mode=sync ! tensor_sink name=out")
+        runner = PipelineRunner(pipe, trace=True)
+        runner.start()
+        src = pipe.get("src")
+        try:
+            _push_frames(src, 5)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        assert runner.stats()["f"]["forced_syncs"] == 5
+        assert runner.tracer.forced_syncs().get("f") == 5
+
+    def test_fakesink_sync_device_counts(self, _fresh_store):
+        store = _fresh_store
+        store.register("seg_solo", _v_double)
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_filter name=f model=store://seg_solo ! "
+            "fakesink name=snk sync-device=true")
+        runner = PipelineRunner(pipe, trace=True)
+        runner.start()
+        src = pipe.get("src")
+        try:
+            _push_frames(src, 4)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        assert pipe.get("snk").count == 4
+        assert runner.tracer.forced_syncs().get("snk") == 4
+        from nnstreamer_tpu.runtime.sync import forced_sync_count
+        assert forced_sync_count() > 0
+
+
+# -- one dispatch end-to-end -------------------------------------------------
+
+class TestOneDispatch:
+    def test_transform_filter_transform_filter_decoder_single_jit(
+            self, _fresh_store):
+        """The tentpole shape: t → f1 → t → f2 → decoder(device=true)
+        lowers to ONE compiled computation — segment fusion folds f2
+        into f1, then transform fusion folds the pre/post chains and
+        the device decoder into the same jit."""
+        store = _fresh_store
+        store.register("seg_m1", _v_double)
+        # 4 "class" scores; argmax decode runs on device
+        store.register("seg_m2", lambda x: (x + np.arange(
+            4, dtype=np.float32),))
+        pipe = parse_launch(
+            "appsrc name=src dims=4 types=float32 ! "
+            "tensor_transform mode=arithmetic option=add:1.0 ! "
+            "tensor_filter name=f1 model=store://seg_m1 ! "
+            "tensor_transform mode=arithmetic option=mul:2.0 ! "
+            "tensor_filter name=f2 model=store://seg_m2 ! "
+            "tensor_decoder mode=image_labeling device=true ! "
+            "tensor_sink name=out")
+        runner = PipelineRunner(pipe)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        try:
+            _push_frames(src, 6)
+            src.end()
+            runner.wait(30)
+        finally:
+            runner.stop()
+        # everything between src and sink collapsed into f1
+        assert set(pipe.elements) == {"src", "f1", "out"}
+        st = runner.stats()["f1"]
+        assert st["segment"] == "f1+f2"
+        assert st["segment_composed"] == 1
+        assert st["backend_compile_count"] == 1      # ONE dispatch
+        # argmax of (i+1)*2*2 + [0..3] is always class 3
+        assert all(int(np.asarray(b.tensors[0]).ravel()[0]) == 3
+                   for b in sink.results)
