@@ -264,7 +264,11 @@ def _gate_env(env: dict, errors: dict) -> None:
     dominate every host-path number in the artifact — record it as an
     error so the run is flagged, never silently blended into history.
     Override with BENCH_ENV_D2H_GATE_MS; 0 disables."""
-    gate_ms = float(os.environ.get("BENCH_ENV_D2H_GATE_MS", "60"))
+    # 30ms: healthy runs agree on a warm median well under it (r02
+    # 17.32ms, r03 23.42ms) while the one tunnel-degraded run (r05,
+    # pre-fix) read 192ms — the old 60ms gate left a 3x grey zone where
+    # a half-degraded tunnel would still pass and pollute history
+    gate_ms = float(os.environ.get("BENCH_ENV_D2H_GATE_MS", "30"))
     if gate_ms <= 0 or "d2h_1k_ms" not in env:
         return
     env["d2h_gate_ms"] = gate_ms
@@ -1680,6 +1684,78 @@ def llm_serve() -> dict:
     return out
 
 
+#: traffic family: fraction-of-capacity sweep points. Below-knee points
+#: (<1x) should shed nothing; over-capacity points must shed and lose
+#: nothing. Trimmed per-point report keys kept in the artifact.
+TRAFFIC_LOADS = (0.5, 0.9, 1.5, 2.0)
+_TRAFFIC_KEYS = ("offered", "completed", "rejected", "lost",
+                 "offered_rate_rps", "throughput_rps", "goodput_rps",
+                 "shed_rate", "queue_depth_peak", "server_crashed")
+
+
+def _traffic_point(report: dict) -> dict:
+    out = {k: report[k] for k in _TRAFFIC_KEYS if k in report}
+    lat = report.get("latency_ms") or {}
+    out["p50_ms"] = lat.get("p50", 0.0)
+    out["p99_ms"] = lat.get("p99", 0.0)
+    return out
+
+
+def traffic_serve() -> dict:
+    """Admission-control family: open-loop Poisson load against a
+    bounded echo query server at fractions of its capacity, plus the
+    acceptance A/B — at 2x overload the bounded server must shed (typed
+    BUSY), lose nothing, not crash, and its goodput at the p99 budget
+    must be >= the unbounded baseline's (whose queue wait blows the
+    budget for everyone). BENCH_TRAFFIC_SHED_GATE=1 additionally
+    requires zero shed below the knee (<1x points)."""
+    from nnstreamer_tpu.traffic import run_against_echo
+
+    service_ms = 5.0
+    max_pending = 16
+    n = 240
+    # one budget for every arm so goodput numbers are comparable:
+    # a full bounded queue's wait plus one service time
+    budget_ms = (max_pending + 2) * service_ms
+    out = {"service_ms": service_ms, "capacity_rps": 1e3 / service_ms,
+           "max_pending": max_pending, "p99_budget_ms": budget_ms,
+           "n_requests": n}
+    for load_x in TRAFFIC_LOADS:
+        r = run_against_echo(
+            pattern="poisson", load_x=load_x, n=n,
+            service_ms=service_ms, max_pending=max_pending,
+            p99_budget_ms=budget_ms, seed=42)
+        out[f"poisson_x{load_x:g}"] = _traffic_point(r)
+        _family_partial(dict(out))
+    out["bursty_x2"] = _traffic_point(run_against_echo(
+        pattern="bursty", load_x=2.0, n=n, service_ms=service_ms,
+        max_pending=max_pending, p99_budget_ms=budget_ms, seed=42))
+    _family_partial(dict(out))
+    # unbounded baseline for the A/B: same arrivals (same seed), a
+    # queue so deep it never refuses — every request is admitted and
+    # waits, so p99 explodes past the budget instead of being shed
+    unb = run_against_echo(
+        pattern="poisson", load_x=2.0, n=n, service_ms=service_ms,
+        max_pending=100000, p99_budget_ms=budget_ms, seed=42)
+    out["unbounded_x2"] = _traffic_point(unb)
+    bnd = out["poisson_x2"]
+    out["overload_shed"] = bnd["shed_rate"] > 0
+    out["overload_lost"] = bnd["lost"]
+    out["overload_crashed"] = bnd["server_crashed"]
+    out["goodput_win"] = bnd["goodput_rps"] >= unb["goodput_rps"]
+    if not (out["overload_shed"] and out["goodput_win"]
+            and bnd["lost"] == 0 and not bnd["server_crashed"]):
+        out["unverified"] = True   # ship the numbers, flag the claim
+    if os.environ.get("BENCH_TRAFFIC_SHED_GATE") == "1":
+        below_knee_shed = sum(
+            out[f"poisson_x{x:g}"]["rejected"]
+            for x in TRAFFIC_LOADS if x < 1.0)
+        out["shed_gate_ok"] = below_knee_shed == 0
+        if not out["shed_gate_ok"]:
+            out["unverified"] = True
+    return out
+
+
 #: pipeline configs, each its own subprocess family as well — host-path
 #: configs do per-frame D2H, and running them after anything else in
 #: one process measured 2x drift (label 157 -> 76 FPS across trials)
@@ -1707,6 +1783,7 @@ _FAMILIES = {
     "model_swap": lambda: model_swap(),
     "host_path": lambda: host_path(),
     "llm_serve": lambda: llm_serve(),
+    "traffic": lambda: traffic_serve(),
 }
 for _d in OFFLOAD_DELAYS:
     _FAMILIES[f"offload_{_d}"] = (
@@ -1872,7 +1949,7 @@ def _ordered_families() -> list:
         return list(_FAMILIES)
     return (["cfg_label_device", "pallas", "transformer_prefill",
              "mxu_peak", "batch_sweep", "dyn_batch", "host_path",
-             "llm_serve"]
+             "llm_serve", "traffic"]
             + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
             + [f"offload_{d}" for d in OFFLOAD_DELAYS]
             + ["int8_native", "model_swap", "chaos_smoke"])

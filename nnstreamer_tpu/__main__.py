@@ -13,6 +13,8 @@
     python -m nnstreamer_tpu models swap NAME [VER]    # hot swap
     python -m nnstreamer_tpu llm --requests 8          # continuous-batching
                                                        #  LLM serving demo
+    python -m nnstreamer_tpu traffic --load-x 2        # open-loop overload
+                                                       #  harness + SLO report
 """
 
 from __future__ import annotations
@@ -218,6 +220,93 @@ def _llm_main(argv) -> int:
     return 0
 
 
+def _traffic_main(argv) -> int:
+    """`traffic` subcommand: open-loop load against a bounded query
+    server (a self-contained echo server by default, or --host/--port
+    for a live one) and print the latency-SLO report."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu traffic",
+        description="open-loop traffic harness: Poisson/bursty load, "
+                    "admission-control SLO report (docs/traffic.md)")
+    ap.add_argument("--pattern", default="poisson",
+                    choices=("poisson", "bursty"))
+    ap.add_argument("--load-x", type=float, default=2.0,
+                    help="offered load as a multiple of server capacity "
+                         "(self-contained mode; default 2.0)")
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--service-ms", type=float, default=5.0,
+                    help="echo server's per-frame service time")
+    ap.add_argument("--max-pending", type=int, default=16,
+                    help="server admission queue bound")
+    ap.add_argument("--max-inflight", type=int, default=0)
+    ap.add_argument("--shed-policy", default="reject-newest",
+                    choices=("reject-newest", "reject-oldest",
+                             "deadline-drop"))
+    ap.add_argument("--budget-ms", type=float, default=None,
+                    help="p99 latency budget for goodput (default: a "
+                         "full queue's wait + one service time)")
+    ap.add_argument("--host", default=None,
+                    help="attack a LIVE server instead (with --port, "
+                         "--dims; --rate becomes absolute rps)")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--dims", default="8:1")
+    ap.add_argument("--types", default="float32")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="absolute offered rps in --host mode")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw report JSON only")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from nnstreamer_tpu.traffic import (
+        bursty_arrivals, poisson_arrivals, run_against_echo,
+        run_open_loop)
+
+    if args.host is not None:
+        if args.port is None:
+            print("--host needs --port", file=sys.stderr)
+            return 2
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        from nnstreamer_tpu.tensor.info import TensorsSpec
+
+        rng = np.random.default_rng(args.seed)
+        if args.pattern == "poisson":
+            arrivals = poisson_arrivals(args.rate, args.requests, rng)
+        else:
+            arrivals = bursty_arrivals(
+                args.requests, rate_high_hz=2 * args.rate,
+                rate_low_hz=max(args.rate / 4, 0.5), rng=rng)
+        spec = TensorsSpec.from_strings(args.dims, args.types)
+        x = np.zeros(spec.tensors[0].shape, spec.tensors[0].dtype.np_dtype)
+        report = run_open_loop(
+            args.host, args.port, dims=args.dims, types=args.types,
+            arrivals=arrivals,
+            make_frame=lambda i: TensorBuffer.of(x, pts=i),
+            p99_budget_ms=args.budget_ms or 250.0)
+    else:
+        report = run_against_echo(
+            pattern=args.pattern, load_x=args.load_x, n=args.requests,
+            service_ms=args.service_ms, max_pending=args.max_pending,
+            max_inflight=args.max_inflight, shed_policy=args.shed_policy,
+            p99_budget_ms=args.budget_ms, seed=args.seed)
+    if args.json:
+        print(json.dumps(report, default=float))
+        return 0
+    tl = report.pop("queue_depth_timeline", None)
+    print(json.dumps(report, indent=2, default=float))
+    if tl:
+        # crude depth-over-time sparkline so overload is visible at a
+        # glance without loading the JSON anywhere
+        peak = max(d for _, d in tl) or 1
+        blocks = " ▁▂▃▄▅▆▇█"
+        line = "".join(blocks[min(8, round(8 * d / peak))] for _, d in tl)
+        print(f"queue depth (peak {peak}): |{line}|", file=sys.stderr)
+    lost = report.get("lost", 0)
+    return 0 if lost == 0 else 1
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
@@ -226,6 +315,8 @@ def main(argv=None) -> int:
         return _models_main(argv[1:])
     if argv and argv[0] == "llm":
         return _llm_main(argv[1:])
+    if argv and argv[0] == "traffic":
+        return _traffic_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu",
         description="TPU-native streaming AI pipelines (gst-launch parity)")

@@ -50,6 +50,26 @@ class StreamError(NNStreamerTPUError):
     """Runtime dataflow failure (the GST_FLOW_ERROR analog)."""
 
 
+class ServerBusyError(StreamError):
+    """A remote query server refused a frame at admission (wire `BUSY`
+    reply): its bounded queue was full, its outstanding-request bound
+    was hit, or the frame's deadline had already passed. Carries the
+    server's view of the overload so callers — and the element
+    error-policy machinery — can back off intelligently:
+    `retry:N:backoff` on the client re-offers after the backoff,
+    `degrade` routes the frame to the fallback pad, `skip` sheds it
+    locally."""
+
+    def __init__(self, msg: str, *, queue_depth: int = 0,
+                 retry_after_ms: float = 0.0, cause: str = "queue_full",
+                 pts=None):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.retry_after_ms = retry_after_ms
+        self.cause = cause
+        self.pts = pts
+
+
 class FaultInjected(StreamError):
     """Raised by the `tensor_fault` element's `mode=raise` injection —
     a distinct type so tests and policies can tell injected chaos from

@@ -8,7 +8,8 @@ reference's TCP/HYBRID/AITT/MQTT zoo; the message framing is:
   u32 type | u32 length | length bytes payload
 
 Types: HELLO (caps string), HELLO_ACK (caps string or error), DATA
-(wire frame, edge/wire.py), RESULT (wire frame), BYE, PING/PONG.
+(wire frame, edge/wire.py), RESULT (wire frame), BYE, PING/PONG,
+BUSY (admission rejection, JSON).
 
 Threading model: a `MsgServer` runs an accept loop + one reader thread
 per connection, dispatching to a callback; `MsgClient` owns one socket
@@ -39,6 +40,11 @@ T_RESULT = 5
 T_BYE = 6
 T_PING = 7
 T_PONG = 8
+# admission rejection: the server refused a DATA frame (bounded queue
+# full / outstanding bound hit / deadline passed). Payload is JSON
+# {"pts", "cause", "queue_depth", "retry_after_ms"} — enough for the
+# client to back off instead of timing out blind (traffic/admission.py)
+T_BUSY = 9
 
 #: hard cap on a single message (matches wire.MAX_FRAME_BYTES intent)
 MAX_MSG = 1 << 31
@@ -90,6 +96,13 @@ class Connection:
     def __init__(self, sock: socket.socket, addr):
         self.sock = sock
         self.addr = addr
+        # Nagle holds every small write after the first until the peer
+        # ACKs — with delayed ACKs that serializes a pipelined client's
+        # window to one frame per reply, defeating max_in_flight>1
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
         self.send_lock = threading.Lock()
         with Connection._id_lock:
             self.client_id = Connection._next_id
@@ -258,6 +271,12 @@ class MsgClient:
                 f"cannot connect to edge peer {host}:{port} after "
                 f"{retries} attempts: {last}")
         self.sock.settimeout(None)
+        # see Connection.__init__: Nagle + delayed ACK would serialize
+        # a pipelined offload window to one frame per reply
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
         self._reader = threading.Thread(target=self._read_loop,
                                         name=f"edge-client:{port}",
                                         daemon=True)

@@ -18,23 +18,31 @@ from __future__ import annotations
 import json
 import queue as _queue
 import threading
+import time
 from collections import deque
 
 from typing import Dict, Iterator, List, Optional, Sequence
 
-from nnstreamer_tpu.core.errors import PipelineError, StreamError
+from nnstreamer_tpu.core.errors import (
+    PipelineError, ServerBusyError, StreamError)
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.core.registry import register_element
 from nnstreamer_tpu.edge import protocol as P
 from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
 from nnstreamer_tpu.graph.pipeline import (
     Element, Emission, PropDef, SinkElement, SourceElement, StreamSpec)
+from nnstreamer_tpu.runtime.tracing import NULL_TRACER, percentile
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.info import TensorsSpec
+from nnstreamer_tpu.traffic.admission import AdmissionQueue
 
 log = get_logger("edge.query")
 
 _STOPPED = object()   # sentinel unblocking _take_reply at teardown
+
+#: retry-after hint on shutdown/dispatch-failure BUSYs, where the
+#: admission queue's drain-rate estimate is meaningless
+_DEFAULT_SHUTDOWN_RETRY_MS = 250.0
 
 
 class QueryServer:
@@ -50,7 +58,12 @@ class QueryServer:
         self.server: Optional[P.MsgServer] = None
         self.in_spec: Optional[TensorsSpec] = None
         self.out_spec: Optional[TensorsSpec] = None
-        self.frames: _queue.Queue = _queue.Queue(maxsize=64)
+        # bounded admission (traffic/admission.py): a full queue refuses
+        # the frame with a typed wire BUSY instead of the seed's silent
+        # drop-after-5s-block, which collapsed goodput under overload.
+        # serversrc re-knobs this from its properties at start().
+        self.frames: AdmissionQueue = AdmissionQueue(max_pending=64)
+        self.tracer = NULL_TRACER
         self.started = threading.Event()
 
     @classmethod
@@ -110,13 +123,64 @@ class QueryServer:
                           self.sid, e)
                 return
             buf = buf.with_meta(client_id=conn.client_id)
+            dec = self.frames.offer(buf)
+            # reject-oldest / deadline-drop sheds previously-ADMITTED
+            # frames: each victim's client still gets a typed BUSY —
+            # the conservation contract is that no request ever ends
+            # neither-replied-nor-rejected
+            for v in dec.victims:
+                if v is not None:
+                    self._send_busy(
+                        v.meta.get("client_id"), v.pts,
+                        dec.victim_cause or "shed",
+                        dec.queue_depth, dec.retry_after_ms)
+            if not dec.admitted:
+                self._send_busy(conn.client_id, buf.pts, dec.cause,
+                                dec.queue_depth, dec.retry_after_ms,
+                                conn=conn)
+
+    def _send_busy(self, client_id, pts, cause: str, depth: int,
+                   retry_after_ms: float,
+                   conn: Optional[P.Connection] = None) -> None:
+        """Typed admission rejection: BUSY carrying the server's queue
+        depth and a retry-after suggestion (the client surfaces it as
+        ServerBusyError through its error policy)."""
+        if self.tracer.active:
+            self.tracer.record_shed(f"query_server_{self.sid}", cause,
+                                    time.perf_counter(), pts=pts,
+                                    depth=depth)
+        if conn is None and self.server is not None and \
+                client_id is not None:
+            conn = self.server.connection(int(client_id))
+        if conn is None:
+            log.warning("server %d: client %s gone, BUSY (%s) for pts=%s "
+                        "undeliverable", self.sid, client_id, cause, pts)
+            return
+        payload = json.dumps({
+            "pts": pts, "cause": cause, "queue_depth": depth,
+            "retry_after_ms": round(retry_after_ms, 1)}).encode()
+        try:
+            conn.send(P.T_BUSY, payload, timeout=5.0)
+        except OSError as e:
+            log.warning("server %d: BUSY to %s failed (%s); closing the "
+                        "connection", self.sid, client_id, e)
             try:
-                self.frames.put(buf, timeout=5)
-            except _queue.Full:
-                log.warning("server %d: frame queue full, dropping "
-                            "(client %d)", self.sid, conn.client_id)
+                conn.close()
+            except OSError:
+                pass
+
+    def send_busy(self, client_id, pts, cause: str) -> None:
+        """BUSY a previously-admitted frame that will never be answered
+        (dispatch failure, shutdown drain)."""
+        c = self.frames.counters()
+        self._send_busy(client_id, pts, cause, c["depth"],
+                        _DEFAULT_SHUTDOWN_RETRY_MS)
 
     def reply(self, client_id: int, buf: TensorBuffer) -> None:
+        # a request is "served" once its result reaches the reply path,
+        # even if the client has meanwhile vanished — completion
+        # accounting must balance admission accounting
+        self.frames.note_replied()
         conn = self.server.connection(client_id) if self.server else None
         if conn is None:
             log.warning("server %d: client %d gone, dropping result",
@@ -139,6 +203,12 @@ class QueryServer:
                 pass
 
     def stop(self) -> None:
+        # admitted-but-unprocessed frames are shed with a typed BUSY
+        # before the transport drops: no client is left to time out
+        # blind on a request the server silently discarded
+        for v in self.frames.shed_remaining("shutdown"):
+            if v is not None:
+                self.send_busy(v.meta.get("client_id"), v.pts, "shutdown")
         if self.server is not None:
             self.server.close()
             self.server = None
@@ -161,6 +231,18 @@ class TensorQueryServerSrc(SourceElement):
         "id": PropDef(int, 0, "server pair id"),
         "dims": PropDef(str, None, "accepted input dims"),
         "types": PropDef(str, "float32"),
+        # admission control (traffic/admission.py, docs/traffic.md):
+        # a full server answers BUSY instead of buffering unboundedly
+        "max_pending": PropDef(
+            int, 64, "admission queue bound; a full queue sheds per "
+                     "shed_policy with a typed BUSY reply"),
+        "max_inflight": PropDef(
+            int, 0, "bound on outstanding requests (queued + "
+                    "processing); 0 = unlimited"),
+        "shed_policy": PropDef(
+            str, "reject-newest",
+            "reject-newest | reject-oldest | deadline-drop (sheds "
+            "requests whose meta deadline_ms budget has passed)"),
         # HYBRID connect type (tensor_query_common.c:35-39): advertise
         # this server under topic= at an EdgeBroker so clients find it by
         # name instead of host:port
@@ -189,6 +271,16 @@ class TensorQueryServerSrc(SourceElement):
     def start(self) -> None:
         self._srv = QueryServer.get(self.props["id"])
         self._srv.in_spec = self.out_specs[0]
+        try:
+            self._srv.frames.configure(
+                max_pending=self.props["max_pending"],
+                max_inflight=self.props["max_inflight"],
+                shed_policy=self.props["shed_policy"])
+        except ValueError as e:
+            raise PipelineError(f"{self.name}: {e}") from None
+        # the runner hands the tracer down before start(): shed events
+        # land on the pipeline's trace alongside everything else
+        self._srv.tracer = self._tracer
         self._srv.start(self.props["host"], self.props["port"])
         if self.props["broker_port"]:
             if not self.props["topic"]:
@@ -216,10 +308,9 @@ class TensorQueryServerSrc(SourceElement):
     def interrupt(self) -> None:
         self._stop.set()
         if self._srv is not None:
-            try:
-                self._srv.frames.put_nowait(None)
-            except _queue.Full:
-                pass
+            # sentinels bypass admission and cannot be lost to a full
+            # queue (AdmissionQueue.put_nowait is unbounded for them)
+            self._srv.frames.put_nowait(None)
 
     def stop(self) -> None:
         if self._broker is not None:
@@ -234,6 +325,29 @@ class TensorQueryServerSrc(SourceElement):
             if item is None:
                 return
             yield item
+
+    def admission_counters(self) -> Dict:
+        """Consistent admission/shed snapshot (traffic harness reads
+        this for the conservation check)."""
+        srv = self._srv or QueryServer.get(self.props["id"])
+        return srv.frames.counters()
+
+    def extra_stats(self) -> Dict:
+        c = self.admission_counters()
+        out = {
+            "admitted": c["admitted"],
+            "replied": c["replied"],
+            "rejected_total": sum(c["rejected"].values()),
+            "shed_total": sum(c["shed"].values()),
+            "admission_depth": c["depth"],
+            "admission_depth_peak": c["depth_peak"],
+            "admission_inflight": c["inflight"],
+        }
+        for cause, v in c["rejected"].items():
+            out[f"rejected_{cause}"] = v
+        for cause, v in c["shed"].items():
+            out[f"shed_{cause}"] = v
+        return out
 
 
 @register_element("tensor_query_serversink")
@@ -268,7 +382,21 @@ class TensorQueryServerSink(SinkElement):
 @register_element("tensor_query_client")
 class TensorQueryClient(Element):
     """Sync RPC offload: push frame to server, block (with timeout) for
-    the result, emit it downstream (tensor_query_client.c:657-699)."""
+    the result, emit it downstream (tensor_query_client.c:657-699).
+
+    Backpressure: a server-side admission rejection (wire BUSY) surfaces
+    as `ServerBusyError` carrying the server's queue depth and
+    retry-after hint, so the element error-policy machinery finally sees
+    remote overload: `error_policy=retry:N:backoff` re-offers the frame
+    after the backoff, `degrade` routes it to the fallback pad, `skip`
+    sheds it client-side, and the default `fail` stops the pipeline.
+    With `max_in_flight=1` (the default) retry semantics are exact — the
+    rejected frame IS the retried frame. With a pipelined window >1 a
+    rejection may concern an *older* in-flight frame whose bytes are
+    gone; that frame counts as shed and the policy's retry/backoff acts
+    as send throttling (the overload response that matters), so under
+    overload the emitted sequence can have gaps but never reorders.
+    """
 
     WANTS_HOST = True
 
@@ -298,7 +426,18 @@ class TensorQueryClient(Element):
         self._client: Optional[P.MsgClient] = None
         self._replies: _queue.Queue = _queue.Queue()
         self._hello: _queue.Queue = _queue.Queue()
-        self._pending: "deque" = deque()   # pts of sent-but-unanswered
+        # (pts, t_send) of sent-but-unanswered frames, server FIFO order
+        self._pending: "deque" = deque()
+        # BUSY rejections consumed off the wire but not yet raised (the
+        # raise is deferred to a point where no collected emissions can
+        # be lost with it)
+        self._busy_stash: "deque" = deque()
+        # client-side goodput/rejection stats (extra_stats)
+        self._sent = 0
+        self._replied = 0
+        self._busy = 0
+        self._rtt: "deque" = deque(maxlen=2048)   # reply RTTs, seconds
+        self._last_busy: Optional[dict] = None
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
         spec = self.expect_tensors(in_specs[0])
@@ -359,22 +498,70 @@ class TensorQueryClient(Element):
         if mtype in (P.T_HELLO_ACK, P.T_HELLO_NAK):
             self._hello.put((mtype, payload))
         elif mtype == P.T_RESULT:
-            self._replies.put(payload)
+            self._replies.put(("r", payload))
+        elif mtype == P.T_BUSY:
+            self._replies.put(("b", payload))
 
-    def _take_reply(self) -> Emission:
-        """Pop the oldest in-flight frame's reply (blocking, timeout)."""
+    def _note_busy(self, payload: bytes) -> None:
+        """Consume one BUSY: the rejected frame leaves the in-flight
+        window (it may not be the oldest — rejections are answered at
+        admission, results only after service) and the rejection is
+        stashed for the next deferred raise."""
         try:
-            payload = self._replies.get(timeout=self.props["timeout"])
+            info = json.loads(payload.decode())
+        except ValueError:
+            info = {}
+        pts = info.get("pts")
+        removed = False
+        if pts is not None:
+            for i, (p, _) in enumerate(self._pending):
+                if p == pts:
+                    del self._pending[i]
+                    removed = True
+                    break
+        if not removed and self._pending:
+            self._pending.popleft()
+        self._busy += 1
+        self._last_busy = info
+        self._busy_stash.append(info)
+
+    def _raise_stashed(self) -> None:
+        if not self._busy_stash:
+            return
+        info = self._busy_stash.popleft()
+        cause = info.get("cause", "queue_full")
+        depth = int(info.get("queue_depth", 0))
+        retry_ms = float(info.get("retry_after_ms", 0.0))
+        raise ServerBusyError(
+            f"tensor_query_client {self.name}: server rejected frame "
+            f"pts={info.get('pts')} at admission ({cause}; queue depth "
+            f"{depth}, suggested retry after ~{retry_ms:.0f}ms). Set "
+            f"error_policy=retry:N:backoff_ms | degrade | skip on this "
+            f"element to absorb overload instead of failing",
+            queue_depth=depth, retry_after_ms=retry_ms, cause=cause,
+            pts=info.get("pts"))
+
+    def _take_reply(self) -> Optional[Emission]:
+        """Pop the oldest in-flight frame's reply (blocking, timeout).
+        Returns None when the message was a BUSY rejection — the window
+        shrank but nothing is emitted."""
+        try:
+            item = self._replies.get(timeout=self.props["timeout"])
         except _queue.Empty:
             raise StreamError(
                 f"tensor_query_client {self.name}: no reply for frame "
-                f"pts={self._pending[0]} within {self.props['timeout']}s "
-                f"(server overloaded or connection lost)") from None
-        if payload is _STOPPED:
+                f"pts={self._pending[0][0]} within "
+                f"{self.props['timeout']}s (server overloaded or "
+                f"connection lost)") from None
+        if item is _STOPPED:
             raise StreamError(
                 f"tensor_query_client {self.name}: stopped with "
                 f"{len(self._pending)} frame(s) still in flight")
-        pts = self._pending.popleft()
+        kind, payload = item
+        if kind == "b":
+            self._note_busy(payload)
+            return None
+        pts, t_send = self._pending.popleft()
         out, _ = decode_buffer(payload)
         out.meta.pop("client_id", None)
         # integrity check for the pipelined window: the reply echoes the
@@ -386,29 +573,67 @@ class TensorQueryClient(Element):
                 f"sync — expected pts={pts}, server answered pts="
                 f"{out.pts}. A frame was dropped server-side; lower "
                 f"max_in_flight or fix the server pipeline")
+        self._replied += 1
+        self._rtt.append(time.perf_counter() - t_send)
         return (0, out.with_tensors(out.tensors, pts=pts))
 
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
+        # a rejection consumed on a previous call raises BEFORE this
+        # frame is sent: under retry the re-invoked process() sends it
+        # exactly once, so no frame is ever duplicated on the wire
+        self._raise_stashed()
         self._client.send(P.T_DATA, encode_buffer(buf))
-        self._pending.append(buf.pts)
+        self._pending.append((buf.pts, time.perf_counter()))
+        self._sent += 1
         emissions: List[Emission] = []
         # opportunistically drain replies that already arrived, then
-        # block only when the in-flight window is full
+        # block only when the in-flight window is full (a consumed BUSY
+        # shrinks the window without emitting)
         while self._pending:
             if not self._replies.empty():
-                emissions.append(self._take_reply())
+                em = self._take_reply()
             elif len(self._pending) >= self.props["max_in_flight"]:
-                emissions.append(self._take_reply())
+                em = self._take_reply()
             else:
                 break
+            if em is not None:
+                emissions.append(em)
+        if self._busy_stash and not emissions:
+            # nothing collected, safe to raise now: with max_in_flight=1
+            # this is the just-sent frame's own rejection, and the retry
+            # policy re-offers it with backoff — exact retry semantics
+            self._raise_stashed()
         return emissions
 
     def flush(self) -> List[Emission]:
-        """EOS: drain every in-flight frame so nothing is dropped."""
+        """EOS: drain every in-flight frame so nothing is dropped.
+        Rejections during the drain are counted, not raised — EOS must
+        deliver what CAN be delivered."""
         emissions: List[Emission] = []
         while self._pending:
-            emissions.append(self._take_reply())
+            em = self._take_reply()
+            if em is not None:
+                emissions.append(em)
+        self._busy_stash.clear()
         return emissions
+
+    def extra_stats(self) -> Dict:
+        """Client-side goodput/rejection view of the offload."""
+        out = {
+            "query_sent": self._sent,
+            "query_replied": self._replied,
+            "query_busy": self._busy,
+            "query_goodput": round(self._replied / self._sent, 4)
+            if self._sent else 1.0,
+        }
+        if self._rtt:
+            vals = sorted(v * 1e3 for v in self._rtt)
+            out["query_rtt_p50_ms"] = round(percentile(vals, 50), 3)
+            out["query_rtt_p95_ms"] = round(percentile(vals, 95), 3)
+        if self._last_busy is not None:
+            out["query_retry_after_ms"] = float(
+                self._last_busy.get("retry_after_ms", 0.0))
+        return out
 
     def stop(self) -> None:
         if self._client is not None:
@@ -438,14 +663,18 @@ class BatchedQueryServer:
     One drain thread feeds the dispatcher so each client's frames enter
     batches in arrival order — the client contract is ordered replies
     (TensorQueryClient enforces the pts sequence). A frame whose
-    dispatch fails gets no reply (the client's per-frame timeout
-    applies); the failure is kept on `.error` for supervisors.
+    dispatch fails is answered with a typed BUSY(dispatch_error) —
+    never silence — and the failure is kept on `.error` for
+    supervisors. Admission knobs (max_pending / max_inflight /
+    shed_policy) mirror tensor_query_serversrc's (docs/traffic.md).
     """
 
     def __init__(self, model, *, sid: int = 0, host: str = "127.0.0.1",
                  port: int = 0, mesh=None, bucket: int = 8,
                  max_delay_ms: float = 2.0, pre=None,
-                 in_spec: Optional[TensorsSpec] = None):
+                 in_spec: Optional[TensorsSpec] = None,
+                 max_pending: int = 64, max_inflight: int = 0,
+                 shed_policy: str = "reject-newest"):
         import jax
 
         from nnstreamer_tpu.backends.xla import XLABackend
@@ -484,6 +713,9 @@ class BatchedQueryServer:
         self.qs.in_spec = in_spec if in_spec is not None \
             else bundle.in_spec
         self.qs.out_spec = bundle.out_spec
+        self.qs.frames.configure(max_pending=max_pending,
+                                 max_inflight=max_inflight,
+                                 shed_policy=shed_policy)
         self.qs.start(host, port)
         self._stop = threading.Event()
         self.error: Optional[Exception] = None
@@ -513,6 +745,11 @@ class BatchedQueryServer:
                 fut = self.dispatcher.submit(buf.tensors[0])
             except StreamError as e:
                 log.warning("batched query: submit failed: %s", e)
+                # the frame was admitted but will never be answered:
+                # account it as shed and tell the client now, instead
+                # of letting its per-frame timeout expire blind
+                self.qs.frames.note_failed("dispatch_error")
+                self.qs.send_busy(cid, pts, "dispatch_error")
                 continue
 
             def done(f, cid=cid, pts=pts):
@@ -522,6 +759,8 @@ class BatchedQueryServer:
                     log.warning("batched query: dispatch failed for "
                                 "client %d: %s", cid, e)
                     self.error = e
+                    self.qs.frames.note_failed("dispatch_error")
+                    self.qs.send_busy(cid, pts, "dispatch_error")
                     return
                 outs = tuple(
                     o[None] if i < len(self._lead1) and self._lead1[i]
@@ -532,10 +771,33 @@ class BatchedQueryServer:
             fut.add_done_callback(done)
 
     def stats(self) -> Dict[str, int]:
-        return {"frames": self.dispatcher.frames,
-                "batches": self.dispatcher.batches}
+        """Consistent snapshot: dispatcher counters are read under the
+        dispatcher's lock (they are mutated from its completion thread)
+        and admission counters under the admission queue's — callers
+        never see a torn frames/batches pair mid-increment."""
+        out = dict(self.dispatcher.stats())
+        adm = self.qs.frames.counters()
+        out.update({
+            "admitted": adm["admitted"],
+            "replied": adm["replied"],
+            "rejected": sum(adm["rejected"].values()),
+            "shed": sum(adm["shed"].values()),
+            "admission_depth_peak": adm["depth_peak"],
+        })
+        return out
 
     def close(self) -> None:
+        """Orderly teardown, strongest guarantee first: no request that
+        a client is still waiting on may end up silently dropped.
+
+        1. stop + JOIN the drain thread (a frame dequeued concurrently
+           is still submitted — the dispatcher is not down yet);
+        2. shed everything still queued with a typed BUSY(shutdown);
+        3. shut the dispatcher down — it drains submitted batches (the
+           done callbacks still reply: the transport is up) and fails
+           any never-dispatched future with a typed StreamError;
+        4. drop the transport.
+        """
         self._stop.set()
         for t in self._drainers:
             t.join(timeout=5)
@@ -543,5 +805,9 @@ class BatchedQueryServer:
                 log.warning(
                     "query server: drainer thread %s still alive after "
                     "5s join at close — wedged consumer leaked", t.name)
+        for v in self.qs.frames.shed_remaining("shutdown"):
+            if v is not None:
+                self.qs.send_busy(v.meta.get("client_id"), v.pts,
+                                  "shutdown")
         self.dispatcher.shutdown()
         self.qs.stop()

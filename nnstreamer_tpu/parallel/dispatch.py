@@ -83,7 +83,9 @@ class MeshDispatcher:
                                            name="mesh-dispatch-complete",
                                            daemon=True)
         self._completer.start()
-        # perf counters (BASELINE.md: p50 latency / batches)
+        # perf counters (BASELINE.md: p50 latency / batches) — mutated
+        # under _lock by the completion thread; read via stats() for a
+        # consistent snapshot (bare attribute reads see a live value)
         self.frames = 0
         self.batches = 0
 
@@ -101,6 +103,13 @@ class MeshDispatcher:
     def infer(self, frame, timeout: Optional[float] = 30.0):
         return self.submit(frame).result(timeout)
 
+    def stats(self) -> dict:
+        """Consistent counter snapshot (one lock hold — the counters
+        are incremented together under _lock, so frames/batches never
+        tear mid-batch)."""
+        with self._lock:
+            return {"frames": self.frames, "batches": self.batches}
+
     def shutdown(self) -> None:
         with self._lock:
             self._stop = True
@@ -110,6 +119,17 @@ class MeshDispatcher:
             log.warning("dispatcher: batcher thread %s still alive after "
                         "30s join at shutdown — thread leaked",
                         self._thread.name)
+        # the batcher normally drains _pending before exiting; if it
+        # died or wedged, fail the leftovers with a typed error instead
+        # of leaving callers blocked on futures nobody will resolve
+        with self._lock:
+            leftover = self._pending
+            self._pending = []
+        for _, fut in leftover:
+            if not fut.done():
+                fut.set_exception(StreamError(
+                    "dispatcher shut down before the frame was "
+                    "dispatched"))
         # bounded sentinel enqueue: if the completion stage is wedged
         # (hung D2H) its queue may be full — shutdown must still return
         try:
@@ -196,10 +216,13 @@ class MeshDispatcher:
             outs, take, n = item
             try:
                 host = [np.asarray(o) for o in outs]
+                # count BEFORE resolving: a caller that observed its
+                # result (and then read stats()) must see these frames
+                with self._lock:
+                    self.frames += n
+                    self.batches += 1
                 for i, (_, fut) in enumerate(take):
                     fut.set_result(tuple(h[i] for h in host))
-                self.frames += n
-                self.batches += 1
             except Exception as e:
                 for _, fut in take:
                     if not fut.done():

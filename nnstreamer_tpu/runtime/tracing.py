@@ -105,6 +105,9 @@ class NullTracer:
     def record_inflight(self, name, depth, t):
         pass
 
+    def record_shed(self, name, cause, t, **args):
+        pass
+
     def instant(self, name, label, t=None, **args):
         pass
 
@@ -150,6 +153,10 @@ class Tracer:
         self._forced: Dict[str, int] = {}
         # element name -> {"peak": max async in-flight depth sampled}
         self._inflight: Dict[str, Dict[str, int]] = {}
+        # server name -> {cause: count} of admission sheds/rejections
+        # (edge/query.py): kept whole like swaps — per-cause shed
+        # totals must survive ring wrap under sustained overload
+        self._sheds: Dict[str, Dict[str, int]] = {}
 
     # -- scheduler hooks ---------------------------------------------------
     def source_emit(self, name: str, buf, t: float) -> None:
@@ -258,6 +265,23 @@ class Tracer:
     def inflight_gauges(self) -> Dict[str, dict]:
         return {name: dict(g) for name, g in self._inflight.items()}
 
+    def record_shed(self, name: str, cause: str, t: float,
+                    **args) -> None:
+        """One request refused or shed at a query server's admission
+        queue (edge/query.py). `cause` is the admission taxonomy:
+        queue_full / inflight_full / deadline / reject_oldest /
+        dispatch_error / shutdown. Dict writes under the GIL — a lost
+        race between two reader threads costs one count at worst."""
+        c = self._sheds.get(name)
+        if c is None:
+            c = self._sheds[name] = {}
+        c[cause] = c.get(cause, 0) + 1
+        self._append("i", "admission", name, f"shed_{cause}", t, 0.0,
+                     args or None)
+
+    def shed_counts(self) -> Dict[str, Dict[str, int]]:
+        return {name: dict(c) for name, c in self._sheds.items()}
+
     def instant(self, name: str, label: str, t: Optional[float] = None,
                 **args) -> None:
         if t is None:
@@ -337,6 +361,7 @@ class Tracer:
             "llm_requests": len(self._llm_requests),
             "forced_syncs": dict(self._forced),
             "inflight": self.inflight_gauges(),
+            "sheds": self.shed_counts(),
         }
 
     def to_chrome_trace(self, pipeline_name: str = "pipeline") -> dict:

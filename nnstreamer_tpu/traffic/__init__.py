@@ -1,0 +1,35 @@
+"""Production serving edge: admission control + open-loop traffic.
+
+The among-device layer (edge/) gives the wire; this package gives the
+discipline real traffic forces onto it:
+
+- admission.py — bounded admission queue with typed rejection (wire
+  BUSY), shed policies (reject-newest | reject-oldest | deadline-drop),
+  and exhaustive per-cause accounting (nothing is ever silently lost).
+- loadgen.py   — open-loop Poisson / bursty (Markov-modulated on/off)
+  load harness with a latency-SLO report: goodput at a p99 budget,
+  shed rate, queue-depth timeline.
+
+Surfaces: `tensor_query_serversrc` admission properties (max_pending /
+max_inflight / shed_policy), `tensor_query_client` BUSY backpressure
+through the element error-policy machinery, `python -m nnstreamer_tpu
+traffic`, and `bench.py --family traffic`. See docs/traffic.md.
+"""
+
+from nnstreamer_tpu.traffic.admission import (
+    DEADLINE_META, SHED_POLICIES, AdmissionDecision, AdmissionQueue)
+from nnstreamer_tpu.traffic.loadgen import (
+    EchoServer, bursty_arrivals, poisson_arrivals, run_against_echo,
+    run_open_loop)
+
+__all__ = [
+    "AdmissionDecision",
+    "AdmissionQueue",
+    "DEADLINE_META",
+    "SHED_POLICIES",
+    "EchoServer",
+    "bursty_arrivals",
+    "poisson_arrivals",
+    "run_against_echo",
+    "run_open_loop",
+]

@@ -1,0 +1,289 @@
+"""Bounded admission queue — load shedding at the serving edge.
+
+The reference's query server buffers unboundedly and collapses under
+overload (every request eventually times out, goodput → 0). Real
+serving edges shed instead: a bounded queue admits up to `max_pending`
+requests, refuses the rest with a *typed* rejection the client can act
+on (wire `BUSY`, edge/protocol.py), and keeps per-cause counters so the
+operator can see exactly what was shed and why.
+
+Policy knobs:
+
+- ``max_pending``   — bound on queued-but-not-yet-dequeued requests.
+- ``max_inflight``  — bound on total outstanding requests (queued +
+  dequeued-but-not-yet-replied); 0 = unlimited. This caps end-to-end
+  concurrency/memory, not just the queue.
+- ``shed_policy``   — what happens when the queue is full:
+    * ``reject-newest`` (default): refuse the arriving request. FIFO
+      fairness; the cheapest policy (nothing admitted is ever wasted).
+    * ``reject-oldest``: admit the arrival, shed the oldest *queued*
+      request (which has waited longest and is most likely to miss its
+      deadline anyway). The victim still gets a BUSY reply — nothing is
+      ever silently dropped.
+    * ``deadline-drop``: requests carrying a ``meta["deadline_ms"]``
+      budget are purged once the budget expires (measured from arrival,
+      so no cross-host clock agreement is needed); a full queue with no
+      expired entries falls back to reject-newest.
+
+Accounting contract (the conservation invariant tests assert):
+
+    offered  == admitted + sum(rejected.values())
+    admitted == replied + sum(shed.values()) + depth + inflight
+
+``rejected`` counts at-the-door refusals (never entered the queue);
+``shed`` counts post-admission victims (reject-oldest, deadline purge,
+shutdown drain, dispatch errors). Both reach the client as BUSY.
+
+The queue doubles as the serversrc's frame source: ``get()`` is
+``queue.Queue``-compatible (blocking, raises ``queue.Empty`` on
+timeout) so it drops into the existing drain loops, and ``None``
+sentinels pushed via ``put_nowait`` bypass admission entirely (they are
+teardown wakeups, not requests — and must never be lost to a full
+queue).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+SHED_POLICIES = ("reject-newest", "reject-oldest", "deadline-drop")
+
+#: TensorBuffer.meta key: per-request latency budget in ms, measured
+#: from server-side arrival (deadline-drop purges expired entries)
+DEADLINE_META = "deadline_ms"
+
+#: retry-after suggestion before any service-rate estimate exists
+_DEFAULT_RETRY_MS = 50.0
+
+
+@dataclass
+class AdmissionDecision:
+    """Outcome of one `offer()`: admitted or not, why not, and any
+    previously-admitted victims the caller must send BUSY replies for
+    (reject-oldest / deadline purge)."""
+
+    admitted: bool
+    cause: Optional[str] = None          # rejection cause when refused
+    queue_depth: int = 0
+    retry_after_ms: float = _DEFAULT_RETRY_MS
+    victims: List[Any] = field(default_factory=list)
+    victim_cause: Optional[str] = None   # cause for the victims' BUSY
+
+
+class AdmissionQueue:
+    """Bounded request queue with typed rejection (module docstring)."""
+
+    def __init__(self, max_pending: int = 64, max_inflight: int = 0,
+                 shed_policy: str = "reject-newest"):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._q: deque = deque()          # (item, enq_t, expiry_or_None)
+        self.configure(max_pending=max_pending, max_inflight=max_inflight,
+                       shed_policy=shed_policy)
+        self._inflight = 0
+        self._closed = False
+        # counters (all mutated under _lock)
+        self._offered = 0
+        self._admitted = 0
+        self._replied = 0
+        self._rejected: Dict[str, int] = {}
+        self._shed: Dict[str, int] = {}
+        self._depth_peak = 0
+        # EWMA of inter-reply interval → retry-after suggestion
+        self._ewma_reply_s: Optional[float] = None
+        self._last_reply_t: Optional[float] = None
+
+    def configure(self, max_pending: Optional[int] = None,
+                  max_inflight: Optional[int] = None,
+                  shed_policy: Optional[str] = None) -> None:
+        """Re-knob a live queue (serversrc applies its properties at
+        start(); the process-wide QueryServer is created earlier with
+        defaults)."""
+        with self._lock:
+            if max_pending is not None:
+                if max_pending < 1:
+                    raise ValueError(
+                        f"max_pending must be >= 1, got {max_pending}")
+                self.max_pending = max_pending
+            if max_inflight is not None:
+                if max_inflight < 0:
+                    raise ValueError(
+                        f"max_inflight must be >= 0 (0 = unlimited), "
+                        f"got {max_inflight}")
+                self.max_inflight = max_inflight
+            if shed_policy is not None:
+                if shed_policy not in SHED_POLICIES:
+                    raise ValueError(
+                        f"shed_policy must be one of "
+                        f"{' | '.join(SHED_POLICIES)}, got {shed_policy!r}")
+                self.shed_policy = shed_policy
+
+    # -- admission ---------------------------------------------------------
+    def offer(self, item, now: Optional[float] = None) -> AdmissionDecision:
+        """Admit `item` or return a typed refusal. Never blocks."""
+        if now is None:
+            now = time.monotonic()
+        expiry = None
+        meta = getattr(item, "meta", None)
+        if isinstance(meta, dict):
+            budget = meta.get(DEADLINE_META)
+            if isinstance(budget, (int, float)) and budget > 0:
+                expiry = now + float(budget) / 1e3
+        with self._cv:
+            self._offered += 1
+            if self._closed:
+                return self._refuse("shutdown")
+            victims: List[Any] = []
+            victim_cause = None
+            if self.shed_policy == "deadline-drop":
+                victims = self._purge_expired(now)
+                if victims:
+                    victim_cause = "deadline"
+            if self.max_inflight and \
+                    len(self._q) + self._inflight >= self.max_inflight:
+                d = self._refuse("inflight_full")
+                d.victims, d.victim_cause = victims, victim_cause
+                return d
+            if len(self._q) >= self.max_pending:
+                if self.shed_policy == "reject-oldest":
+                    victim, _, _ = self._q.popleft()
+                    victims.append(victim)
+                    victim_cause = "reject_oldest"
+                    self._shed["reject_oldest"] = \
+                        self._shed.get("reject_oldest", 0) + 1
+                else:      # reject-newest, or deadline-drop w/o expiries
+                    d = self._refuse("queue_full")
+                    d.victims, d.victim_cause = victims, victim_cause
+                    return d
+            self._admitted += 1
+            self._q.append((item, now, expiry))
+            if len(self._q) > self._depth_peak:
+                self._depth_peak = len(self._q)
+            self._cv.notify()
+            return AdmissionDecision(
+                admitted=True, queue_depth=len(self._q),
+                retry_after_ms=self._retry_after_locked(),
+                victims=victims, victim_cause=victim_cause)
+
+    def _refuse(self, cause: str) -> AdmissionDecision:
+        self._rejected[cause] = self._rejected.get(cause, 0) + 1
+        return AdmissionDecision(
+            admitted=False, cause=cause, queue_depth=len(self._q),
+            retry_after_ms=self._retry_after_locked())
+
+    def _purge_expired(self, now: float) -> List[Any]:
+        """deadline-drop: shed queued entries whose budget has passed.
+        Expired work is wasted work — purge on every offer, not only
+        when full."""
+        victims = []
+        kept = deque()
+        for item, enq_t, expiry in self._q:
+            if expiry is not None and expiry <= now:
+                victims.append(item)
+            else:
+                kept.append((item, enq_t, expiry))
+        if victims:
+            self._q = kept
+            self._shed["deadline"] = \
+                self._shed.get("deadline", 0) + len(victims)
+        return victims
+
+    def _retry_after_locked(self) -> float:
+        """Suggested client backoff: expected time for the current queue
+        to drain at the EWMA service rate, clamped to [1ms, 10s]."""
+        if self._ewma_reply_s is None:
+            return _DEFAULT_RETRY_MS
+        est = (len(self._q) + 1) * self._ewma_reply_s * 1e3
+        return min(max(est, 1.0), 10_000.0)
+
+    # -- queue.Queue-compatible consumer side ------------------------------
+    def get(self, timeout: Optional[float] = None):
+        """Blocking dequeue; raises `queue.Empty` on timeout (drop-in
+        for the previous `queue.Queue` drain loops). A dequeued request
+        becomes *inflight* until `note_replied`/`note_failed`."""
+        with self._cv:
+            if not self._cv.wait_for(lambda: len(self._q) > 0,
+                                     timeout=timeout):
+                raise _queue.Empty
+            item, _, _ = self._q.popleft()
+            if item is not None:          # None = teardown sentinel
+                self._inflight += 1
+            return item
+
+    def put_nowait(self, item) -> None:
+        """Sentinel bypass: enqueue without admission accounting. Used
+        for `None` teardown wakeups, which must never be refused or lost
+        to a full queue (the seed's `queue.Full` drop left `generate()`
+        blocked forever)."""
+        with self._cv:
+            self._q.append((item, time.monotonic(), None))
+            self._cv.notify()
+
+    # -- completion accounting ---------------------------------------------
+    def note_replied(self) -> None:
+        """One admitted request answered (RESULT sent, or attempted —
+        a vanished client still counts as served)."""
+        now = time.monotonic()
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._replied += 1
+            if self._last_reply_t is not None:
+                dt = now - self._last_reply_t
+                self._ewma_reply_s = dt if self._ewma_reply_s is None \
+                    else 0.8 * self._ewma_reply_s + 0.2 * dt
+            self._last_reply_t = now
+
+    def note_failed(self, cause: str = "dispatch_error") -> None:
+        """One dequeued request failed before a RESULT could be sent —
+        counts as shed so conservation still balances; the caller owes
+        the client a BUSY with the same cause."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._shed[cause] = self._shed.get(cause, 0) + 1
+
+    def shed_remaining(self, cause: str = "shutdown") -> List[Any]:
+        """Drain every queued request (at close): they are shed with
+        `cause`, returned so the caller can send each a BUSY reply, and
+        further offers are refused with the same cause."""
+        with self._cv:
+            self._closed = True
+            victims = [item for item, _, _ in self._q if item is not None]
+            self._q.clear()
+            if victims:
+                self._shed[cause] = \
+                    self._shed.get(cause, 0) + len(victims)
+            self._cv.notify_all()
+            return victims
+
+    def reopen(self) -> None:
+        """Undo `shed_remaining`'s closed latch (tests / restart)."""
+        with self._lock:
+            self._closed = False
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def counters(self) -> Dict[str, Any]:
+        """Consistent snapshot of the accounting state (one lock hold)."""
+        with self._lock:
+            return {
+                "offered": self._offered,
+                "admitted": self._admitted,
+                "replied": self._replied,
+                "rejected": dict(self._rejected),
+                "shed": dict(self._shed),
+                "depth": len(self._q),
+                "inflight": self._inflight,
+                "depth_peak": self._depth_peak,
+                "max_pending": self.max_pending,
+                "max_inflight": self.max_inflight,
+                "shed_policy": self.shed_policy,
+            }

@@ -1,0 +1,379 @@
+"""Open-loop load harness — Poisson/bursty arrivals + latency-SLO report.
+
+Closed-loop load (send, wait, send) lets a slow server throttle its own
+offered load and flatter its tail — the coordinated-omission trap. This
+harness is *open-loop*: arrival times are pre-drawn from the arrival
+process and every request is sent at its scheduled time regardless of
+completions, so overload actually happens and the report shows what the
+server did about it.
+
+Arrival processes:
+
+- ``poisson_arrivals(rate, n)``  — memoryless, the standard serving
+  baseline (exponential inter-arrivals).
+- ``bursty_arrivals(n, ...)``    — Markov-modulated on/off: the source
+  alternates between a high-rate and a low-rate state with
+  exponentially-distributed dwell times. Same mean rate as a Poisson
+  source can carry; the bursts are what break naive admission.
+
+Per-request outcome accounting is exhaustive: every sent request ends
+as *completed* (RESULT received), *rejected* (typed BUSY received), or
+*lost* (neither — a crash or silent drop). A healthy bounded server
+under overload reports nonzero ``rejected`` and ZERO ``lost``; the seed
+behavior (silent queue drop) shows up as ``lost`` > 0.
+
+SLO report fields (``run_open_loop`` return value): see docs/traffic.md.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.traffic.admission import DEADLINE_META
+from nnstreamer_tpu.edge import protocol as P
+from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
+from nnstreamer_tpu.runtime.tracing import percentile
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+log = get_logger("traffic.loadgen")
+
+
+# -- arrival processes -------------------------------------------------------
+
+def poisson_arrivals(rate_hz: float, n: int,
+                     rng: Optional[np.random.Generator] = None
+                     ) -> np.ndarray:
+    """`n` cumulative arrival times (s) of a Poisson process at
+    `rate_hz` requests/s."""
+    if rate_hz <= 0:
+        raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
+    rng = rng or np.random.default_rng(0)
+    return np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+
+
+def bursty_arrivals(n: int, *, rate_high_hz: float, rate_low_hz: float,
+                    mean_dwell_s: float = 0.25,
+                    rng: Optional[np.random.Generator] = None
+                    ) -> np.ndarray:
+    """`n` cumulative arrival times of a Markov-modulated on/off
+    process: exponential dwell (`mean_dwell_s`) in each state, drawing
+    exponential inter-arrivals at that state's rate. Starts in the
+    high-rate state."""
+    if rate_high_hz <= 0 or rate_low_hz <= 0:
+        raise ValueError("both state rates must be > 0")
+    rng = rng or np.random.default_rng(0)
+    out: List[float] = []
+    t = 0.0
+    high = True
+    state_end = float(rng.exponential(mean_dwell_s))
+    while len(out) < n:
+        rate = rate_high_hz if high else rate_low_hz
+        t += float(rng.exponential(1.0 / rate))
+        while t >= state_end:        # dwell expired: flip state
+            high = not high
+            state_end += float(rng.exponential(mean_dwell_s))
+        out.append(t)
+    return np.asarray(out)
+
+
+# -- open-loop runner --------------------------------------------------------
+
+def run_open_loop(host: str, port: int, *, dims: str,
+                  types: str = "float32",
+                  arrivals: np.ndarray,
+                  make_frame: Callable[[int], TensorBuffer],
+                  p99_budget_ms: float = 250.0,
+                  drain_timeout_s: float = 15.0,
+                  hello_timeout_s: float = 10.0,
+                  depth_probe: Optional[Callable[[], int]] = None,
+                  depth_sample_ms: float = 25.0) -> dict:
+    """Drive one live query server open-loop; return the SLO report.
+
+    make_frame(i) builds request i's TensorBuffer (its pts is forced to
+    i — the pts echo is how outcomes are matched). `depth_probe`, when
+    the server is in-process, samples its admission-queue depth on a
+    timeline; remote servers still get depth points from every BUSY
+    payload.
+    """
+    n = len(arrivals)
+    if n == 0:
+        raise ValueError("arrivals is empty")
+    done: Dict[int, float] = {}      # pts -> completion t
+    busy: Dict[int, dict] = {}       # pts -> BUSY payload
+    evt_lock = threading.Lock()
+    all_answered = threading.Event()
+    hello_q: List[tuple] = []
+    hello_evt = threading.Event()
+    timeline: List[List[float]] = []  # [t_rel_s, depth]
+    t0 = [0.0]                        # set when the clock starts
+
+    def on_message(mtype: int, payload: bytes) -> None:
+        now = time.perf_counter()
+        if mtype in (P.T_HELLO_ACK, P.T_HELLO_NAK):
+            hello_q.append((mtype, payload))
+            hello_evt.set()
+            return
+        with evt_lock:
+            if mtype == P.T_RESULT:
+                try:
+                    buf, _ = decode_buffer(payload)
+                except ValueError as e:
+                    log.warning("loadgen: corrupt result dropped: %s", e)
+                    return
+                if buf.pts is not None:
+                    done[int(buf.pts)] = now
+            elif mtype == P.T_BUSY:
+                try:
+                    info = json.loads(payload.decode())
+                except ValueError:
+                    info = {}
+                pts = info.get("pts")
+                if pts is not None:
+                    busy[int(pts)] = info
+                if "queue_depth" in info:
+                    timeline.append([now - t0[0],
+                                     int(info["queue_depth"])])
+            if len(done) + len(busy) >= n:
+                all_answered.set()
+
+    client = P.MsgClient(host, port, on_message=on_message)
+    try:
+        client.send(P.T_HELLO,
+                    json.dumps({"dims": dims, "types": types}).encode())
+        if not hello_evt.wait(hello_timeout_s):
+            raise StreamError(
+                f"loadgen: query server {host}:{port} did not answer the "
+                f"caps handshake within {hello_timeout_s}s")
+        kind, payload = hello_q[0]
+        if kind == P.T_HELLO_NAK:
+            raise StreamError(
+                f"loadgen: server rejected caps: {payload.decode()}")
+
+        # pre-encode every frame: send-time work is one sendall, so the
+        # arrival schedule is honored to sub-ms even at high rates
+        frames = []
+        for i in range(n):
+            buf = make_frame(i)
+            frames.append(encode_buffer(
+                buf.with_tensors(buf.tensors, pts=i)))
+
+        stop_sampler = threading.Event()
+        sampler = None
+        t0[0] = time.perf_counter()
+        if depth_probe is not None:
+            def sample():
+                while not stop_sampler.is_set():
+                    try:
+                        d = int(depth_probe())
+                    except Exception:
+                        break
+                    with evt_lock:
+                        timeline.append(
+                            [time.perf_counter() - t0[0], d])
+                    stop_sampler.wait(depth_sample_ms / 1e3)
+            sampler = threading.Thread(target=sample, daemon=True,
+                                       name="loadgen-depth")
+            sampler.start()
+
+        sent_at: List[float] = []
+        send_errors = 0
+        for i, t_arr in enumerate(arrivals):
+            now = time.perf_counter() - t0[0]
+            if t_arr > now:
+                time.sleep(t_arr - now)
+            sent_at.append(time.perf_counter())
+            try:
+                client.send(P.T_DATA, frames[i])
+            except StreamError:
+                send_errors += 1
+                break                 # connection died: everything after
+                                      # this counts as lost
+        # drain: wait for every sent request to resolve (or time out)
+        all_answered.wait(drain_timeout_s)
+        elapsed = time.perf_counter() - t0[0]
+        stop_sampler.set()
+        if sampler is not None:
+            sampler.join(timeout=2)
+    finally:
+        client.close()
+
+    with evt_lock:
+        n_sent = len(sent_at)
+        lat_ms = sorted((done[i] - sent_at[i]) * 1e3
+                        for i in list(done) if i < n_sent)
+        completed = len(lat_ms)
+        rejected = sum(1 for i in busy if i < n_sent)
+        causes: Dict[str, int] = {}
+        retry_hints = []
+        for i, info in busy.items():
+            if i >= n_sent:
+                continue
+            causes[info.get("cause", "?")] = \
+                causes.get(info.get("cause", "?"), 0) + 1
+            if "retry_after_ms" in info:
+                retry_hints.append(float(info["retry_after_ms"]))
+        tl = sorted(timeline)
+    lost = n_sent - completed - rejected
+    within = sum(1 for v in lat_ms if v <= p99_budget_ms)
+    # offered rate is a property of the SEND window; elapsed also spans
+    # the drain wait, which would understate it for any run that queues
+    send_window = (sent_at[-1] - t0[0]) if sent_at else 0.0
+    report = {
+        "offered": n_sent,
+        "completed": completed,
+        "rejected": rejected,
+        "lost": lost,
+        "send_errors": send_errors,
+        "duration_s": round(elapsed, 3),
+        "offered_rate_rps": round(n_sent / send_window, 2)
+        if send_window else 0.0,
+        "throughput_rps": round(completed / elapsed, 2) if elapsed else 0.0,
+        "goodput_rps": round(within / elapsed, 2) if elapsed else 0.0,
+        "within_budget": within,
+        "p99_budget_ms": p99_budget_ms,
+        "shed_rate": round(rejected / n_sent, 4) if n_sent else 0.0,
+        "busy_causes": causes,
+    }
+    if lat_ms:
+        report["latency_ms"] = {
+            "p50": round(percentile(lat_ms, 50), 2),
+            "p95": round(percentile(lat_ms, 95), 2),
+            "p99": round(percentile(lat_ms, 99), 2),
+            "max": round(lat_ms[-1], 2)}
+    if retry_hints:
+        retry_hints.sort()
+        report["retry_after_ms_p50"] = round(
+            percentile(retry_hints, 50), 1)
+    if tl:
+        # downsample the timeline to <= 200 points, keep the peak honest
+        step = max(1, len(tl) // 200)
+        report["queue_depth_peak"] = max(d for _, d in tl)
+        report["queue_depth_timeline"] = [
+            [round(t, 3), int(d)] for t, d in tl[::step]]
+    return report
+
+
+# -- self-contained server (CLI / bench / tests share it) --------------------
+
+class EchoServer:
+    """A live bounded query server with a known service time: serversrc
+    → custom filter (sleeps `service_ms`, returns its input) →
+    serversink. Capacity is 1000/service_ms rps by construction, which
+    is what lets the harness express load as a multiple of capacity."""
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, *, dims: str = "8:1", types: str = "float32",
+                 service_ms: float = 5.0, max_pending: int = 16,
+                 max_inflight: int = 0,
+                 shed_policy: str = "reject-newest", port: int = 0):
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.backends.custom import register_custom_easy
+
+        with EchoServer._seq_lock:
+            EchoServer._seq += 1
+            self.sid = 9000 + EchoServer._seq
+        self.dims, self.types = dims, types
+        self.service_ms = service_ms
+        model = f"traffic_echo_{self.sid}"
+        delay = service_ms / 1e3
+
+        def serve(ts):
+            if delay > 0:
+                time.sleep(delay)
+            return ts
+
+        register_custom_easy(model, serve)
+        self.pipe = nns.parse_launch(
+            f"tensor_query_serversrc name=src id={self.sid} port={port} "
+            f"dims={dims} types={types} max_pending={max_pending} "
+            f"max_inflight={max_inflight} shed_policy={shed_policy} ! "
+            f"tensor_filter framework=custom model={model} ! "
+            f"tensor_query_serversink id={self.sid}")
+        self.runner = nns.PipelineRunner(self.pipe).start()
+        self.src = self.pipe.get("src")
+        self.port = self.src.port
+
+    @property
+    def capacity_rps(self) -> float:
+        return 1e3 / self.service_ms if self.service_ms > 0 else 1e6
+
+    def admission_counters(self) -> dict:
+        return self.src.admission_counters()
+
+    def depth_probe(self) -> int:
+        from nnstreamer_tpu.edge.query import QueryServer
+
+        return QueryServer.get(self.sid).frames.depth
+
+    def crashed(self) -> bool:
+        return self.runner._error is not None
+
+    def stop(self) -> None:
+        from nnstreamer_tpu.backends.custom import unregister_custom_easy
+
+        try:
+            self.runner.stop()
+        finally:
+            unregister_custom_easy(f"traffic_echo_{self.sid}")
+
+
+def run_against_echo(*, pattern: str = "poisson", load_x: float = 2.0,
+                     n: int = 200, service_ms: float = 5.0,
+                     max_pending: int = 16, max_inflight: int = 0,
+                     shed_policy: str = "reject-newest",
+                     p99_budget_ms: Optional[float] = None,
+                     seed: int = 0) -> dict:
+    """One self-contained harness run: bounded echo server + open-loop
+    load at `load_x` × its capacity. The shape bench/CLI/tests share."""
+    rng = np.random.default_rng(seed)
+    srv = EchoServer(service_ms=service_ms, max_pending=max_pending,
+                     max_inflight=max_inflight, shed_policy=shed_policy)
+    try:
+        rate = load_x * srv.capacity_rps
+        if pattern == "poisson":
+            arrivals = poisson_arrivals(rate, n, rng)
+        elif pattern == "bursty":
+            arrivals = bursty_arrivals(
+                n, rate_high_hz=2 * rate, rate_low_hz=max(rate / 4, 0.5),
+                rng=rng)
+        else:
+            raise ValueError(
+                f"pattern must be poisson|bursty, got {pattern!r}")
+        if p99_budget_ms is None:
+            # budget: full queue's worth of waiting + one service time
+            p99_budget_ms = (max_pending + 2) * service_ms
+        x = np.ones((8, 1), np.float32)
+
+        def make_frame(i):
+            buf = TensorBuffer.of(x, pts=i)
+            if shed_policy == "deadline-drop":
+                # deadline-drop only purges frames that carry a budget;
+                # without this stamp the policy silently degrades to
+                # reject-newest in the harness
+                buf = buf.with_meta(**{DEADLINE_META: p99_budget_ms})
+            return buf
+
+        report = run_open_loop(
+            "127.0.0.1", srv.port, dims=srv.dims, types=srv.types,
+            arrivals=arrivals,
+            make_frame=make_frame,
+            p99_budget_ms=p99_budget_ms,
+            depth_probe=srv.depth_probe)
+        report["pattern"] = pattern
+        report["load_x"] = load_x
+        report["service_ms"] = service_ms
+        report["capacity_rps"] = round(srv.capacity_rps, 1)
+        report["server_crashed"] = srv.crashed()
+        report["admission"] = srv.admission_counters()
+        return report
+    finally:
+        srv.stop()

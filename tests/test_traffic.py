@@ -1,0 +1,387 @@
+"""Serving-edge traffic tests: bounded admission, typed BUSY
+backpressure, shed policies, and the open-loop harness.
+
+The load-bearing invariant throughout is conservation — every offered
+request is exactly one of {replied, rejected, shed, still queued/
+inflight}; nothing is ever silently dropped (ISSUE 8 acceptance)."""
+
+import queue as _queue
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.core.errors import ServerBusyError, StreamError
+from nnstreamer_tpu.edge import QueryServer
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.traffic import (
+    AdmissionQueue, EchoServer, bursty_arrivals, poisson_arrivals,
+    run_against_echo)
+
+
+@pytest.fixture(autouse=True)
+def _clean_servers():
+    yield
+    QueryServer.reset_all()
+
+
+def _conserved(c: dict) -> bool:
+    """Both accounting invariants from the admission contract."""
+    return (c["offered"] == c["admitted"] + sum(c["rejected"].values())
+            and c["admitted"] == c["replied"] + sum(c["shed"].values())
+            + c["depth"] + c["inflight"])
+
+
+# -- AdmissionQueue unit tests (no sockets) ----------------------------------
+
+class TestAdmissionQueue:
+    def test_reject_newest_bounds_queue(self):
+        q = AdmissionQueue(max_pending=3)
+        for i in range(3):
+            assert q.offer(i).admitted
+        d = q.offer(99)
+        assert not d.admitted and d.cause == "queue_full"
+        assert d.queue_depth == 3 and d.retry_after_ms > 0
+        c = q.counters()
+        assert c["offered"] == 4 and c["admitted"] == 3
+        assert c["rejected"] == {"queue_full": 1}
+        assert c["depth_peak"] == 3 and _conserved(c)
+
+    def test_reject_oldest_sheds_victim_still_admits(self):
+        q = AdmissionQueue(max_pending=2, shed_policy="reject-oldest")
+        q.offer("a"), q.offer("b")
+        d = q.offer("c")
+        assert d.admitted
+        assert d.victims == ["a"] and d.victim_cause == "reject_oldest"
+        c = q.counters()
+        assert c["shed"] == {"reject_oldest": 1} and c["depth"] == 2
+        # FIFO order after the shed: b then c
+        assert q.get(timeout=1) == "b" and q.get(timeout=1) == "c"
+        q.note_replied(), q.note_replied()
+        assert _conserved(q.counters())
+
+    def test_deadline_drop_purges_expired(self):
+        q = AdmissionQueue(max_pending=8, shed_policy="deadline-drop")
+        rushed = SimpleNamespace(meta={"deadline_ms": 5})
+        d = q.offer(rushed, now=100.0)
+        assert d.admitted
+        # 200ms later its 5ms budget is long gone: the next offer purges
+        d = q.offer(SimpleNamespace(meta={}), now=100.2)
+        assert d.admitted
+        assert d.victims == [rushed] and d.victim_cause == "deadline"
+        c = q.counters()
+        assert c["shed"] == {"deadline": 1} and c["depth"] == 1
+        assert _conserved(c)
+
+    def test_deadline_drop_full_without_expiries_rejects_newest(self):
+        q = AdmissionQueue(max_pending=1, shed_policy="deadline-drop")
+        assert q.offer(SimpleNamespace(meta={}), now=1.0).admitted
+        d = q.offer(SimpleNamespace(meta={}), now=1.001)
+        assert not d.admitted and d.cause == "queue_full"
+
+    def test_inflight_bound_counts_dequeued_work(self):
+        q = AdmissionQueue(max_pending=10, max_inflight=2)
+        assert q.offer("a").admitted and q.offer("b").admitted
+        assert q.offer("c").cause == "inflight_full"
+        q.get(timeout=1)                     # a queued->inflight
+        assert q.offer("c").cause == "inflight_full"   # still 2 total
+        q.note_replied()                     # a done
+        assert q.offer("c").admitted
+        assert _conserved(q.counters())
+
+    def test_note_failed_counts_as_shed(self):
+        q = AdmissionQueue(max_pending=4)
+        q.offer("a")
+        q.get(timeout=1)
+        q.note_failed("dispatch_error")
+        c = q.counters()
+        assert c["shed"] == {"dispatch_error": 1}
+        assert c["inflight"] == 0 and _conserved(c)
+
+    def test_sentinel_bypasses_admission(self):
+        q = AdmissionQueue(max_pending=1)
+        assert q.offer("real").admitted
+        q.put_nowait(None)                   # full queue must not refuse
+        assert q.get(timeout=1) == "real"
+        assert q.get(timeout=1) is None
+        c = q.counters()
+        assert c["offered"] == 1             # sentinel never counted
+        assert c["inflight"] == 1            # only the real item
+
+    def test_get_timeout_raises_queue_empty(self):
+        with pytest.raises(_queue.Empty):
+            AdmissionQueue().get(timeout=0.05)
+
+    def test_shed_remaining_closes_then_reopen(self):
+        q = AdmissionQueue(max_pending=8)
+        q.offer("a"), q.offer("b")
+        assert q.shed_remaining() == ["a", "b"]
+        d = q.offer("c")
+        assert not d.admitted and d.cause == "shutdown"
+        c = q.counters()
+        assert c["shed"] == {"shutdown": 2}
+        assert c["rejected"] == {"shutdown": 1} and _conserved(c)
+        q.reopen()
+        assert q.offer("c").admitted
+
+    def test_configure_validates(self):
+        q = AdmissionQueue()
+        with pytest.raises(ValueError, match="max_pending"):
+            q.configure(max_pending=0)
+        with pytest.raises(ValueError, match="max_inflight"):
+            q.configure(max_inflight=-1)
+        with pytest.raises(ValueError, match="shed_policy"):
+            q.configure(shed_policy="drop-table")
+
+    def test_retry_after_tracks_service_rate(self):
+        q = AdmissionQueue(max_pending=4)
+        assert q.offer(0).retry_after_ms == 50.0   # no estimate yet
+        for _ in range(3):
+            q.get(timeout=1)
+            q.note_replied()
+            q.offer(0)
+        # EWMA exists now: suggestion scales with queue depth, clamped
+        d = q.offer(1)
+        assert 1.0 <= d.retry_after_ms <= 10_000.0
+
+
+# -- arrival processes -------------------------------------------------------
+
+class TestArrivals:
+    def test_poisson_deterministic_and_on_rate(self):
+        a = poisson_arrivals(100.0, 400, np.random.default_rng(7))
+        b = poisson_arrivals(100.0, 400, np.random.default_rng(7))
+        np.testing.assert_array_equal(a, b)
+        assert np.all(np.diff(a) >= 0) and a[0] >= 0
+        # 400 samples at 100 rps: mean inter-arrival 10ms +/- 30%
+        assert 0.007 < np.mean(np.diff(a)) < 0.013
+
+    def test_bursty_alternates_phases(self):
+        a = bursty_arrivals(500, rate_high_hz=500.0, rate_low_hz=10.0,
+                            mean_dwell_s=0.05,
+                            rng=np.random.default_rng(3))
+        b = bursty_arrivals(500, rate_high_hz=500.0, rate_low_hz=10.0,
+                            mean_dwell_s=0.05,
+                            rng=np.random.default_rng(3))
+        np.testing.assert_array_equal(a, b)
+        assert np.all(np.diff(a) >= 0)
+        gaps = np.diff(a)
+        # both phases visible: some burst-rate gaps, some trough gaps
+        assert np.min(gaps) < 1 / 100.0 < np.max(gaps)
+
+
+# -- flood the real server (the ISSUE acceptance scenario) -------------------
+
+class TestFlood:
+    def test_overload_sheds_typed_and_loses_nothing(self):
+        r = run_against_echo(pattern="poisson", load_x=2.0, n=60,
+                             service_ms=5.0, max_pending=4, seed=7)
+        assert r["rejected"] > 0, "2x overload must shed"
+        assert r["lost"] == 0, "every request replied or typed-rejected"
+        assert not r["server_crashed"]
+        assert r["busy_causes"].get("queue_full", 0) > 0
+        adm = r["admission"]
+        assert adm["max_pending"] == 4          # knob reached the queue
+        assert adm["offered"] == r["offered"]
+        assert _conserved(adm)
+        assert r["queue_depth_peak"] <= 4
+
+    def test_deadline_drop_purges_live(self):
+        # a 20ms budget against 5ms service + overload: queued frames
+        # expire and are shed with the deadline cause, never lost
+        r = run_against_echo(pattern="poisson", load_x=2.5, n=60,
+                             service_ms=5.0, max_pending=8,
+                             shed_policy="deadline-drop",
+                             p99_budget_ms=20.0, seed=5)
+        assert r["busy_causes"].get("deadline", 0) > 0
+        assert r["lost"] == 0 and _conserved(r["admission"])
+
+    def test_below_knee_sheds_nothing(self):
+        r = run_against_echo(pattern="poisson", load_x=0.4, n=40,
+                             service_ms=5.0, max_pending=8, seed=7)
+        assert r["rejected"] == 0 and r["lost"] == 0
+        assert r["completed"] == 40
+
+
+# -- client backpressure through the error-policy machinery ------------------
+
+def _client_pipe(port, policy, n, max_in_flight=2, timeout=30):
+    extra = f"error_policy={policy} " if policy else ""
+    pipe = nns.parse_launch(
+        f"appsrc name=src dims=8:1 types=float32 ! "
+        f"tensor_query_client name=qc port={port} timeout={timeout} "
+        f"max_in_flight={max_in_flight} {extra}! tensor_sink name=sink")
+    rn = nns.PipelineRunner(pipe).start()
+    for i in range(n):
+        pipe.get("src").push(
+            TensorBuffer.of(np.full((8, 1), float(i), np.float32), pts=i))
+    pipe.get("src").end()
+    return pipe, rn
+
+
+class TestClientBackpressure:
+    def test_retry_policy_recovers_every_frame(self):
+        # max_inflight=1: any frame offered while another is queued or
+        # in service is refused, so a 2-deep client window guarantees
+        # rejections — retry must still deliver all frames, in order
+        srv = EchoServer(service_ms=40.0, max_pending=16, max_inflight=1)
+        try:
+            pipe, rn = _client_pipe(srv.port, "retry:10:30", n=6)
+            rn.wait(60)
+            st = rn.stats()
+            rn.stop()
+            res = pipe.get("sink").results
+            assert [r.pts for r in res] == list(range(6))
+            # the test is vacuous unless BUSY actually happened
+            assert st["qc"]["query_busy"] >= 1
+            assert st["qc"]["retries"] >= 1
+            assert not srv.crashed()
+        finally:
+            srv.stop()
+
+    def test_skip_policy_sheds_client_side(self):
+        srv = EchoServer(service_ms=30.0, max_pending=16, max_inflight=1)
+        try:
+            pipe, rn = _client_pipe(srv.port, "skip", n=8)
+            rn.wait(60)                      # no error: skip absorbs
+            st = rn.stats()
+            rn.stop()
+            res = pipe.get("sink").results
+            assert 1 <= len(res) < 8         # some delivered, some shed
+            assert st["qc"]["query_busy"] >= 1
+            pts = [r.pts for r in res]
+            assert pts == sorted(pts)        # gaps allowed, reorder not
+        finally:
+            srv.stop()
+
+    def test_fail_fast_surfaces_typed_busy(self):
+        srv = EchoServer(service_ms=50.0, max_pending=16, max_inflight=1)
+        try:
+            pipe, rn = _client_pipe(srv.port, None, n=4)
+            with pytest.raises(StreamError, match="rejected frame"):
+                rn.wait(30)
+            assert isinstance(rn._error, ServerBusyError)
+            assert rn._error.cause == "inflight_full"
+            rn.stop()
+        finally:
+            srv.stop()
+
+
+# -- BatchedQueryServer shutdown race + stats snapshot -----------------------
+
+class TestBatchedShutdown:
+    def _server(self, **kw):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.backends.xla import ModelBundle
+        from nnstreamer_tpu.edge import BatchedQueryServer
+        from nnstreamer_tpu.tensor.dtypes import DType
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+        QueryServer.reset_all()
+        w = jnp.arange(12, dtype=jnp.float32).reshape(4, 3)
+        bundle = ModelBundle(
+            fn=lambda p, x: (x @ p["w"],),
+            params={"w": w},
+            in_spec=TensorsSpec.of(TensorInfo((1, 4), DType.FLOAT32)),
+            out_spec=TensorsSpec.of(TensorInfo((1, 3), DType.FLOAT32)),
+            name="linear")
+        return BatchedQueryServer(bundle, sid=33, port=0, **kw)
+
+    def test_close_mid_stream_answers_or_sheds_every_frame(self):
+        """The PR-7 race: close() while frames are queued must neither
+        hang a client nor silently drop — each in-flight frame ends as
+        RESULT or typed BUSY, and close() returns promptly."""
+        import nnstreamer_tpu.edge.protocol as P
+        from nnstreamer_tpu.edge.wire import encode_buffer
+
+        srv = self._server(bucket=4, max_delay_ms=50.0)
+        done = threading.Event()
+        got = {"result": 0, "busy": 0}
+        n_sent = 12
+
+        def on_msg(mtype, payload):
+            if mtype == P.T_RESULT:
+                got["result"] += 1
+            elif mtype == P.T_BUSY:
+                got["busy"] += 1
+            if got["result"] + got["busy"] >= n_sent:
+                done.set()
+
+        cli = P.MsgClient("127.0.0.1", srv.port, on_message=on_msg)
+        try:
+            cli.send(P.T_HELLO, b'{"dims": "1:4", "types": "float32"}')
+            time.sleep(0.3)                  # let the ACK land
+            x = np.ones((1, 4), np.float32)
+            for i in range(n_sent):
+                cli.send(P.T_DATA, encode_buffer(
+                    TensorBuffer.of(x, pts=i)))
+            t0 = time.monotonic()
+            srv.close()                      # race: frames still queued
+            assert time.monotonic() - t0 < 15
+            assert done.wait(10), (
+                f"lost frames: {got} of {n_sent} answered")
+            assert got["result"] + got["busy"] == n_sent
+            st = srv.stats()
+            assert st["admitted"] == st["replied"] + st["shed"]
+        finally:
+            cli.close()
+
+    def test_stats_snapshot_is_thread_safe_under_load(self):
+        import nnstreamer_tpu as nns
+
+        srv = self._server(bucket=4, max_delay_ms=5.0)
+        errs = []
+
+        def poll():
+            for _ in range(200):
+                st = srv.stats()
+                if not {"frames", "batches", "admitted",
+                        "replied"} <= set(st):
+                    errs.append(f"missing keys: {sorted(st)}")
+                    return
+        try:
+            poller = threading.Thread(target=poll)
+            poller.start()
+            pipe = nns.parse_launch(
+                f"appsrc name=src dims=4:1 types=float32 ! "
+                f"tensor_query_client port={srv.port} timeout=30 "
+                f"max_in_flight=4 ! tensor_sink name=sink")
+            rn = nns.PipelineRunner(pipe).start()
+            for i in range(16):
+                pipe.get("src").push(TensorBuffer.of(
+                    np.ones((1, 4), np.float32), pts=i))
+            pipe.get("src").end()
+            rn.wait(30)
+            rn.stop()
+            poller.join(10)
+            assert not errs, errs[0]
+            assert len(pipe.get("sink").results) == 16
+        finally:
+            srv.close()
+
+
+# -- observability ------------------------------------------------------------
+
+class TestShedObservability:
+    def test_tracer_counts_sheds_across_ring_wrap(self):
+        from nnstreamer_tpu.runtime.tracing import Tracer
+
+        tr = Tracer(max_events=4)            # tiny ring: force wrap
+        for i in range(10):
+            tr.record_shed("query_server_5", "queue_full",
+                           float(i), pts=i)
+        tr.record_shed("query_server_5", "shutdown", 11.0)
+        counts = tr.shed_counts()
+        assert counts["query_server_5"] == {"queue_full": 10,
+                                            "shutdown": 1}
+        assert tr.summary()["sheds"] == counts
+
+    def test_serversrc_extra_stats_surface_admission(self):
+        r = run_against_echo(pattern="poisson", load_x=2.0, n=40,
+                             service_ms=5.0, max_pending=4, seed=3)
+        adm = r["admission"]
+        assert adm["rejected"].get("queue_full", 0) == r["rejected"]
