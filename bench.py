@@ -1753,6 +1753,39 @@ def traffic_serve() -> dict:
         out["shed_gate_ok"] = below_knee_shed == 0
         if not out["shed_gate_ok"]:
             out["unverified"] = True
+    # worker-kill acceptance point: a 2-worker pool at 1.5x its
+    # aggregate capacity takes a SIGKILL mid-flood. Gate: zero lost
+    # frames (every one replied or typed-BUSY), conservation exact,
+    # back at full capacity within the restart budget, zero orphan
+    # processes, and pool goodput at the 90ms p99 budget >= a
+    # single-process server facing the same absolute offered rate
+    # (for which that rate is 3x capacity)
+    from nnstreamer_tpu.traffic import run_against_pool
+
+    pool_ms = 20.0
+    kill = run_against_pool(
+        pattern="poisson", load_x=1.5, n=240, service_ms=pool_ms,
+        workers=2, max_pending=32, p99_budget_ms=90.0, seed=42,
+        kills=1)
+    pt = _traffic_point(kill)
+    pt.update({k: kill[k] for k in (
+        "recovered", "recovery_s", "conserved", "kill_schedule",
+        "seed")})
+    pt["orphans"] = len(kill["orphans"])
+    pt["restarts"] = kill["pool"]["pool"]["restarts"]
+    out["worker_kill_x1.5"] = pt
+    _family_partial(dict(out))
+    single = run_against_echo(
+        pattern="poisson", load_x=3.0, n=240, service_ms=pool_ms,
+        max_pending=32, p99_budget_ms=90.0, seed=42)
+    out["single_proc_same_rate"] = _traffic_point(single)
+    out["kill_goodput_win"] = (
+        pt["goodput_rps"] >=
+        out["single_proc_same_rate"]["goodput_rps"])
+    if not (kill["lost"] == 0 and kill["recovered"]
+            and kill["conserved"] and not kill["orphans"]
+            and out["kill_goodput_win"]):
+        out["unverified"] = True   # ship the numbers, flag the claim
     return out
 
 

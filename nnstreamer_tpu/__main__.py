@@ -15,6 +15,11 @@
                                                        #  LLM serving demo
     python -m nnstreamer_tpu traffic --load-x 2        # open-loop overload
                                                        #  harness + SLO report
+    python -m nnstreamer_tpu traffic --workers 2 --kill-at 1
+                                                       # chaos-kill a pool
+                                                       #  worker mid-flood
+    python -m nnstreamer_tpu serve --workers 4         # supervised worker
+                                                       #  pool (SIGTERM drains)
 """
 
 from __future__ import annotations
@@ -220,6 +225,74 @@ def _llm_main(argv) -> int:
     return 0
 
 
+def _serve_main(argv) -> int:
+    """`serve` subcommand: run a supervised multi-process worker pool
+    behind a query server until SIGTERM/SIGINT, then drain gracefully
+    (serving/pool.py, docs/robustness.md). Each worker runs one copy of
+    --pipeline (a mid-pipeline description, e.g. 'tensor_filter
+    framework=xla model=store://m'); without --pipeline the workers
+    echo after --service-ms, which gives a known-capacity pool for
+    drills and demos."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu serve",
+        description="supervised multi-process serving pool "
+                    "(docs/robustness.md)")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="worker processes (pipeline copies)")
+    ap.add_argument("--pipeline", default=None,
+                    help="mid-pipeline each worker runs between appsrc "
+                         "and tensor_sink (default: echo)")
+    ap.add_argument("--dims", default="8:1",
+                    help="accepted input dims (HELLO contract)")
+    ap.add_argument("--types", default="float32")
+    ap.add_argument("--service-ms", type=float, default=5.0,
+                    help="echo mode per-frame service time")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 picks a free port (printed at startup)")
+    ap.add_argument("--id", type=int, default=0, help="server pair id")
+    ap.add_argument("--max-pending", type=int, default=64)
+    ap.add_argument("--max-inflight", type=int, default=0)
+    ap.add_argument("--shed-policy", default="reject-newest",
+                    choices=("reject-newest", "reject-oldest",
+                             "deadline-drop"))
+    ap.add_argument("--stats-every", type=float, default=0.0,
+                    help="print pool stats JSON every N seconds")
+    args = ap.parse_args(argv)
+
+    from nnstreamer_tpu.serving.pool import PooledQueryServer
+    from nnstreamer_tpu.serving.worker import WorkerSpec
+
+    if args.pipeline:
+        spec = WorkerSpec(kind="pipeline", pipeline=args.pipeline,
+                          dims=args.dims, types=args.types)
+    else:
+        spec = WorkerSpec(kind="echo", service_ms=args.service_ms,
+                          dims=args.dims, types=args.types)
+    pqs = PooledQueryServer(
+        spec, workers=args.workers, sid=args.id, host=args.host,
+        port=args.port, max_pending=args.max_pending,
+        max_inflight=args.max_inflight, shed_policy=args.shed_policy)
+    pqs.install_signal_handlers()
+    print(f"pool serving on {args.host}:{pqs.port} "
+          f"({args.workers} worker(s); SIGTERM/^C drains)",
+          file=sys.stderr)
+    last_stats = time.monotonic()
+    try:
+        while not pqs.pool.closed:
+            time.sleep(0.2)
+            if args.stats_every and \
+                    time.monotonic() - last_stats >= args.stats_every:
+                last_stats = time.monotonic()
+                print(json.dumps(pqs.stats(), default=float),
+                      file=sys.stderr)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        pqs.close()
+    return 0
+
+
 def _traffic_main(argv) -> int:
     """`traffic` subcommand: open-loop load against a bounded query
     server (a self-contained echo server by default, or --host/--port
@@ -253,7 +326,20 @@ def _traffic_main(argv) -> int:
     ap.add_argument("--types", default="float32")
     ap.add_argument("--rate", type=float, default=100.0,
                     help="absolute offered rps in --host mode")
-    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="rng seed for the arrival process AND the "
+                         "chaos-kill schedule (reproducible runs; the "
+                         "report records it)")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="serve from a supervised worker POOL of N "
+                         "processes instead of the in-process echo "
+                         "server (enables --kill-at chaos mode)")
+    ap.add_argument("--kill-at", type=float, default=None,
+                    help="SIGKILL one rng-chosen pool worker at t "
+                         "seconds into the send window (default: the "
+                         "median arrival; needs --workers)")
+    ap.add_argument("--kills", type=int, default=1,
+                    help="number of staggered worker kills (--workers)")
     ap.add_argument("--json", action="store_true",
                     help="print the raw report JSON only")
     args = ap.parse_args(argv)
@@ -262,9 +348,17 @@ def _traffic_main(argv) -> int:
 
     from nnstreamer_tpu.traffic import (
         bursty_arrivals, poisson_arrivals, run_against_echo,
-        run_open_loop)
+        run_against_pool, run_open_loop)
 
-    if args.host is not None:
+    if args.workers > 0:
+        report = run_against_pool(
+            pattern=args.pattern, load_x=args.load_x, n=args.requests,
+            service_ms=args.service_ms, workers=args.workers,
+            max_pending=args.max_pending, max_inflight=args.max_inflight,
+            shed_policy=args.shed_policy,
+            p99_budget_ms=args.budget_ms or 90.0, seed=args.seed,
+            kill_at_s=args.kill_at, kills=args.kills)
+    elif args.host is not None:
         if args.port is None:
             print("--host needs --port", file=sys.stderr)
             return 2
@@ -285,6 +379,7 @@ def _traffic_main(argv) -> int:
             arrivals=arrivals,
             make_frame=lambda i: TensorBuffer.of(x, pts=i),
             p99_budget_ms=args.budget_ms or 250.0)
+        report["seed"] = args.seed
     else:
         report = run_against_echo(
             pattern=args.pattern, load_x=args.load_x, n=args.requests,
@@ -317,6 +412,8 @@ def main(argv=None) -> int:
         return _llm_main(argv[1:])
     if argv and argv[0] == "traffic":
         return _traffic_main(argv[1:])
+    if argv and argv[0] == "serve":
+        return _serve_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu",
         description="TPU-native streaming AI pipelines (gst-launch parity)")

@@ -11,8 +11,32 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 
+def _rebuild_error(cls, args, state):
+    """Pickle reconstructor (see NNStreamerTPUError.__reduce__):
+    rebuilds without calling the subclass __init__, then restores args
+    and instance state verbatim."""
+    exc = cls.__new__(cls)
+    Exception.__init__(exc, *args)
+    exc.__dict__.update(state)
+    return exc
+
+
 class NNStreamerTPUError(Exception):
-    """Base class for all framework errors."""
+    """Base class for all framework errors.
+
+    Every framework error is pickle-round-trip safe: errors cross
+    process boundaries in the supervised worker pool (serving/pool.py
+    ships them back over a multiprocessing pipe). Subclasses with
+    non-default ``__init__`` signatures (`SegmentStageError`,
+    `ServerBusyError`) would break naive pickling — which re-invokes
+    ``cls(*args)`` — so the base class reduces to a reconstructor that
+    bypasses ``__init__`` and restores ``args`` + ``__dict__`` exactly
+    (tests/test_faults.py parametrizes the round trip over every
+    public error class)."""
+
+    def __reduce__(self):
+        return (_rebuild_error,
+                (type(self), self.args, dict(self.__dict__)))
 
 
 class ConfigError(NNStreamerTPUError):

@@ -65,6 +65,9 @@ class QueryServer:
         self.frames: AdmissionQueue = AdmissionQueue(max_pending=64)
         self.tracer = NULL_TRACER
         self.started = threading.Event()
+        # set by serving/pool.py when a WorkerPool services this id;
+        # serversrc extra_stats folds the pool's per-worker view in
+        self.pool = None
 
     @classmethod
     def get(cls, sid: int) -> "QueryServer":
@@ -347,6 +350,9 @@ class TensorQueryServerSrc(SourceElement):
             out[f"rejected_{cause}"] = v
         for cause, v in c["shed"].items():
             out[f"shed_{cause}"] = v
+        srv = self._srv or QueryServer.get(self.props["id"])
+        if srv.pool is not None:
+            out.update(srv.pool.extra_stats())
         return out
 
 
@@ -718,6 +724,8 @@ class BatchedQueryServer:
                                  shed_policy=shed_policy)
         self.qs.start(host, port)
         self._stop = threading.Event()
+        self._closed = False
+        self._close_lock = threading.Lock()
         self.error: Optional[Exception] = None
         # exactly ONE drainer: a second thread could swap the order of a
         # client's consecutive frames between queue-get and submit,
@@ -797,7 +805,14 @@ class BatchedQueryServer:
            done callbacks still reply: the transport is up) and fails
            any never-dispatched future with a typed StreamError;
         4. drop the transport.
+
+        Idempotent: a supervisor drain racing a user close() is a
+        no-op, not a double-join/double-shed.
         """
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop.set()
         for t in self._drainers:
             t.join(timeout=5)

@@ -68,6 +68,7 @@ class MeshDispatcher:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        self._shutdown_done = False
         self._thread = threading.Thread(target=self._loop,
                                         name="mesh-dispatch", daemon=True)
         self._thread.start()
@@ -111,7 +112,12 @@ class MeshDispatcher:
             return {"frames": self.frames, "batches": self.batches}
 
     def shutdown(self) -> None:
+        # idempotent: a second shutdown (supervisor drain racing a user
+        # close) must not double-join or enqueue a second sentinel
         with self._lock:
+            if self._shutdown_done:
+                return
+            self._shutdown_done = True
             self._stop = True
         self._wake.set()
         self._thread.join(timeout=30)

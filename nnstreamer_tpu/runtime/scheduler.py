@@ -276,6 +276,11 @@ class PipelineRunner:
         self._wd_warned_proc: Dict[str, float] = {}
         self._wd_q_full_since: Dict[str, float] = {}
         self._wd_warned_q: Dict[str, float] = {}
+        # admission-queue incidents (serversrc): name -> (since,
+        # replied-at-arm) / name -> since-warned; same prune-on-recovery
+        # discipline as the other _wd_* dicts
+        self._wd_adm_since: Dict[str, tuple] = {}
+        self._wd_warned_adm: Dict[str, float] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "PipelineRunner":
@@ -942,6 +947,60 @@ class PipelineRunner:
                     f"input queue of element {name} stayed at "
                     f"capacity ({self._cap}) for {full_for:.2f}s "
                     f"(budget {q_budget:.2f}s)"))
+                return True
+        # wedged admission: a serversrc whose admission queue sits
+        # pinned at max_pending with ZERO replies for the queue stall
+        # budget. Depth alone is healthy under overload (BUSY at the
+        # door is the design); depth pinned AND no reply progress means
+        # the service plane behind the queue is gone while clients
+        # still burn their timeouts — exactly what a supervisor must
+        # hear about before the retries pile up.
+        adm_since = self._wd_adm_since
+        warned_adm = self._wd_warned_adm
+        for name, elem in list(self.pipeline.elements.items()):
+            probe = getattr(elem, "admission_counters", None)
+            if probe is None:
+                continue
+            try:
+                c = probe()
+            except Exception:
+                continue
+            pinned = c["depth"] >= c["max_pending"]
+            if not pinned:
+                adm_since.pop(name, None)
+                warned_adm.pop(name, None)
+                continue
+            since, replied0 = adm_since.setdefault(
+                name, (now, c["replied"]))
+            if c["replied"] != replied0:
+                # progress: re-arm the incident at the new reply count
+                adm_since[name] = (now, c["replied"])
+                warned_adm.pop(name, None)
+                continue
+            wedged_for = now - since
+            if wedged_for <= q_budget or warned_adm.get(name) == since:
+                continue
+            warned_adm[name] = since
+            stats = self._stats.get(name)
+            if stats is not None:
+                stats.watchdog_warnings += 1
+            log.warning(
+                "watchdog: admission queue of %s wedged — depth pinned "
+                "at max_pending (%d) with zero replies for %.2fs "
+                "(budget %.2fs); the service plane is not draining",
+                name, c["max_pending"], wedged_for, q_budget)
+            if tr.active:
+                tr.record_watchdog(
+                    name, "wedged-admission", time.perf_counter(),
+                    wedged_for_s=round(wedged_for, 3),
+                    budget_s=q_budget, max_pending=c["max_pending"],
+                    replied=c["replied"])
+            if self._watchdog_action == "fail":
+                self._fail(elem, WatchdogStall(
+                    f"wedged-admission: admission queue of {name} "
+                    f"stayed pinned at max_pending "
+                    f"({c['max_pending']}) with zero replies for "
+                    f"{wedged_for:.2f}s (budget {q_budget:.2f}s)"))
                 return True
         return False
 

@@ -108,6 +108,9 @@ class NullTracer:
     def record_shed(self, name, cause, t, **args):
         pass
 
+    def record_worker_event(self, name, wid, kind, t, **args):
+        pass
+
     def instant(self, name, label, t=None, **args):
         pass
 
@@ -157,6 +160,10 @@ class Tracer:
         # (edge/query.py): kept whole like swaps — per-cause shed
         # totals must survive ring wrap under sustained overload
         self._sheds: Dict[str, Dict[str, int]] = {}
+        # worker-pool lifecycle events (serving/pool.py): kept whole —
+        # a post-mortem needs the full spawn/kill/restart/degraded
+        # sequence even after a chaos run wraps the ring
+        self._worker_events: List[Tuple[str, int, str, float, dict]] = []
 
     # -- scheduler hooks ---------------------------------------------------
     def source_emit(self, name: str, buf, t: float) -> None:
@@ -282,6 +289,28 @@ class Tracer:
     def shed_counts(self) -> Dict[str, Dict[str, int]]:
         return {name: dict(c) for name, c in self._sheds.items()}
 
+    def record_worker_event(self, name: str, wid: int, kind: str,
+                            t: float, **args) -> None:
+        """One worker-pool lifecycle event (serving/pool.py). `kind` is
+        the supervision taxonomy: spawn / ready / kill / exit / restart
+        / reoffer / degraded / swap_commit / swap_abort / drain_stop.
+        wid is the pool slot (-1 for pool-level events like swaps)."""
+        self._worker_events.append((name, wid, kind, t, dict(args)))
+        self._append("i", "worker", f"{name}/w{wid}", f"worker_{kind}",
+                     t, 0.0, args or None)
+
+    def worker_events(self) -> List[Tuple[str, int, str, float, dict]]:
+        return list(self._worker_events)
+
+    def worker_counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-pool event-kind totals (the summary() view; the full
+        ordered sequence is worker_events())."""
+        out: Dict[str, Dict[str, int]] = {}
+        for name, _wid, kind, _t, _args in self._worker_events:
+            c = out.setdefault(name, {})
+            c[kind] = c.get(kind, 0) + 1
+        return out
+
     def instant(self, name: str, label: str, t: Optional[float] = None,
                 **args) -> None:
         if t is None:
@@ -362,6 +391,7 @@ class Tracer:
             "forced_syncs": dict(self._forced),
             "inflight": self.inflight_gauges(),
             "sheds": self.shed_counts(),
+            "workers": self.worker_counts(),
         }
 
     def to_chrome_trace(self, pipeline_name: str = "pipeline") -> dict:

@@ -14,6 +14,11 @@ Models become live, versioned pipeline citizens (docs/serving.md):
   new one, and the old version's compiled buckets are retired.
 - A persistent compile cache (``[serving]`` config group) plus a
   store-level bucket manifest lets restarted processes start warm.
+- A supervised multi-process worker pool (pool.py / worker.py) runs N
+  pipeline copies in child processes behind one query server: crash
+  isolation, heartbeat + frame-deadline liveness, backoff restart with
+  a restart-budget circuit, conservation-exact `worker_lost`
+  accounting, and graceful drain (docs/robustness.md).
 """
 from nnstreamer_tpu.serving.store import (  # noqa: F401
     ModelStore,
@@ -22,3 +27,17 @@ from nnstreamer_tpu.serving.store import (  # noqa: F401
     parse_store_ref,
     reset_store,
 )
+
+
+def __getattr__(name):
+    # pool/worker are lazy: importing the store must not pull in the
+    # multiprocessing machinery (children import this package too)
+    if name in ("WorkerPool", "PooledQueryServer", "proc_alive"):
+        from nnstreamer_tpu.serving import pool as _pool
+
+        return getattr(_pool, name)
+    if name == "WorkerSpec":
+        from nnstreamer_tpu.serving.worker import WorkerSpec
+
+        return WorkerSpec
+    raise AttributeError(name)

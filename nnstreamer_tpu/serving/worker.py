@@ -1,0 +1,335 @@
+"""Pool worker — one pipeline copy in a child process.
+
+This is the child half of the supervised worker pool (serving/pool.py):
+`worker_main` runs inside a spawned process, receives frames from the
+supervisor over a multiprocessing duplex pipe, services them, and sends
+results back. Everything that crosses the pipe is a small tagged tuple;
+tensor payloads travel as wire-frame bytes (edge/wire.py) so the child
+never needs the parent's negotiation context.
+
+Parent -> child messages::
+
+    ("req",  rid, payload)            one frame to service
+    ("swap", phase, name, version)    two-phase model hot swap
+                                      (phase: prepare | commit | abort)
+    ("stop",)                         graceful stop (drain then exit 0)
+
+Child -> parent messages::
+
+    ("ready", info)                   setup done; info carries pid and,
+                                      in pipeline mode, the negotiated
+                                      output spec strings
+    ("hb", seq, t_mono)               heartbeat (dedicated thread, so a
+                                      GIL-bound service loop still beats;
+                                      only a truly wedged process stops)
+    ("res", rid, payload)             one serviced frame
+    ("err", rid, pickled_exc)         one frame failed (request-scoped)
+    ("swap_ack", phase, ok, err)      swap phase outcome
+    ("fatal", pickled_exc)            unrecoverable worker error; the
+                                      child exits nonzero right after
+    ("bye",)                          graceful-stop acknowledgement
+
+Service modes (`WorkerSpec.kind`):
+
+- ``echo``     — sleep `service_ms` then return the frame unchanged.
+  The known-capacity worker the traffic harness and the chaos tests
+  build on (capacity = 1000/service_ms rps per worker, serialized in
+  the worker's main loop exactly like a GIL-bound pipeline stage).
+- ``pipeline`` — parse `pipeline` (a mid-pipeline description, e.g.
+  ``tensor_filter framework=xla model=store://m``) into
+  ``appsrc ! <pipeline> ! tensor_sink`` and stream frames through it.
+
+Chaos hooks (`crash_pts`, `hang_pts`, `crash_after_s`,
+`swap_fail_version`) let tests inject deterministic worker failure
+without reaching into a live process; they are inert by default.
+
+Exceptions cross the process boundary pickled — which is why every
+public error class in core/errors.py is pickle-round-trip safe (the
+base class carries `__reduce__`; tests/test_faults.py pins it).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+#: pts is client-owned; requests are keyed across the pool by a
+#: supervisor-assigned rid riding the buffer meta instead
+RID_META = "_pool_rid"
+
+
+@dataclass
+class WorkerSpec:
+    """Picklable description of what one worker runs (spawn-safe: no
+    callables, no open handles — the child rebuilds everything)."""
+
+    kind: str = "echo"                    # echo | pipeline
+    service_ms: float = 0.0               # echo: per-frame service time
+    pipeline: str = ""                    # pipeline: mid-pipeline desc
+    dims: str = "8:1"                     # accepted input dims (HELLO)
+    types: str = "float32"
+    hb_interval_s: float = 0.1            # heartbeat period
+    # chaos hooks (tests / harness only; all inert by default)
+    crash_pts: Optional[int] = None       # os._exit(3) on this pts
+    hang_pts: Optional[int] = None        # sleep forever on this pts
+    crash_after_s: Optional[float] = None  # os._exit(3) after t seconds
+    swap_fail_version: Optional[int] = None  # swap prepare refuses this
+
+    def __post_init__(self):
+        if self.kind not in ("echo", "pipeline"):
+            raise ValueError(
+                f"WorkerSpec.kind must be echo|pipeline, got {self.kind!r}")
+        if self.kind == "pipeline" and not self.pipeline:
+            raise ValueError("WorkerSpec(kind='pipeline') needs a "
+                             "pipeline description")
+
+
+def _pickle_exc(exc: BaseException) -> bytes:
+    """Best-effort exception pickling: a framework error pickles whole
+    (core/errors.py guarantees it); anything else degrades to a
+    RuntimeError carrying the repr, never to a poisoned pipe."""
+    try:
+        return pickle.dumps(exc)
+    except Exception:
+        return pickle.dumps(RuntimeError(
+            f"[unpicklable {type(exc).__name__}] {exc}"))
+
+
+class _Heartbeat(threading.Thread):
+    """Beats on its own thread so a busy (but alive) service loop keeps
+    beating; only a wedged process — native hang, hard GIL capture —
+    goes silent and trips the supervisor's hb_timeout."""
+
+    def __init__(self, conn, send_lock, interval_s: float):
+        super().__init__(name="pool-worker-hb", daemon=True)
+        self._conn = conn
+        self._lock = send_lock
+        self._interval = max(0.01, interval_s)
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        seq = 0
+        while not self._stop.wait(self._interval):
+            seq += 1
+            try:
+                with self._lock:
+                    self._conn.send(("hb", seq, time.monotonic()))
+            except (OSError, ValueError, BrokenPipeError):
+                # parent gone: nothing left to serve, don't linger as
+                # an orphan
+                os._exit(0)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class _EchoService:
+    """Known-capacity service: sleep then echo the payload bytes
+    untouched (no decode on the hot path unless a chaos hook needs the
+    pts)."""
+
+    def __init__(self, spec: WorkerSpec):
+        self._spec = spec
+        self._needs_pts = (spec.crash_pts is not None
+                           or spec.hang_pts is not None)
+
+    def ready_info(self) -> dict:
+        # echo's out spec is its in spec
+        return {"out_dims": self._spec.dims,
+                "out_types": self._spec.types}
+
+    def serve(self, rid: int, payload: bytes, reply) -> None:
+        if self._needs_pts:
+            from nnstreamer_tpu.edge.wire import decode_buffer
+
+            buf, _ = decode_buffer(payload)
+            if buf.pts == self._spec.crash_pts:
+                os._exit(3)
+            if buf.pts == self._spec.hang_pts:
+                time.sleep(3600)          # wedged: supervisor's problem
+        if self._spec.service_ms > 0:
+            time.sleep(self._spec.service_ms / 1e3)
+        reply(("res", rid, payload))
+
+    def close(self) -> None:
+        pass
+
+
+class _PipelineService:
+    """One full pipeline copy: appsrc ! <spec.pipeline> ! tensor_sink.
+
+    Frames are pushed as they arrive (the pipeline pipelines them); a
+    collector thread drains the sink and ships results, matching
+    request to result by the RID_META stamp that rides buffer meta
+    end-to-end."""
+
+    def __init__(self, spec: WorkerSpec, reply):
+        import queue as _queue
+
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.edge.wire import encode_buffer
+
+        self._reply = reply
+        self._outq: "_queue.Queue" = _queue.Queue()
+        desc = (f"appsrc name=_pool_src dims={spec.dims} "
+                f"types={spec.types} ! {spec.pipeline} ! "
+                f"tensor_sink name=_pool_sink collect=false")
+        pipe = nns.parse_launch(desc)
+        self._src = pipe.get("_pool_src")
+        sink = pipe.get("_pool_sink")
+        sink.props["new_data"] = self._outq.put
+        self.runner = nns.PipelineRunner(pipe).start()
+        out_spec = sink.in_specs[0] if sink.in_specs else None
+        dims, types = "", ""
+        if out_spec is not None and hasattr(out_spec, "to_strings"):
+            dims, types, _ = out_spec.to_strings()
+        self._out_info = {"out_dims": dims, "out_types": types}
+        self._stop = threading.Event()
+
+        def collect():
+            while not self._stop.is_set():
+                try:
+                    buf = self._outq.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+                rid = buf.meta.pop(RID_META, None)
+                if rid is None:
+                    continue          # not ours (defensive)
+                reply(("res", int(rid), encode_buffer(buf)))
+
+        self._collector = threading.Thread(
+            target=collect, name="pool-worker-collect", daemon=True)
+        self._collector.start()
+
+    def ready_info(self) -> dict:
+        return dict(self._out_info)
+
+    def serve(self, rid: int, payload: bytes, reply) -> None:
+        from nnstreamer_tpu.edge.wire import decode_buffer
+
+        # runner death is worker-fatal, not request-scoped: the
+        # supervisor restarts the whole process
+        err = getattr(self.runner, "_error", None)
+        if err is not None:
+            raise err
+        buf, _ = decode_buffer(payload)
+        self._src.push(buf)           # RID_META already rides buf.meta
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self.runner.stop()
+        except Exception:
+            pass
+
+
+def _handle_swap(service, spec: WorkerSpec, state: dict, phase: str,
+                 name: str, version) -> "tuple[bool, Optional[str]]":
+    """Two-phase hot swap, child side. `prepare` stages (and for
+    pipeline workers validates against the child's model store) without
+    flipping; only `commit` makes the new version live — so the
+    supervisor can abort every worker if any one prepare fails, and the
+    pool epoch flips all-or-none (PR 5 semantics, one level up)."""
+    if phase == "abort":
+        state.pop("staged", None)
+        return True, None
+    if phase == "prepare":
+        if spec.swap_fail_version is not None \
+                and version == spec.swap_fail_version:
+            return False, f"injected prepare failure for @{version}"
+        if isinstance(service, _PipelineService):
+            try:
+                from nnstreamer_tpu.serving.store import get_store
+
+                entry = get_store().entry(name)
+                if version is not None and \
+                        int(version) not in entry.versions:
+                    return False, (f"store://{name} has no version "
+                                   f"@{version} in this worker")
+            except Exception as e:
+                return False, str(e)
+        state["staged"] = (name, version)
+        return True, None
+    if phase == "commit":
+        staged = state.pop("staged", None)
+        if staged != (name, version):
+            return False, (f"commit without matching prepare "
+                           f"(staged={staged!r})")
+        if isinstance(service, _PipelineService):
+            try:
+                from nnstreamer_tpu.serving.store import get_store
+
+                get_store().update(name, version)
+            except Exception as e:
+                return False, str(e)
+        state["version"] = (name, version)
+        return True, None
+    return False, f"unknown swap phase {phase!r}"
+
+
+def worker_main(conn, spec: WorkerSpec) -> None:
+    """Child entry point (multiprocessing spawn target).
+
+    The loop is deliberately sequential per worker — concurrency comes
+    from the POOL running N of these processes, which is the whole
+    point: one wedged/GIL-bound worker never slows its siblings."""
+    send_lock = threading.Lock()
+
+    def reply(msg) -> None:
+        try:
+            with send_lock:
+                conn.send(msg)
+        except (OSError, ValueError, BrokenPipeError):
+            os._exit(0)               # parent gone — never orphan
+
+    hb = _Heartbeat(conn, send_lock, spec.hb_interval_s)
+    hb.start()
+    if spec.crash_after_s is not None:
+        # chaos: die abruptly after t seconds (circuit-breaker tests)
+        threading.Timer(spec.crash_after_s, lambda: os._exit(3)).start()
+
+    service = None
+    try:
+        if spec.kind == "pipeline":
+            service = _PipelineService(spec, reply)
+        else:
+            service = _EchoService(spec)
+    except BaseException as e:
+        reply(("fatal", _pickle_exc(e)))
+        os._exit(4)
+
+    reply(("ready", dict(service.ready_info(), pid=os.getpid())))
+    swap_state: dict = {}
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                os._exit(1)           # supervisor died — exit, no orphan
+            tag = msg[0]
+            if tag == "req":
+                _, rid, payload = msg
+                try:
+                    service.serve(rid, payload, reply)
+                except BaseException as e:
+                    reply(("err", rid, _pickle_exc(e)))
+            elif tag == "swap":
+                _, phase, name, version = msg
+                ok, err = _handle_swap(service, spec, swap_state,
+                                       phase, name, version)
+                reply(("swap_ack", phase, ok, err))
+            elif tag == "stop":
+                break
+    finally:
+        hb.stop()
+        if service is not None:
+            service.close()
+    reply(("bye",))
+    try:
+        conn.close()
+    except OSError:
+        pass

@@ -20,7 +20,7 @@ from nnstreamer_tpu.traffic.admission import (
     DEADLINE_META, SHED_POLICIES, AdmissionDecision, AdmissionQueue)
 from nnstreamer_tpu.traffic.loadgen import (
     EchoServer, bursty_arrivals, poisson_arrivals, run_against_echo,
-    run_open_loop)
+    run_against_pool, run_open_loop)
 
 __all__ = [
     "AdmissionDecision",
@@ -31,5 +31,6 @@ __all__ = [
     "bursty_arrivals",
     "poisson_arrivals",
     "run_against_echo",
+    "run_against_pool",
     "run_open_loop",
 ]

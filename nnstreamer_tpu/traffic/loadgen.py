@@ -374,6 +374,123 @@ def run_against_echo(*, pattern: str = "poisson", load_x: float = 2.0,
         report["capacity_rps"] = round(srv.capacity_rps, 1)
         report["server_crashed"] = srv.crashed()
         report["admission"] = srv.admission_counters()
+        report["seed"] = int(seed)
         return report
     finally:
         srv.stop()
+
+
+def _arrivals_for(pattern: str, rate: float, n: int,
+                  rng: np.random.Generator) -> np.ndarray:
+    if pattern == "poisson":
+        return poisson_arrivals(rate, n, rng)
+    if pattern == "bursty":
+        return bursty_arrivals(n, rate_high_hz=2 * rate,
+                               rate_low_hz=max(rate / 4, 0.5), rng=rng)
+    raise ValueError(f"pattern must be poisson|bursty, got {pattern!r}")
+
+
+def _conservation_ok(c: dict) -> bool:
+    """The PR-9 invariants, checked over an admission counters()
+    snapshot — they must hold exactly even across a worker kill."""
+    return (c["offered"] == c["admitted"] + sum(c["rejected"].values())
+            and c["admitted"] == c["replied"] + sum(c["shed"].values())
+            + c["depth"] + c["inflight"])
+
+
+def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,
+                     n: int = 300, service_ms: float = 20.0,
+                     workers: int = 2, max_pending: int = 32,
+                     max_inflight: int = 0,
+                     shed_policy: str = "reject-newest",
+                     p99_budget_ms: float = 90.0, seed: int = 0,
+                     kill_at_s: Optional[float] = None, kills: int = 1,
+                     recovery_timeout_s: Optional[float] = None,
+                     **pool_kwargs) -> dict:
+    """Chaos-kill harness run: open-loop load at `load_x` × a worker
+    POOL's aggregate capacity, with `kills` SIGKILLs of rng-chosen
+    workers at `kill_at_s` (default: the median arrival — mid-flood,
+    where a lost worker hurts most). The run is reproducible from
+    (seed, kill schedule), both recorded in the report.
+
+    The report adds to run_open_loop's fields: `kill_schedule` (planned
+    t / wid / pid actually signalled), `recovered` (pool back to full
+    non-disabled capacity within `recovery_timeout_s` — default sized
+    to the restart budget), `conserved` (admission invariants exact),
+    and `orphans` (live pids left after close() — must be empty).
+    """
+    from nnstreamer_tpu.serving.pool import PooledQueryServer, proc_alive
+
+    rng = np.random.default_rng(seed)
+    pqs = PooledQueryServer.echo(
+        workers=workers, service_ms=service_ms, max_pending=max_pending,
+        max_inflight=max_inflight, shed_policy=shed_policy,
+        **pool_kwargs)
+    pool = pqs.pool
+    closed = False
+    try:
+        rate = load_x * pqs.capacity_rps
+        arrivals = _arrivals_for(pattern, rate, n, rng)
+        if kill_at_s is None:
+            kill_at_s = float(arrivals[len(arrivals) // 2])
+        schedule: List[dict] = []
+        timers: List[threading.Timer] = []
+        for k in range(max(0, kills)):
+            t_k = kill_at_s + k * 0.25    # stagger multi-kill runs
+            wid = int(rng.integers(0, workers))
+            entry = {"t_s": round(t_k, 3), "wid": wid, "pid": None}
+            schedule.append(entry)
+
+            def do_kill(entry=entry):
+                # the chosen slot may be dead/restarting already: fall
+                # back to any live worker so the kill still happens
+                pid = pool.kill_worker(entry["wid"])
+                if pid is None:
+                    pid = pool.kill_worker(None)
+                entry["pid"] = pid
+
+            timers.append(threading.Timer(t_k, do_kill))
+
+        x = np.ones((8, 1), np.float32)
+        for t in timers:
+            t.start()
+        try:
+            report = run_open_loop(
+                "127.0.0.1", pqs.port, dims=pool.spec.dims,
+                types=pool.spec.types, arrivals=arrivals,
+                make_frame=lambda i: TensorBuffer.of(x, pts=i),
+                p99_budget_ms=p99_budget_ms,
+                depth_probe=pqs.depth_probe)
+        finally:
+            for t in timers:
+                t.cancel()
+        # recovery: back to full non-disabled capacity within the
+        # restart budget's worth of backoff (+ margin for respawn)
+        if recovery_timeout_s is None:
+            recovery_timeout_s = max(
+                5.0, 2 * pool.restart_backoff_max_s + 5.0)
+        t_rec = time.perf_counter()
+        recovered = pool.wait_ready(recovery_timeout_s)
+        c = pqs.admission_counters()
+        report.update({
+            "pattern": pattern, "load_x": load_x,
+            "service_ms": service_ms, "workers": workers,
+            "capacity_rps": round(pqs.capacity_rps, 1),
+            "seed": int(seed),
+            "kill_schedule": schedule,
+            "recovered": recovered,
+            "recovery_s": round(time.perf_counter() - t_rec, 3),
+            "conserved": _conservation_ok(c),
+            "admission": c,
+            "pool": pool.stats(),
+        })
+        # orphan audit must run AFTER close(): a drained pool may leave
+        # no live child — a pid still alive here is a leaked orphan
+        all_pids = pool.all_pids_ever()
+        pqs.close()
+        closed = True
+        report["orphans"] = [p for p in all_pids if proc_alive(p)]
+        return report
+    finally:
+        if not closed:
+            pqs.close()

@@ -6,6 +6,7 @@ root-cause chaining, repo slot overflow, upstream-event handler errors).
 Everything runs on the fake (custom) backend / synthetic streams — no
 models, no device."""
 
+import pickle
 import queue as _queue
 import threading
 import time
@@ -13,6 +14,7 @@ import time
 import numpy as np
 import pytest
 
+from nnstreamer_tpu.core import errors as errors_mod
 from nnstreamer_tpu import (
     Pipeline,
     PipelineRunner,
@@ -578,3 +580,65 @@ class TestUpstreamEventErrors:
             runner.wait(timeout=10)
         finally:
             runner.stop()
+
+
+# -- error pickling (worker-pool wire contract) ------------------------------
+
+# serving/pool.py ships exceptions across process boundaries; every
+# public error class must survive pickle exactly — args, message, and
+# any extra instance state (ServerBusyError.retry_after_ms etc.)
+_ERR_INSTANCES = [
+    errors_mod.NNStreamerTPUError("base"),
+    errors_mod.ConfigError("bad [runtime] key: workers"),
+    errors_mod.NegotiationError("dims mismatch 4:1 vs 8:1"),
+    errors_mod.PipelineError("unbalanced tee"),
+    errors_mod.BackendError("xla open failed"),
+    errors_mod.SegmentStageError("conv0", ValueError("bad trace")),
+    errors_mod.StreamError("flow error"),
+    errors_mod.ServerBusyError(
+        "server busy", queue_depth=17, retry_after_ms=12.5,
+        cause="worker_lost", pts=42),
+    errors_mod.FaultInjected("injected at pts=3"),
+    errors_mod.WatchdogStall("element x stalled 2.0s"),
+    errors_mod.CircuitOpenError("breaker open, 3 failures"),
+]
+
+
+class TestErrorPickling:
+    @pytest.mark.parametrize(
+        "exc", _ERR_INSTANCES, ids=lambda e: type(e).__name__)
+    def test_round_trip_preserves_type_args_and_state(self, exc):
+        back = pickle.loads(pickle.dumps(exc))
+        assert type(back) is type(exc)
+        assert back.args == exc.args
+        assert str(back) == str(exc)
+        state = {k: v for k, v in exc.__dict__.items()}
+        assert {k: str(v) if isinstance(v, BaseException) else v
+                for k, v in back.__dict__.items()} == \
+               {k: str(v) if isinstance(v, BaseException) else v
+                for k, v in state.items()}
+
+    def test_every_public_error_class_is_covered(self):
+        # a new error class must be added to _ERR_INSTANCES above, or
+        # it ships without a pickling guarantee
+        public = {
+            obj for name, obj in vars(errors_mod).items()
+            if isinstance(obj, type)
+            and issubclass(obj, Exception)
+            and not name.startswith("_")
+        }
+        covered = {type(e) for e in _ERR_INSTANCES}
+        assert public == covered, (
+            f"uncovered: {public - covered}, stale: {covered - public}")
+
+    def test_rich_state_survives(self):
+        e = errors_mod.ServerBusyError(
+            "busy", queue_depth=9, retry_after_ms=7.0,
+            cause="shutdown", pts=5)
+        back = pickle.loads(pickle.dumps(e))
+        assert (back.queue_depth, back.retry_after_ms,
+                back.cause, back.pts) == (9, 7.0, "shutdown", 5)
+        e2 = errors_mod.SegmentStageError("head", KeyError("w"))
+        back2 = pickle.loads(pickle.dumps(e2))
+        assert back2.member == "head"
+        assert "head" in str(back2)

@@ -633,6 +633,84 @@ class TestWatchdogPrune:
         assert runner._wd_warned_q == {}
 
 
+class TestWedgedAdmission:
+    """Watchdog wedged-admission incidents: depth pinned at max_pending
+    with zero reply progress for the queue stall budget. Synthetic-clock
+    tests driving `_watchdog_scan` directly, like TestWatchdogPrune —
+    the probe is any pipeline element exposing `admission_counters()`."""
+
+    def _runner(self, counters, **kw):
+        from types import SimpleNamespace
+
+        from nnstreamer_tpu.runtime.scheduler import ElementStats
+        from nnstreamer_tpu.runtime.tracing import Tracer
+
+        p = parse_launch("appsrc name=in dims=2 ! tensor_sink name=out")
+        runner = PipelineRunner(p, optimize=False, watchdog=False,
+                                trace=Tracer(),
+                                stall_budget_s=0.5,
+                                queue_stall_budget_s=0.5, **kw).start()
+        p.get("in").end()
+        runner.wait(10)
+        runner.stop()
+        elem = SimpleNamespace(
+            name="adm", admission_counters=lambda: dict(counters))
+        runner.pipeline.elements["adm"] = elem
+        runner._stats.setdefault("adm", ElementStats())
+        return runner
+
+    def test_warn_once_rearm_on_progress_prune_on_recovery(self):
+        c = {"depth": 8, "max_pending": 8, "replied": 0}
+        runner = self._runner(c)
+        warns = lambda: runner.stats()["adm"]["watchdog_warnings"]
+        assert runner._watchdog_scan(3000.0) is False   # arms
+        assert runner._wd_adm_since == {"adm": (3000.0, 0)}
+        assert warns() == 0
+        assert runner._watchdog_scan(3000.9) is False   # past budget
+        assert warns() == 1
+        assert runner._wd_warned_adm == {"adm": 3000.0}
+        wd = [e for e in runner.tracer.events()
+              if e[3] == "watchdog_wedged-admission"]
+        assert len(wd) == 1 and wd[0][2] == "adm"
+        # same incident: warned once, not every scan
+        assert runner._watchdog_scan(3001.5) is False
+        assert warns() == 1
+        # reply progress while still pinned: incident re-arms
+        c["replied"] = 3
+        assert runner._watchdog_scan(3002.0) is False
+        assert runner._wd_adm_since == {"adm": (3002.0, 3)}
+        assert runner._wd_warned_adm == {}
+        # wedges again after the re-arm: a second incident, new warning
+        assert runner._watchdog_scan(3002.8) is False
+        assert warns() == 2
+        # depth recovery prunes all bookkeeping, like every _wd_* dict
+        c["depth"] = 2
+        assert runner._watchdog_scan(3003.0) is False
+        assert runner._wd_adm_since == {} and runner._wd_warned_adm == {}
+
+    def test_depth_pinned_but_replies_flowing_never_warns(self):
+        # overload with a live service plane is HEALTHY (BUSY at the
+        # door is the design) — only zero progress is an incident
+        c = {"depth": 8, "max_pending": 8, "replied": 0}
+        runner = self._runner(c)
+        assert runner._watchdog_scan(4000.0) is False
+        for i, t in enumerate((4000.4, 4000.8, 4001.2, 4001.6)):
+            c["replied"] = i + 1             # progress before each scan
+            assert runner._watchdog_scan(t) is False
+        assert runner.stats()["adm"]["watchdog_warnings"] == 0
+        assert runner._wd_warned_adm == {}
+
+    def test_action_fail_escalates_to_watchdog_stall(self):
+        from nnstreamer_tpu.core.errors import WatchdogStall
+
+        c = {"depth": 4, "max_pending": 4, "replied": 7}
+        runner = self._runner(c, watchdog_action="fail")
+        assert runner._watchdog_scan(5000.0) is False   # arms
+        assert runner._watchdog_scan(5000.9) is True    # escalates
+        assert isinstance(runner._error, WatchdogStall)
+        assert "wedged-admission" in str(runner._error)
+
+
 # -- profiler smoke ----------------------------------------------------------
 
 def test_profile_hostpath_smoke():

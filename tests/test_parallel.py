@@ -118,6 +118,22 @@ def test_mesh_dispatcher_batches(eight_cpu_devices):
         d.shutdown()
 
 
+def test_mesh_dispatcher_shutdown_idempotent(eight_cpu_devices):
+    """Regression: shutdown() must be callable repeatedly (finally
+    blocks + supervised teardown paths both call it) without error and
+    without re-running the teardown."""
+    from nnstreamer_tpu.parallel.dispatch import MeshDispatcher
+
+    mesh = make_mesh(MeshSpec(dp=8, tp=1, sp=1))
+    d = MeshDispatcher(lambda p, x: x @ p["w"], {"w": jnp.eye(4)},
+                       mesh, bucket=8, max_delay_ms=1.0)
+    fut = d.submit(np.ones((4,), np.float32))
+    np.testing.assert_allclose(fut.result(30)[0], np.ones(4, np.float32))
+    d.shutdown()
+    d.shutdown()                             # second call: strict no-op
+    d.shutdown()
+
+
 # -- pipeline parallelism (pp) ------------------------------------------------
 
 def test_pipeline_matches_serial(eight_cpu_devices):

@@ -363,6 +363,17 @@ class TestBatchedShutdown:
         finally:
             srv.close()
 
+    def test_close_is_idempotent(self):
+        """Regression: a supervisor-driven close racing (or repeating)
+        a user close must be a no-op — no double-shed, no error on an
+        already-stopped dispatcher, stable stats."""
+        srv = self._server(bucket=4, max_delay_ms=5.0)
+        srv.close()
+        st = srv.stats()
+        srv.close()                          # second close: no-op
+        assert srv.stats() == st
+        srv.close()                          # and a third, for luck
+
 
 # -- observability ------------------------------------------------------------
 
