@@ -253,9 +253,32 @@ def _probe_env():
         _ = np.asarray(x)
         warm.append((time.perf_counter() - t0) * 1e3)
     warm.sort()
-    return {"d2h_1k_ms": round(warm[len(warm) // 2], 2),
-            "d2h_1k_cold_ms": round(cold_ms, 2),
-            "backend": jax.default_backend()}
+    env = {"d2h_1k_ms": round(warm[len(warm) // 2], 2),
+           "d2h_1k_cold_ms": round(cold_ms, 2),
+           "backend": jax.default_backend()}
+    env.update(_probe_lint())
+    return env
+
+
+def _probe_lint() -> dict:
+    """`lint_clean` in the env snapshot: was the tree nnlint-clean when
+    this artifact was produced (docs/static_analysis.md)?  A dirty tree
+    taints comparisons the same way a degraded tunnel does — a finding
+    like a stray direct sync IS a host-path change.  Never fails the
+    bench: lint breakage reports as lint_clean=False + lint_error."""
+    try:
+        from nnstreamer_tpu.analysis import lint_report
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        report = lint_report(
+            ["nnstreamer_tpu"], root=root,
+            baseline_path=os.path.join(root, "nnlint_baseline.json"))
+        out = {"lint_clean": report.clean}
+        if not report.clean:
+            out["lint_findings"] = len(report.findings)
+        return out
+    except Exception as e:          # pragma: no cover - defensive
+        return {"lint_clean": False, "lint_error": repr(e)}
 
 
 def _gate_env(env: dict, errors: dict) -> None:

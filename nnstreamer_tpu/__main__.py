@@ -20,6 +20,8 @@
                                                        #  worker mid-flood
     python -m nnstreamer_tpu serve --workers 4         # supervised worker
                                                        #  pool (SIGTERM drains)
+    python -m nnstreamer_tpu lint [--json]             # project static
+                                                       #  analysis (nnlint)
 """
 
 from __future__ import annotations
@@ -414,6 +416,10 @@ def main(argv=None) -> int:
         return _traffic_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
+    if argv and argv[0] == "lint":
+        from nnstreamer_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu",
         description="TPU-native streaming AI pipelines (gst-launch parity)")

@@ -1,0 +1,41 @@
+"""nnlint — project-specific static analysis (docs/static_analysis.md).
+
+    python -m nnstreamer_tpu lint            # human output, exit 0/1
+    python -m nnstreamer_tpu lint --json     # machine output
+    python tools/nnlint.py                   # same, direct entry
+
+`lint_report(paths)` is the in-process API (bench.py's env snapshot and
+the tier-1 gate test use it).  The analysis core is stdlib-only — this
+package never imports jax or the code it scans; `contract` (runtime
+introspection for docs) is imported lazily for the same reason.
+"""
+
+from __future__ import annotations
+
+from nnstreamer_tpu.analysis.core import (
+    SCHEMA_VERSION, Finding, Module, Project, Report, Rule,
+    build_project, load_baseline, project_from_sources, run_rules,
+    write_baseline)
+from nnstreamer_tpu.analysis.rules import ALL_RULES, iter_rules
+
+__all__ = [
+    "SCHEMA_VERSION", "Finding", "Module", "Project", "Report", "Rule",
+    "ALL_RULES", "build_project", "element_contract", "iter_rules",
+    "lint_report", "load_baseline", "project_from_sources", "run_rules",
+    "write_baseline",
+]
+
+
+def lint_report(paths=("nnstreamer_tpu",), root=None,
+                baseline_path=None, rules=None) -> Report:
+    """One-call lint: build the project, run the rules, apply the
+    baseline.  `Report.clean` is the gate bit."""
+    project = build_project(paths, root=root)
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    return run_rules(project, iter_rules(rules), baseline)
+
+
+def element_contract(cls):
+    """Lazy re-export (contract.py imports the graph layer)."""
+    from nnstreamer_tpu.analysis.contract import element_contract as ec
+    return ec(cls)

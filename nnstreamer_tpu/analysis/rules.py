@@ -1,0 +1,635 @@
+"""The project-specific rule catalog (docs/static_analysis.md).
+
+NNL001 element-contract   timer pair complete; CHAIN_FUSABLE matches
+                          element shape; DEVICE_RESIDENT not on sinks;
+                          contract flags declared, not mutated per-instance
+NNL002 forced-sync        block_until_ready / jax.device_get / device
+                          np.asarray only via runtime/sync.device_sync
+NNL003 lock-discipline    no blocking call inside a `with <lock>:` body
+NNL004 jit-purity         nothing impure reachable from jitted functions
+NNL005 spawn-safety       no module-scope jax work in modules the spawn
+                          worker imports
+NNL006 picklable-errors   every public error class carries the
+                          __reduce__ round-trip contract
+NNL007 thread-audit       every thread is daemon or joined/cancelled on
+                          a close path
+
+Every rule is pure AST — nothing here imports the code under analysis.
+Heuristics err toward silence (a missed finding is a review problem; a
+noisy gate gets deleted), and every deliberate exception at a flagged
+site takes an inline `# nnlint: disable=...` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from nnstreamer_tpu.analysis.core import (
+    Module, Project, Rule, const_value, dotted, walk_no_functions)
+
+#: class names that mark an Element subclass without importing it
+_ELEMENT_BASES = {"Element", "SourceElement", "SinkElement"}
+#: sink-side bases (sync points: DEVICE_RESIDENT is a contradiction)
+_SINK_BASES = {"SinkElement"}
+
+
+def _is_element_class(node: ast.ClassDef) -> bool:
+    for deco in node.decorator_list:
+        if dotted(deco).split(".")[-1] == "register_element":
+            return True
+    return any(dotted(b).split(".")[-1] in _ELEMENT_BASES
+               for b in node.bases)
+
+
+def _class_assigns(node: ast.ClassDef) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and isinstance(stmt.target, ast.Name):
+            out[stmt.target.id] = stmt.value
+    return out
+
+
+def _method_names(node: ast.ClassDef) -> Set[str]:
+    return {s.name for s in node.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+class ElementContract(Rule):
+    rule_id = "NNL001"
+    title = "element-contract"
+    rationale = (
+        "the scheduler trusts class-level contract flags: a timer "
+        "element missing half its pair never wakes (or fires into a "
+        "missing handler), a fusable multi-pad element would execute "
+        "fan-in on a chain thread, and a DEVICE_RESIDENT sink would "
+        "never sync its results")
+
+    def check(self, module: Module, project: Project):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef) and _is_element_class(node):
+                yield from self._check_class(node)
+            # contract flags are class-level declarations the scheduler
+            # and docs introspect — per-instance mutation hides them
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self" \
+                            and t.attr in ("CHAIN_FUSABLE",
+                                           "DEVICE_RESIDENT"):
+                        yield node, (
+                            f"contract flag {t.attr} mutated per-instance; "
+                            f"declare it on the class (the scheduler and "
+                            f"docs introspect the class-level value)")
+
+    def _check_class(self, node: ast.ClassDef):
+        assigns = _class_assigns(node)
+        methods = _method_names(node)
+        has_deadline = "next_deadline" in methods
+        has_timer = "on_timer" in methods
+        if has_deadline != has_timer:
+            missing = "on_timer" if has_deadline else "next_deadline"
+            present = "next_deadline" if has_deadline else "on_timer"
+            yield node, (
+                f"element {node.name} defines {present} without "
+                f"{missing}: the timer contract is a pair (scheduler "
+                f"worker loop fires on_timer when next_deadline expires)")
+        fusable = const_value(assigns["CHAIN_FUSABLE"]) \
+            if "CHAIN_FUSABLE" in assigns else None
+        if (has_deadline and has_timer) and fusable is not False:
+            yield node, (
+                f"timer element {node.name} must declare CHAIN_FUSABLE "
+                f"= False: a fused chain member cannot be woken "
+                f"independently of its chain head")
+        for attr in ("NUM_SINK_PADS", "NUM_SRC_PADS"):
+            if attr in assigns:
+                v = const_value(assigns[attr])
+                if isinstance(v, int) and (v == -1 or v >= 2) \
+                        and fusable is not False:
+                    yield node, (
+                        f"element {node.name} declares {attr}="
+                        f"{'DYNAMIC' if v == -1 else v} but not "
+                        f"CHAIN_FUSABLE = False: chain fusion is for "
+                        f"single-in/single-out call-through elements only")
+                    break
+        if const_value(assigns.get("DEVICE_RESIDENT",
+                                   ast.Constant(False))) is True:
+            if any(dotted(b).split(".")[-1] in _SINK_BASES
+                   for b in node.bases):
+                yield node, (
+                    f"sink element {node.name} declares DEVICE_RESIDENT "
+                    f"= True: sinks are sync points — their results "
+                    f"must resolve (runtime/sync.device_sync)")
+
+
+class ForcedSync(Rule):
+    rule_id = "NNL002"
+    title = "forced-sync"
+    rationale = (
+        "runtime/sync.device_sync is the single host-sync choke point: "
+        "it does one whole-tuple block_until_ready and feeds the "
+        "tracer's forced_syncs stat — a direct block_until_ready / "
+        "device_get / device-array np.asarray elsewhere is an invisible "
+        "host-path tax the bench can no longer attribute")
+
+    #: the one module allowed to touch the primitives
+    EXEMPT = ("runtime/sync.py",)
+    #: directories where a bare single-arg np.asarray is presumed to be
+    #: a device readback (elements/decoders consume host arrays the
+    #: scheduler already resolved; the device-adjacent layers do not)
+    ASARRAY_DIRS = ("backends/", "runtime/")
+
+    def check(self, module: Module, project: Project):
+        if module.path.endswith(self.EXEMPT):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.split(".")[-1]
+            if leaf == "block_until_ready":
+                yield node, (
+                    "direct block_until_ready bypasses "
+                    "runtime/sync.device_sync (one sync call site keeps "
+                    "the tracer's forced_syncs truthful)")
+            elif d == "jax.device_get" or d.endswith(".device_get"):
+                yield node, (
+                    "jax.device_get bypasses runtime/sync.device_sync; "
+                    "resolve via device_sync then read on host")
+            elif d in ("np.asarray", "numpy.asarray") \
+                    and self._in_asarray_scope(module.path) \
+                    and len(node.args) == 1 and not node.keywords \
+                    and not self._arg_is_synced(node.args[0]):
+                yield node, (
+                    "bare np.asarray in a device-adjacent layer is a "
+                    "hidden host sync; use np.asarray(device_sync(x)) "
+                    "so the sync is counted, or add a justification")
+
+    def _in_asarray_scope(self, path: str) -> bool:
+        return any(f"/{d}" in f"/{path}" for d in self.ASARRAY_DIRS)
+
+    @staticmethod
+    def _arg_is_synced(arg: ast.AST) -> bool:
+        """np.asarray(device_sync(x)) is the blessed idiom: the sync is
+        explicit and counted; the asarray is then a plain host copy."""
+        return isinstance(arg, ast.Call) \
+            and dotted(arg.func).split(".")[-1] == "device_sync"
+
+
+class LockDiscipline(Rule):
+    rule_id = "NNL003"
+    title = "lock-discipline"
+    rationale = (
+        "a blocking call while holding a lock is the classic deadlock/"
+        "latency-cliff shape: every other thread that needs the lock "
+        "stalls behind the wait (runtime/channel.py exists to do this "
+        "correctly with condition variables)")
+
+    #: queue-ish receiver names where a positional .get()/.put() is a
+    #: blocking channel operation, not a dict access
+    QUEUE_NAMES = {"q", "queue", "outq", "inq", "sendq", "frames",
+                   "channel", "chan", "done_q", "acks"}
+
+    def check(self, module: Module, project: Project):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lock_names = [dotted(item.context_expr).split(".")[-1]
+                          for item in node.items]
+            if not any(n.lower().endswith("lock") for n in lock_names):
+                continue
+            for inner in walk_no_functions(node.body):
+                if isinstance(inner, ast.Call):
+                    msg = self._blocking(inner)
+                    if msg:
+                        yield inner, (
+                            f"{msg} inside `with "
+                            f"{'/'.join(lock_names)}:` — blocking under "
+                            f"a lock stalls every thread that needs it")
+
+    def _blocking(self, call: ast.Call) -> Optional[str]:
+        d = dotted(call.func)
+        leaf = d.split(".")[-1]
+        kwargs = {k.arg for k in call.keywords}
+        if d == "time.sleep" or leaf == "sleep":
+            return "time.sleep"
+        if leaf == "device_sync":
+            return "device_sync (host sync)"
+        if leaf == "join" and (not call.args or "timeout" in kwargs):
+            # str.join always takes one positional and never timeout=
+            return "thread/process join"
+        if leaf in ("recv", "recv_bytes", "accept", "recvfrom"):
+            return f"socket/pipe {leaf}()"
+        if leaf in ("get", "put"):
+            recv = dotted(call.func.value).split(".")[-1].lower() \
+                .lstrip("_") if isinstance(call.func, ast.Attribute) else ""
+            if kwargs & {"timeout", "deadline"} \
+                    or recv in self.QUEUE_NAMES:
+                return f"queue/channel {leaf}()"
+        return None
+
+
+#: call prefixes/names that are impure under jax tracing: host clocks,
+#: host RNG, I/O, tracer hooks, host syncs
+_JIT_BANNED_PREFIX = ("time.", "random.", "np.random.", "numpy.random.",
+                      "os.", "socket.", "logging.")
+_JIT_BANNED_NAMES = {"open", "print", "input", "perf_counter",
+                     "device_sync", "block_until_ready", "monotonic"}
+#: module origins that make a bare imported name impure
+_JIT_BANNED_MODULES = {"time", "random", "os", "socket"}
+
+
+class JitPurity(Rule):
+    rule_id = "NNL004"
+    title = "jit-purity"
+    rationale = (
+        "a function traced by jax.jit/compose_segment runs its Python "
+        "body ONCE at trace time: clocks freeze into constants, host "
+        "RNG draws bake in forever, tracer/I-O calls fire at compile "
+        "instead of per frame — all silent wrong-answer bugs")
+
+    MAX_DEPTH = 8
+
+    def check(self, module: Module, project: Project):
+        imports = _import_map(module.tree)
+        for node in ast.walk(module.tree):
+            fn: Optional[Tuple[Module, ast.AST]] = None
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if self._is_jit(deco):
+                        fn = (module, node)
+            elif isinstance(node, ast.Call) and self._is_jit(node) \
+                    and node.args:
+                fn = self._resolve(module, project, imports, node.args[0])
+            if fn is None:
+                continue
+            seen: Set[Tuple[str, str]] = set()
+            yield from self._scan(project, fn[0], fn[1], seen, 0)
+
+    @staticmethod
+    def _is_jit(node: ast.AST) -> bool:
+        d = dotted(node)
+        leaf = d.split(".")[-1]
+        if leaf in ("jit", "pmap", "compose_segment"):
+            return True
+        # @partial(jax.jit, ...) / partial(jit, ...)
+        if isinstance(node, ast.Call) \
+                and dotted(node.func).split(".")[-1] == "partial" \
+                and node.args:
+            return JitPurity._is_jit(node.args[0])
+        return False
+
+    def _resolve(self, module: Module, project: Project, imports,
+                 arg: ast.AST) -> Optional[Tuple[Module, ast.AST]]:
+        """Name → its FunctionDef, locally or via `from X import f`
+        when X is a scanned module. Lambdas/inline defs analyze in
+        place; attributes (bound methods) are skipped."""
+        if isinstance(arg, ast.Lambda):
+            return module, arg
+        if not isinstance(arg, ast.Name):
+            return None
+        fn = _module_function(module.tree, arg.id)
+        if fn is not None:
+            return module, fn
+        origin = imports.get(arg.id)
+        if origin:
+            target = project.by_dotted(origin[0])
+            if target is not None:
+                fn = _module_function(target.tree, origin[1])
+                if fn is not None:
+                    return target, fn
+        return None
+
+    def _scan(self, project: Project, module: Module, fn: ast.AST,
+              seen: Set[Tuple[str, str]], depth: int):
+        key = (module.path, getattr(fn, "name", f"<lambda@{fn.lineno}>"))
+        if key in seen or depth > self.MAX_DEPTH:
+            return
+        seen.add(key)
+        imports = _import_map(module.tree)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+            if isinstance(node, ast.Attribute) and node.attr == "_tracer":
+                yield _at(node, module, fn), (
+                    f"tracer access reachable from jitted "
+                    f"{key[1]} ({module.path}): hooks fire at trace "
+                    f"time, not per frame")
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.split(".")[-1]
+            banned = (
+                any(d.startswith(p) for p in _JIT_BANNED_PREFIX)
+                or d in _JIT_BANNED_NAMES
+                or leaf == "block_until_ready"
+                or (isinstance(node.func, ast.Name)
+                    and imports.get(d, ("",))[0] in _JIT_BANNED_MODULES))
+            if banned:
+                yield _at(node, module, fn), (
+                    f"impure call {d or leaf}() reachable from jitted "
+                    f"{key[1]} ({module.path}): traces once, then "
+                    f"freezes into the compiled program")
+                continue
+            # follow local/imported plain-function calls
+            nxt = self._resolve(module, project, imports,
+                                node.func if isinstance(node.func, ast.Name)
+                                else ast.Constant(None))
+            if nxt is not None:
+                yield from self._scan(project, nxt[0], nxt[1], seen,
+                                      depth + 1)
+
+
+def _at(node: ast.AST, module: Module, fn: ast.AST):
+    """Findings for cross-module reachability anchor on the defining
+    module only when it is the one being scanned; otherwise on the
+    jitted function's def line (suppressions stay local)."""
+    return node if getattr(node, "lineno", None) else fn
+
+
+def _module_function(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    """FunctionDef named `name` anywhere in the module (jit wrappees
+    are often defined inside factory functions)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _import_map(tree: ast.AST) -> Dict[str, Tuple[str, str]]:
+    """name → (module dotted path, original name) for every import in
+    the tree (function-local imports included: the runtime imports jax
+    lazily everywhere)."""
+    out: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[(a.asname or a.name).split(".")[0]] = (a.name, "")
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = (node.module, a.name)
+    return out
+
+
+class SpawnSafety(Rule):
+    rule_id = "NNL005"
+    title = "spawn-safety"
+    rationale = (
+        "serving/pool.py uses the spawn context: every worker process "
+        "re-imports its modules from scratch — module-scope jax work "
+        "(or even a module-scope jax import) in that closure runs N "
+        "times at fork-bomb speed, initializes device runtimes before "
+        "the worker can configure them, and wedges startup")
+
+    ROOT = "nnstreamer_tpu/serving/worker.py"
+    PKG = "nnstreamer_tpu"
+
+    def check(self, module: Module, project: Project):
+        closure = self._closure(project)
+        if module.path not in closure:
+            return
+        for stmt in self._module_scope(module.tree):
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        yield stmt, (
+                            f"module-scope `import {a.name}` in a "
+                            f"module the spawn worker imports "
+                            f"({self.ROOT}): keep jax imports lazy")
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module and (stmt.module == "jax"
+                                    or stmt.module.startswith("jax.")):
+                    yield stmt, (
+                        f"module-scope `from {stmt.module} import ...` "
+                        f"in a module the spawn worker imports "
+                        f"({self.ROOT}): keep jax imports lazy")
+            else:
+                for node in walk_no_functions([stmt]):
+                    if isinstance(node, ast.Call):
+                        d = dotted(node.func)
+                        if d.startswith("jax.") or d.startswith("jnp."):
+                            yield node, (
+                                f"module-scope device work {d}() in a "
+                                f"module the spawn worker imports "
+                                f"({self.ROOT}): every worker re-runs "
+                                f"it at import")
+
+    def _closure(self, project: Project) -> Set[str]:
+        root = project.modules.get(self.ROOT)
+        if root is None:
+            return set()
+        todo, seen = [self.ROOT], {self.ROOT}
+        while todo:
+            mod = project.modules.get(todo.pop())
+            if mod is None:
+                continue
+            for stmt in self._module_scope(mod.tree):
+                for name in self._imported_modules(stmt, mod.path):
+                    if not name.startswith(self.PKG):
+                        continue
+                    target = project.by_dotted(name)
+                    if target and target.path not in seen:
+                        seen.add(target.path)
+                        todo.append(target.path)
+        return seen
+
+    @staticmethod
+    def _module_scope(tree: ast.AST):
+        """Top-level statements, descending through top-level if/try
+        blocks (conditional imports still run at import time) but never
+        into function or class bodies' functions."""
+        stack = list(getattr(tree, "body", []))
+        while stack:
+            stmt = stack.pop()
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, (ast.If, ast.Try, ast.With, ast.ClassDef)):
+                for field in ("body", "orelse", "finalbody"):
+                    stack.extend(getattr(stmt, field, []))
+                for h in getattr(stmt, "handlers", []):
+                    stack.extend(h.body)
+                continue
+            yield stmt
+
+    @staticmethod
+    def _imported_modules(stmt: ast.AST, path: str) -> Iterable[str]:
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                yield a.name
+        elif isinstance(stmt, ast.ImportFrom):
+            if stmt.level:
+                pkg_parts = path.split("/")[:-1]
+                base = ".".join(pkg_parts[: len(pkg_parts) - stmt.level + 1])
+                mod = f"{base}.{stmt.module}" if stmt.module else base
+            else:
+                mod = stmt.module or ""
+            # `from X import name`: name may be a submodule or an
+            # attribute — offer both; by_dotted misses attributes
+            yield mod
+            for a in stmt.names:
+                if a.name != "*":
+                    yield f"{mod}.{a.name}"
+
+
+class PicklableErrors(Rule):
+    rule_id = "NNL006"
+    title = "picklable-errors"
+    rationale = (
+        "errors cross process boundaries in the worker pool pickled; "
+        "naive pickling re-invokes cls(*args), so any subclass with a "
+        "custom __init__ signature raises TypeError at UNPICKLE time — "
+        "the parent then loses the real failure")
+
+    def check(self, module: Module, project: Project):
+        if not module.path.endswith("errors.py"):
+            return
+        classes: Dict[str, ast.ClassDef] = {
+            n.name: n for n in module.tree.body
+            if isinstance(n, ast.ClassDef)}
+        for node in classes.values():
+            if node.name.startswith("_"):
+                continue
+            if not self._is_exception(node, classes):
+                continue
+            if not self._has_reduce(node, classes):
+                yield node, (
+                    f"public error class {node.name} has no __reduce__ "
+                    f"in its local base chain: subclass "
+                    f"NNStreamerTPUError (or define __reduce__) so it "
+                    f"survives the worker-pool pickle round trip")
+
+    def _is_exception(self, node: ast.ClassDef,
+                      classes: Dict[str, ast.ClassDef]) -> bool:
+        for b in node.bases:
+            name = dotted(b).split(".")[-1]
+            if name in ("Exception", "BaseException") \
+                    or name.endswith("Error"):
+                if name in classes:
+                    return self._is_exception(classes[name], classes) \
+                        or True
+                return True
+            if name in classes and self._is_exception(classes[name],
+                                                      classes):
+                return True
+        return False
+
+    def _has_reduce(self, node: ast.ClassDef,
+                    classes: Dict[str, ast.ClassDef],
+                    depth: int = 0) -> bool:
+        if depth > 10:
+            return False
+        if "__reduce__" in _method_names(node):
+            return True
+        return any(self._has_reduce(classes[dotted(b).split(".")[-1]],
+                                    classes, depth + 1)
+                   for b in node.bases
+                   if dotted(b).split(".")[-1] in classes)
+
+
+class ThreadAudit(Rule):
+    rule_id = "NNL007"
+    title = "thread-audit"
+    rationale = (
+        "a non-daemon thread that nobody joins outlives its owner: "
+        "teardown hangs waiting for it (a fired Timer held a worker "
+        "process alive past its graceful exit), and tests leak "
+        "threads across cases")
+
+    def check(self, module: Module, project: Project):
+        src = module.src
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_subclass(node)
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.split(".")[-1]
+            if d not in ("threading.Thread", "threading.Timer") \
+                    and leaf not in ("Thread", "Timer"):
+                continue
+            if leaf not in ("Thread", "Timer"):
+                continue
+            if not (d.startswith("threading.") or d in ("Thread", "Timer")):
+                continue
+            if self._daemon_kw(node):
+                continue
+            target = self._assign_target(module, node)
+            if target and (f"{target}.join" in src
+                           or f"{target}.cancel" in src
+                           or f"{target}.daemon" in src):
+                continue
+            kind = "Timer" if leaf == "Timer" else "Thread"
+            yield node, (
+                f"threading.{kind} is neither daemon=True nor "
+                f"joined/cancelled on a close path: it outlives its "
+                f"owner and hangs teardown")
+
+    @staticmethod
+    def _daemon_kw(node: ast.Call) -> bool:
+        for k in node.keywords:
+            if k.arg == "daemon" \
+                    and isinstance(k.value, ast.Constant) \
+                    and k.value.value is True:
+                return True
+        return False
+
+    @staticmethod
+    def _assign_target(module: Module, call: ast.Call) -> Optional[str]:
+        """Terminal name the Thread lands in (x / self.x / slot.x),
+        found by locating the Assign/append wrapping this call."""
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    return t.id
+                if isinstance(t, ast.Attribute):
+                    return t.attr
+            # timers.append(threading.Timer(...)) — audit the list name
+            if isinstance(node, ast.Call) and call in node.args \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "append":
+                return dotted(node.func.value).split(".")[-1]
+        return None
+
+    def _check_subclass(self, node: ast.ClassDef):
+        if not any(dotted(b) in ("threading.Thread", "Thread")
+                   for b in node.bases):
+            return
+        for stmt in node.body:
+            if isinstance(stmt, ast.FunctionDef) \
+                    and stmt.name == "__init__":
+                blob = ast.dump(stmt)
+                if "daemon" in blob:
+                    return
+                yield node, (
+                    f"threading.Thread subclass {node.name}.__init__ "
+                    f"never sets daemon: instances default non-daemon "
+                    f"and hang interpreter exit unless every owner "
+                    f"joins them")
+                return
+
+
+#: registry, in catalog order
+ALL_RULES: List[Rule] = [
+    ElementContract(), ForcedSync(), LockDiscipline(), JitPurity(),
+    SpawnSafety(), PicklableErrors(), ThreadAudit(),
+]
+
+
+def iter_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
+    if not only:
+        return list(ALL_RULES)
+    want = {r.strip().upper() for r in only}
+    unknown = want - {r.rule_id for r in ALL_RULES}
+    if unknown:
+        raise ValueError(
+            f"unknown rule id(s) {sorted(unknown)}; available: "
+            f"{[r.rule_id for r in ALL_RULES]}")
+    return [r for r in ALL_RULES if r.rule_id in want]

@@ -32,6 +32,7 @@ import numpy as np
 from nnstreamer_tpu.core.errors import BackendError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.llm.paged_cache import SCRATCH_BLOCK, PagedKVCache
+from nnstreamer_tpu.runtime.sync import device_sync
 from nnstreamer_tpu.runtime.tracing import NULL_TRACER
 
 log = get_logger("backends.llm")
@@ -218,7 +219,8 @@ class PagedLLMExecutor:
             self.params, ids, blk_idx, blk_off, self.cache.k,
             self.cache.v, np.int32(plen - 1), n_heads=self.n_heads,
             dtype=self.dtype)
-        out = np.asarray(logits)
+        out = np.asarray(device_sync(
+            logits, tracer=self.tracer, name=f"{self.name}:prefill"))
         t1 = time.perf_counter()
         if fresh:
             self.compile_count += 1
@@ -252,7 +254,8 @@ class PagedLLMExecutor:
         logits, self.cache.k, self.cache.v = jitted(
             self.params, cur_a, tab_a, pos_a, self.cache.k,
             self.cache.v, n_heads=self.n_heads, dtype=self.dtype)
-        out = np.asarray(logits)[:n]
+        out = np.asarray(device_sync(
+            logits, tracer=self.tracer, name=f"{self.name}:decode"))[:n]
         t1 = time.perf_counter()
         if fresh:
             self.compile_count += 1
@@ -274,8 +277,6 @@ class PagedLLMExecutor:
         populates the jit's dispatch cache, so the first *served*
         request is a cache hit, not a second compile. Returns whether a
         fresh executable was built."""
-        import jax
-
         key = (self._ns(version), kind, bucket)
         if key in self._jits:
             return False
@@ -298,7 +299,8 @@ class PagedLLMExecutor:
             logits, self.cache.k, self.cache.v = jitted(
                 params, cur, tab, pos, self.cache.k, self.cache.v,
                 n_heads=self.n_heads, dtype=self.dtype)
-        jax.block_until_ready(logits)
+        device_sync(logits, tracer=self.tracer,
+                    name=f"{self.name}:warm_{kind}")
         self.compile_count += 1
         self._span("compile", t0, time.perf_counter(),
                    what=f"llm_{kind}_warm", bucket=bucket)

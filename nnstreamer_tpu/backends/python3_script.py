@@ -183,9 +183,16 @@ class Python3ScriptBackend(FilterBackend):
     def invoke(self, tensors: ArrayTuple) -> ArrayTuple:
         f = self._filter
         assert f is not None
+        from nnstreamer_tpu.runtime.sync import device_sync
+
+        # scripts consume host arrays: resolve the whole tuple in ONE
+        # counted sync (free if the scheduler already handed us host
+        # data), then the per-leaf asarray below is a plain host view
+        tensors = device_sync(tensors, name="python3_script")
         # the reference hands scripts flat arrays of the negotiated
         # dtype (scaler.py reshapes from 1-D itself)
-        flat = [np.ravel(np.asarray(t)) for t in tensors]
+        flat = [np.ravel(np.asarray(t))  # nnlint: disable=NNL002 whole-tuple device_sync above
+                for t in tensors]
         out = f.invoke(flat)
         if out is None:
             raise BackendError(
@@ -195,7 +202,7 @@ class Python3ScriptBackend(FilterBackend):
             self._out_spec = outs
         shaped = []
         for i, arr in enumerate(out):
-            arr = np.asarray(arr)
+            arr = np.asarray(arr)  # nnlint: disable=NNL002 script ABI returns host lists/ndarrays, never device arrays
             if self._out_spec is not None and \
                     i < len(self._out_spec.tensors):
                 t = self._out_spec.tensors[i]

@@ -87,6 +87,8 @@ class TensorBatch(Element):
     ELEMENT_NAME = "tensor_batch"
     NUM_SINK_PADS = DYNAMIC
     NUM_SRC_PADS = 1
+    # timer + fan-in: must run on its own worker, never in a fused chain
+    CHAIN_FUSABLE = False
     PROPS = {
         "max_batch": PropDef(int, 8, "flush when this many frames queued"),
         "max_latency_ms": PropDef(
@@ -239,6 +241,8 @@ class TensorUnbatch(Element):
     ELEMENT_NAME = "tensor_unbatch"
     NUM_SINK_PADS = 1
     NUM_SRC_PADS = DYNAMIC
+    # 1→N emission: a chain expects one buffer out per buffer in
+    CHAIN_FUSABLE = False
     ACCEPTS_DYN_BATCH = True
     PROPS = {}
 

@@ -79,6 +79,8 @@ class TensorIf(Element):
 
     ELEMENT_NAME = "tensor_if"
     NUM_SRC_PADS = DYNAMIC
+    # branching fan-out: chain fusion is single-in/single-out only
+    CHAIN_FUSABLE = False
     PROPS = {
         "compared_value": PropDef(str, "a_value", "|".join(CV_MODES)),
         "compared_value_option": PropDef(str, "0:0"),
@@ -403,6 +405,8 @@ class TensorCrop(Element):
 
     ELEMENT_NAME = "tensor_crop"
     NUM_SINK_PADS = 2
+    # two-pad fan-in: chain fusion is single-in/single-out only
+    CHAIN_FUSABLE = False
     PROPS = {
         "lateness": PropDef(int, 33_000_000, "max |pts_raw - pts_info| ns"),
     }

@@ -140,7 +140,7 @@ class TensorDecoder(Element):
             self.WANTS_HOST = False   # keep payloads on device
             # device decode emits unresolved jax arrays — eligible for
             # the scheduler's async-dispatch window (no per-result sync)
-            self.DEVICE_RESIDENT = True
+            self.DEVICE_RESIDENT = True  # nnlint: disable=NNL001 residency is the device= property's choice, set before the scheduler ever reads it
         # pipelined host decode (max_in_flight>1) keeps WANTS_HOST=True:
         # the scheduler's enqueue-side prefetch_host starts the copy as
         # early as possible; this element merely defers the blocking

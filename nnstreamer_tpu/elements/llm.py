@@ -60,6 +60,8 @@ class TensorLLM(Element):
     ELEMENT_NAME = "tensor_llm"
     NUM_SINK_PADS = 1
     NUM_SRC_PADS = 1
+    # timer element (decode-step wakeups): needs its own worker loop
+    CHAIN_FUSABLE = False
     WANTS_HOST = True
     PROPS = {
         "model": PropDef(str, "store://transformer",

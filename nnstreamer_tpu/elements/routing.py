@@ -196,6 +196,8 @@ class TensorMux(Element):
     ELEMENT_NAME = "tensor_mux"
     NUM_SINK_PADS = DYNAMIC
     NUM_SRC_PADS = 1
+    # dynamic fan-in: chain fusion is single-in/single-out only
+    CHAIN_FUSABLE = False
     PROPS = {
         "sync_mode": PropDef(str, "slowest", "|".join(SYNC_MODES)),
         "sync_option": PropDef(str, "", "basepad option '<pad>:<window_ns>'"),
@@ -250,6 +252,8 @@ class TensorMerge(Element):
     ELEMENT_NAME = "tensor_merge"
     NUM_SINK_PADS = DYNAMIC
     NUM_SRC_PADS = 1
+    # dynamic fan-in: chain fusion is single-in/single-out only
+    CHAIN_FUSABLE = False
     _KEYWORDS = {"batch": 0, "height": 1, "width": 2, "channel": 3}
     PROPS = {
         "mode": PropDef(str, "linear"),
@@ -353,6 +357,8 @@ class TensorDemux(Element):
     ELEMENT_NAME = "tensor_demux"
     NUM_SINK_PADS = 1
     NUM_SRC_PADS = DYNAMIC
+    # dynamic fan-out: chain fusion is single-in/single-out only
+    CHAIN_FUSABLE = False
     PROPS = {
         "tensorpick": PropDef(str, "", "e.g. '0,2' or '0,1+2'; empty = all"),
     }
@@ -404,6 +410,8 @@ class TensorSplit(Element):
     ELEMENT_NAME = "tensor_split"
     NUM_SINK_PADS = 1
     NUM_SRC_PADS = DYNAMIC
+    # dynamic fan-out: chain fusion is single-in/single-out only
+    CHAIN_FUSABLE = False
     PROPS = {
         "tensorseg": PropDef(str, None, "colon-separated segment sizes"),
         "axis": PropDef(int, -1, "row-major split axis (default last)"),
@@ -471,6 +479,8 @@ class Join(Element):
     ELEMENT_NAME = "join"
     NUM_SINK_PADS = DYNAMIC
     NUM_SRC_PADS = 1
+    # dynamic fan-in: chain fusion is single-in/single-out only
+    CHAIN_FUSABLE = False
     PROPS = {}
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:
@@ -496,6 +506,8 @@ class Tee(Element):
     ELEMENT_NAME = "tee"
     NUM_SINK_PADS = 1
     NUM_SRC_PADS = DYNAMIC
+    # dynamic fan-out: chain fusion is single-in/single-out only
+    CHAIN_FUSABLE = False
     PROPS = {}
 
     def negotiate(self, in_specs: Sequence[StreamSpec]) -> List[StreamSpec]:

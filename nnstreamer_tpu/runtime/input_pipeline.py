@@ -139,7 +139,7 @@ class DeviceFeeder:
             with self._lock:
                 if self._closed:
                     raise RuntimeError("DeviceFeeder is closed")
-                self._q.put(staged)
+                self._q.put(staged)  # nnlint: disable=NNL003 non-blocking by invariant: _slots caps data at depth, maxsize=depth+1
         except BaseException:
             self._slots.release()
             raise
@@ -149,7 +149,7 @@ class DeviceFeeder:
         with self._lock:
             if not self._closed:
                 self._closed = True
-                self._q.put(_STOP)
+                self._q.put(_STOP)  # nnlint: disable=NNL003 non-blocking by invariant: the +1 queue slot is reserved for this sentinel
 
     def get(self, timeout: Optional[float] = None) -> Optional[Any]:
         item = self._q.get(timeout=timeout)

@@ -289,8 +289,12 @@ def worker_main(conn, spec: WorkerSpec) -> None:
     hb = _Heartbeat(conn, send_lock, spec.hb_interval_s)
     hb.start()
     if spec.crash_after_s is not None:
-        # chaos: die abruptly after t seconds (circuit-breaker tests)
-        threading.Timer(spec.crash_after_s, lambda: os._exit(3)).start()
+        # chaos: die abruptly after t seconds (circuit-breaker tests);
+        # daemon so a worker that drains cleanly first isn't held alive
+        # until the fuse fires
+        crash = threading.Timer(spec.crash_after_s, lambda: os._exit(3))
+        crash.daemon = True
+        crash.start()
 
     service = None
     try:

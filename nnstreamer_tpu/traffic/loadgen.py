@@ -449,7 +449,11 @@ def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,
                     pid = pool.kill_worker(None)
                 entry["pid"] = pid
 
-            timers.append(threading.Timer(t_k, do_kill))
+            t = threading.Timer(t_k, do_kill)
+            # cancelled in the finally below; daemon besides, so an
+            # exception between here and start() can't hang exit
+            t.daemon = True
+            timers.append(t)
 
         x = np.ones((8, 1), np.float32)
         for t in timers:

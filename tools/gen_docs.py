@@ -76,11 +76,17 @@ def render() -> str:
         # GitHub heading slugs preserve underscores
         parts.append(f"- [`{n}`](#{n})")
     parts.append("")
+    from nnstreamer_tpu.analysis.contract import contract_badges
+
     for n in names:
         cls = registry.get(PluginKind.ELEMENT, n)
         parts.append(f"### {n}")
         parts.append("")
         parts.append(f"*class `{cls.__module__}.{cls.__name__}`*")
+        parts.append("")
+        # the same introspection the scheduler and the NNL001 lint rule
+        # use — the docs cannot drift from the declared contract
+        parts.append(f"*contract: {contract_badges(cls)}*")
         parts.append("")
         doc = _doc(cls)
         if doc:

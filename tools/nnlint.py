@@ -1,0 +1,18 @@
+#!/usr/bin/env python3
+"""Direct entry for the project linter — same as
+`python -m nnstreamer_tpu lint` (see docs/static_analysis.md).
+
+Kept runnable from a clean checkout with no install: adds the repo
+root to sys.path, then delegates.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from nnstreamer_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
