@@ -1515,6 +1515,20 @@ def host_path() -> dict:
                               if f_off else 0.0)
     out["piped_fps"] = piped
     _family_partial(out)
+    # tracer cost A/B: the same fused pipeline with the Tracer ON.
+    # fusion_on above IS the tracer-off arm (runner default NULL_TRACER
+    # — tests/test_tracing.py pins that arm's hot path does zero
+    # tracing work), so the delta prices record_process + ring appends
+    # per frame. trace_overhead_pct also lands in the env snapshot:
+    # any artifact produced with tracing accidentally enabled carries
+    # the discount factor its FPS numbers need.
+    piped["traced"] = _Bench(
+        _build_label,
+        runner_kwargs={"chain_fusion": True, "trace": True}).run()
+    f_tr = piped["traced"].get("fps") or 0.0
+    piped["trace_overhead_pct"] = (round((f_on - f_tr) / f_on * 100, 1)
+                                   if f_on else 0.0)
+    _family_partial(out)
     # raw vs piped: the same model invoked straight on the backend with
     # no scheduler in the way — the denominator of the 100x host-path
     # gap (BENCH_r05: ~34k fps raw vs ~309 piped). piped_over_raw → 1.0
@@ -2270,6 +2284,13 @@ def main() -> int:
             _gate_env(env, errors)
         except Exception as e:
             errors["env"] = f"{type(e).__name__}: {e}"
+    # lift the host_path tracer A/B into the env snapshot: the tracing
+    # discount is environment context for EVERY family's numbers, not
+    # just host_path's
+    pct = (family_out.get("host_path") or {}).get(
+        "piped_fps", {}).get("trace_overhead_pct")
+    if pct is not None:
+        env["trace_overhead_pct"] = pct
 
     out = _assemble(family_out, errors, env, time.monotonic() - t0,
                     partial=False)

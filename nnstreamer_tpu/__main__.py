@@ -8,6 +8,15 @@
     python -m nnstreamer_tpu --stats '...pipeline...'  # per-element stats
     python -m nnstreamer_tpu trace '...pipeline...'    # traced run: report
                                                        #  + Chrome trace JSON
+    python -m nnstreamer_tpu trace --merge a.json b.json --out m.json
+                                                       # merge traces onto
+                                                       #  one timeline
+    python -m nnstreamer_tpu serve --workers 2 --metrics-port 9100
+                                                       # pool + /metrics
+                                                       #  exposition endpoint
+    python -m nnstreamer_tpu top http://127.0.0.1:9100/metrics
+                                                       # live terminal view
+                                                       #  over any /metrics
     python -m nnstreamer_tpu models list               # model store contents
     python -m nnstreamer_tpu models describe NAME      # versions/stats/swaps
     python -m nnstreamer_tpu models swap NAME [VER]    # hot swap
@@ -74,18 +83,45 @@ def _trace_main(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="nnstreamer_tpu trace",
         description="run a pipeline traced: element report + Chrome trace")
-    ap.add_argument("pipeline", help="pipeline description string")
+    ap.add_argument("pipeline", nargs="+",
+                    help="pipeline description string (with --merge: two "
+                         "or more Chrome-trace JSON files)")
     ap.add_argument("--out", default="trace.json", metavar="FILE",
                     help="Chrome-trace JSON output path (default trace.json)")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge already-written trace JSONs onto one "
+                         "timeline (distinct process track groups) "
+                         "instead of running a pipeline")
     ap.add_argument("--timeout", type=float, default=None,
                     help="max run seconds")
     ap.add_argument("--no-optimize", action="store_true",
                     help="disable transform-into-filter fusion")
     args = ap.parse_args(argv)
 
+    if args.merge:
+        import os
+
+        from nnstreamer_tpu.runtime.tracing import merge_chrome_traces
+
+        docs = []
+        for path in args.pipeline:
+            with open(path) as f:
+                docs.append(json.load(f))
+        merged = merge_chrome_traces(
+            docs, labels=[os.path.basename(p) for p in args.pipeline])
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        print(f"merged {len(docs)} trace(s) -> {args.out} "
+              f"({len(merged['traceEvents'])} events)", file=sys.stderr)
+        return 0
+    if len(args.pipeline) != 1:
+        print("trace takes one pipeline description (or --merge with "
+              "trace files)", file=sys.stderr)
+        return 2
+
     import nnstreamer_tpu as nns
 
-    pipe = nns.parse_launch(args.pipeline)
+    pipe = nns.parse_launch(args.pipeline[0])
     runner = nns.PipelineRunner(pipe, optimize=not args.no_optimize,
                                 trace=True)
     interrupted = False
@@ -260,11 +296,25 @@ def _serve_main(argv) -> int:
                              "deadline-drop"))
     ap.add_argument("--stats-every", type=float, default=0.0,
                     help="print pool stats JSON every N seconds")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve Prometheus text exposition on "
+                         "http://HOST:PORT/metrics (0 picks a free "
+                         "port; also turns on the pool tracer)")
+    ap.add_argument("--metrics-host", default="127.0.0.1")
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="write the merged multi-process Chrome trace "
+                         "here at drain (also turns on the pool tracer)")
     args = ap.parse_args(argv)
 
     from nnstreamer_tpu.serving.pool import PooledQueryServer
     from nnstreamer_tpu.serving.worker import WorkerSpec
 
+    tracer = None
+    if args.metrics_port is not None or args.trace_out:
+        from nnstreamer_tpu.runtime.tracing import Tracer
+
+        tracer = Tracer()
     if args.pipeline:
         spec = WorkerSpec(kind="pipeline", pipeline=args.pipeline,
                           dims=args.dims, types=args.types)
@@ -274,8 +324,25 @@ def _serve_main(argv) -> int:
     pqs = PooledQueryServer(
         spec, workers=args.workers, sid=args.id, host=args.host,
         port=args.port, max_pending=args.max_pending,
-        max_inflight=args.max_inflight, shed_policy=args.shed_policy)
+        max_inflight=args.max_inflight, shed_policy=args.shed_policy,
+        tracer=tracer)
     pqs.install_signal_handlers()
+    msrv = None
+    if args.metrics_port is not None:
+        from nnstreamer_tpu.serving.metrics import (
+            MetricsServer, metrics_snapshot)
+
+        def collect():
+            s = pqs.stats()
+            return metrics_snapshot(tracer=tracer,
+                                    admission=s.pop("admission"),
+                                    pool=s)
+
+        msrv = MetricsServer(collect, host=args.metrics_host,
+                             port=args.metrics_port,
+                             health=lambda: {"pool": pqs.stats()["pool"]})
+        print(f"metrics on http://{args.metrics_host}:{msrv.port}"
+              f"/metrics", file=sys.stderr)
     print(f"pool serving on {args.host}:{pqs.port} "
           f"({args.workers} worker(s); SIGTERM/^C drains)",
           file=sys.stderr)
@@ -292,6 +359,47 @@ def _serve_main(argv) -> int:
         pass
     finally:
         pqs.close()
+        if msrv is not None:
+            msrv.close()
+        if args.trace_out and tracer is not None:
+            with open(args.trace_out, "w") as f:
+                json.dump(tracer.to_chrome_trace("serve"), f)
+            print(f"chrome trace written to {args.trace_out}",
+                  file=sys.stderr)
+    return 0
+
+
+def _top_main(argv) -> int:
+    """`top` subcommand: live terminal view over any /metrics
+    exposition endpoint (serving/metrics.py) — counters as rates,
+    gauges as current values, refreshed in place."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu top",
+        description="live terminal view over a /metrics endpoint")
+    ap.add_argument("url", nargs="?", default=None,
+                    help="endpoint URL (or use --port for localhost)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="shorthand for http://127.0.0.1:PORT/metrics")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh seconds")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="stop after N refreshes (0 = until ^C)")
+    args = ap.parse_args(argv)
+
+    url = args.url
+    if url is None and args.port is not None:
+        url = f"http://127.0.0.1:{args.port}/metrics"
+    if url is None:
+        print("top needs a URL or --port", file=sys.stderr)
+        return 2
+
+    from nnstreamer_tpu.serving.metrics import top_view
+
+    try:
+        top_view(url, interval_s=args.interval,
+                 iterations=args.iterations)
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -344,6 +452,14 @@ def _traffic_main(argv) -> int:
                     help="number of staggered worker kills (--workers)")
     ap.add_argument("--json", action="store_true",
                     help="print the raw report JSON only")
+    ap.add_argument("--trace", action="store_true",
+                    help="give every request a trace context and print "
+                         "the per-hop latency decomposition of the "
+                         "worst-p99 request")
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="with --workers: run the pool traced and "
+                         "write the merged multi-process Chrome trace "
+                         "here (implies --trace)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -352,14 +468,30 @@ def _traffic_main(argv) -> int:
         bursty_arrivals, poisson_arrivals, run_against_echo,
         run_against_pool, run_open_loop)
 
+    if args.trace_out:
+        args.trace = True
     if args.workers > 0:
+        tracer = None
+        pool_kw = {}
+        if args.trace_out:
+            from nnstreamer_tpu.runtime.tracing import Tracer
+
+            tracer = Tracer()
+            pool_kw["tracer"] = tracer
         report = run_against_pool(
             pattern=args.pattern, load_x=args.load_x, n=args.requests,
             service_ms=args.service_ms, workers=args.workers,
             max_pending=args.max_pending, max_inflight=args.max_inflight,
             shed_policy=args.shed_policy,
             p99_budget_ms=args.budget_ms or 90.0, seed=args.seed,
-            kill_at_s=args.kill_at, kills=args.kills)
+            kill_at_s=args.kill_at, kills=args.kills,
+            trace=args.trace, **pool_kw)
+        if tracer is not None:
+            with open(args.trace_out, "w") as f:
+                json.dump(tracer.to_chrome_trace("traffic"), f)
+            print(f"chrome trace written to {args.trace_out} "
+                  f"(load in Perfetto or chrome://tracing)",
+                  file=sys.stderr)
     elif args.host is not None:
         if args.port is None:
             print("--host needs --port", file=sys.stderr)
@@ -380,19 +512,36 @@ def _traffic_main(argv) -> int:
             args.host, args.port, dims=args.dims, types=args.types,
             arrivals=arrivals,
             make_frame=lambda i: TensorBuffer.of(x, pts=i),
-            p99_budget_ms=args.budget_ms or 250.0)
+            p99_budget_ms=args.budget_ms or 250.0, trace=args.trace)
         report["seed"] = args.seed
     else:
         report = run_against_echo(
             pattern=args.pattern, load_x=args.load_x, n=args.requests,
             service_ms=args.service_ms, max_pending=args.max_pending,
             max_inflight=args.max_inflight, shed_policy=args.shed_policy,
-            p99_budget_ms=args.budget_ms, seed=args.seed)
+            p99_budget_ms=args.budget_ms, seed=args.seed,
+            trace=args.trace)
     if args.json:
         print(json.dumps(report, default=float))
         return 0
     tl = report.pop("queue_depth_timeline", None)
     print(json.dumps(report, indent=2, default=float))
+    hb = report.get("hop_breakdown")
+    if hb:
+        spans = hb.get("spans", {})
+        stages = [(k.replace("_ms", "").replace("_", " "), spans[k])
+                  for k in ("admission_wait_ms", "route_ms",
+                            "worker_queue_ms", "service_ms", "reply_ms")
+                  if spans.get(k) is not None]
+        parts = " + ".join(f"{name} {v:.2f}ms" for name, v in stages)
+        print(f"worst-p99 request (pts={hb['pts']}, "
+              f"trace={hb.get('trace_id')}): {hb['latency_ms']:.2f}ms"
+              + (f" = {parts}" if parts else "")
+              + (f" (+{spans['retries']} retry)"
+                 if spans.get("retries") else "")
+              + (f" (+{spans['redeliveries']} redelivery)"
+                 if spans.get("redeliveries") else ""),
+              file=sys.stderr)
     if tl:
         # crude depth-over-time sparkline so overload is visible at a
         # glance without loading the JSON anywhere
@@ -416,6 +565,8 @@ def main(argv=None) -> int:
         return _traffic_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
+    if argv and argv[0] == "top":
+        return _top_main(argv[1:])
     if argv and argv[0] == "lint":
         from nnstreamer_tpu.analysis.cli import main as lint_main
 

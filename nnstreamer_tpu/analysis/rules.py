@@ -142,7 +142,13 @@ class ForcedSync(Rule):
     EXEMPT = ("runtime/sync.py",)
     #: directories where a bare single-arg np.asarray is presumed to be
     #: a device readback (elements/decoders consume host arrays the
-    #: scheduler already resolved; the device-adjacent layers do not)
+    #: scheduler already resolved; the device-adjacent layers do not).
+    #: Deliberately NOT listed: serving/ — the metrics/exposition plane
+    #: (serving/metrics.py) and the pool router are host-only code that
+    #: read counters under their own locks and never hold a device
+    #: array, so a bare asarray there is a plain host copy, not a
+    #: hidden sync. Widening this to serving/ would force the blessed
+    #: device_sync idiom onto code with no device to sync.
     ASARRAY_DIRS = ("backends/", "runtime/")
 
     def check(self, module: Module, project: Project):

@@ -31,7 +31,8 @@ from nnstreamer_tpu.edge import protocol as P
 from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
 from nnstreamer_tpu.graph.pipeline import (
     Element, Emission, PropDef, SinkElement, SourceElement, StreamSpec)
-from nnstreamer_tpu.runtime.tracing import NULL_TRACER, percentile
+from nnstreamer_tpu.runtime.tracing import (
+    NULL_TRACER, ensure_trace_ctx, get_trace_ctx, percentile, stamp_hop)
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 from nnstreamer_tpu.tensor.info import TensorsSpec
 from nnstreamer_tpu.traffic.admission import AdmissionQueue
@@ -184,6 +185,15 @@ class QueryServer:
         # even if the client has meanwhile vanished — completion
         # accounting must balance admission accounting
         self.frames.note_replied()
+        stamp_hop(buf.meta, "reply")
+        if self.tracer.active:
+            ctx = get_trace_ctx(buf.meta)
+            if ctx is not None:
+                # server-side end of this request's timeline: the full
+                # hop list (admission→worker→reply) as it leaves us
+                self.tracer.record_request(
+                    f"query_server_{self.sid}", ctx["id"], ctx["hops"],
+                    time.perf_counter(), pts=buf.pts)
         conn = self.server.connection(client_id) if self.server else None
         if conn is None:
             log.warning("server %d: client %d gone, dropping result",
@@ -570,6 +580,15 @@ class TensorQueryClient(Element):
         pts, t_send = self._pending.popleft()
         out, _ = decode_buffer(payload)
         out.meta.pop("client_id", None)
+        stamp_hop(out.meta, "client_recv")
+        if self._tracer.active:
+            ctx = get_trace_ctx(out.meta)
+            if ctx is not None:
+                # client-side end of the timeline: includes the wire
+                # round trip the server-side record cannot see
+                self._tracer.record_request(
+                    self.name, ctx["id"], ctx["hops"],
+                    time.perf_counter(), pts=pts)
         # integrity check for the pipelined window: the reply echoes the
         # request's pts on the wire, so a server-side frame drop cannot
         # silently shift every later reply onto the wrong frame
@@ -588,6 +607,13 @@ class TensorQueryClient(Element):
         # frame is sent: under retry the re-invoked process() sends it
         # exactly once, so no frame is ever duplicated on the wire
         self._raise_stashed()
+        if self._tracer.active:
+            # get-or-create: a BUSY retry re-invokes process() with the
+            # SAME buffer, so the existing context (and its id) is kept
+            # and this send appends a second client_send hop — the
+            # retry-reuses-id invariant the regression tests pin
+            ensure_trace_ctx(buf.meta)
+        stamp_hop(buf.meta, "client_send", pts=buf.pts)
         self._client.send(P.T_DATA, encode_buffer(buf))
         self._pending.append((buf.pts, time.perf_counter()))
         self._sent += 1

@@ -35,21 +35,60 @@ _HEAD = struct.Struct("<IIqQI")  # magic, num, pts, client_id, meta_len
 MAX_FRAME_BYTES = 1 << 31
 
 
-def _meta_to_json(meta: dict) -> dict:
-    """JSON-able meta. Arrays (decoder outputs: boxes/keypoints/class_map)
-    ride as base64'd payloads so the documented meta contract survives
-    the wire; unserializable values are dropped with a log line."""
+#: recursion guard for nested meta (a trace context is depth 3:
+#: ctx → hops list → hop dict; 8 leaves headroom without letting a
+#: pathological self-referential meta spin the encoder)
+_MAX_META_DEPTH = 8
+
+
+def _jsonable(v, depth: int):
+    """JSON-safe view of one meta value, recursing through dicts/lists
+    (the trace context rides meta as nested dicts — dropping composites
+    would silently sever every cross-process timeline). Returns the
+    sentinel `_DROP` for unserializable values."""
     import base64
 
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, np.ndarray):
+        return {"__nd__": True, "dtype": str(v.dtype),
+                "shape": list(v.shape),
+                "b64": base64.b64encode(
+                    np.ascontiguousarray(v).tobytes()).decode()}
+    if depth >= _MAX_META_DEPTH:
+        return _DROP
+    if isinstance(v, dict):
+        out = {}
+        for k, item in v.items():
+            if not isinstance(k, str):
+                return _DROP
+            j = _jsonable(item, depth + 1)
+            if j is not _DROP:
+                out[k] = j
+        return out
+    if isinstance(v, (list, tuple)):
+        items = []
+        for item in v:
+            j = _jsonable(item, depth + 1)
+            if j is not _DROP:
+                items.append(j)
+        return items
+    return _DROP
+
+
+_DROP = object()
+
+
+def _meta_to_json(meta: dict) -> dict:
+    """JSON-able meta. Arrays (decoder outputs: boxes/keypoints/class_map)
+    ride as base64'd payloads, and nested dicts/lists (trace context)
+    pass through recursively, so the documented meta contract survives
+    the wire; unserializable values are dropped with a log line."""
     out = {}
     for k, v in meta.items():
-        if isinstance(v, (str, int, float, bool)) or v is None:
-            out[k] = v
-        elif isinstance(v, np.ndarray):
-            out[k] = {"__nd__": True, "dtype": str(v.dtype),
-                      "shape": list(v.shape),
-                      "b64": base64.b64encode(
-                          np.ascontiguousarray(v).tobytes()).decode()}
+        j = _jsonable(v, 0)
+        if j is not _DROP:
+            out[k] = j
         else:
             from nnstreamer_tpu.core.log import get_logger
 

@@ -29,12 +29,34 @@ Export: `to_chrome_trace()` emits the Trace Event Format JSON that
 chrome://tracing and Perfetto load — one named track (tid) per element
 thread, "X" complete spans for process/timer/flush/backend work, "C"
 counters for queue depth, "i" instants for EOS/drops/batch flushes.
+
+Distributed tracing (docs/observability.md §distributed):
+
+- **trace context** — a request-scoped `trace_id` + hop-stamp list that
+  rides frame meta (`meta["_trace_ctx"]`, wire-serializable JSON) from
+  the query client through admission, the pool router, the worker pipe,
+  the worker's pipeline, and back in the reply. `ensure_trace_ctx`
+  creates it exactly once per request (a BUSY retry or a pool
+  redelivery REUSES the id — new hops, never a fresh id); `stamp_hop`
+  appends one `{hop, t, pid}` record and is a no-op when no context
+  rides the buffer, so untraced traffic pays one dict lookup.
+- **child tracers** — a worker process runs its own `Tracer` and ships
+  `ship_delta()` payloads (drained event batches + monotone counter /
+  histogram deltas) over its pipe; the parent's `ingest_child` merges
+  them with a per-worker clock offset sampled at handshake, so
+  `to_chrome_trace()` renders one Perfetto *process* (track group) per
+  worker and `summary()` is pool-wide. Counter merging is delta-based,
+  which makes parent totals monotone across worker restarts (a fresh
+  worker simply resumes contributing deltas from zero).
 """
 
 from __future__ import annotations
 
+import bisect
 import math
+import os
 import time
+import uuid
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
@@ -43,6 +65,138 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 #: `with_tensors` copies meta, and tensor_batch carries per-frame metas
 #: through `dyn_batch.frames`, so the stamp survives every element.
 SOURCE_TS_META = "_trace_src_ts"
+
+#: TensorBuffer.meta key carrying the request-scoped trace context:
+#: ``{"id": <16-hex>, "hops": [{"hop": str, "t": float, "pid": int,
+#: ...extra}, ...]}``. Everything inside is wire-JSON-safe (edge/wire.py
+#: serializes nested dicts/lists), so the context crosses the query
+#: wire and the worker pipe intact and comes back in the reply.
+TRACE_CTX_META = "_trace_ctx"
+
+
+def new_trace_id() -> str:
+    """16-hex request id (random, collision-safe at serving scale)."""
+    return uuid.uuid4().hex[:16]
+
+
+def ensure_trace_ctx(meta: dict, trace_id: Optional[str] = None) -> dict:
+    """Get-or-create the trace context in `meta`. Creation happens at
+    most once per request: a retry path re-offering the SAME buffer
+    finds the existing context and keeps its id — the invariant the
+    retry regression tests pin."""
+    ctx = meta.get(TRACE_CTX_META)
+    if not isinstance(ctx, dict) or "id" not in ctx:
+        ctx = meta[TRACE_CTX_META] = {
+            "id": trace_id or new_trace_id(), "hops": []}
+    elif not isinstance(ctx.get("hops"), list):
+        ctx["hops"] = []
+    return ctx
+
+
+def get_trace_ctx(meta) -> Optional[dict]:
+    """The trace context riding `meta`, or None (never creates)."""
+    if not isinstance(meta, dict):
+        return None
+    ctx = meta.get(TRACE_CTX_META)
+    return ctx if isinstance(ctx, dict) and "id" in ctx else None
+
+
+def stamp_hop(meta, hop: str, t: Optional[float] = None,
+              **extra) -> Optional[dict]:
+    """Append one hop record to the trace context in `meta` — a no-op
+    (one dict lookup) when no context rides the buffer, so stamping
+    sites can live on the hot path unguarded. Returns the hop record
+    (or None). Timestamps are `time.perf_counter()` seconds; on Linux
+    that is CLOCK_MONOTONIC, shared by every process on the host — the
+    per-worker handshake offsets correct any residual skew."""
+    ctx = get_trace_ctx(meta)
+    if ctx is None:
+        return None
+    rec = {"hop": hop, "t": time.perf_counter() if t is None else t,
+           "pid": os.getpid()}
+    if extra:
+        rec.update(extra)
+    ctx["hops"].append(rec)
+    return rec
+
+
+#: canonical serving-path hop order (docs/observability.md schema);
+#: hop_spans() derives the per-stage decomposition from it
+HOP_STAGES = (
+    ("admission_wait_ms", "admit", "dequeue"),
+    ("route_ms", "dequeue", "dispatch"),
+    ("worker_queue_ms", "dispatch", "worker_recv"),
+    ("service_ms", "worker_recv", "worker_done"),
+    ("reply_ms", "worker_done", "reply"),
+)
+
+
+def hop_spans(hops: List[dict]) -> Dict[str, float]:
+    """Per-stage latency decomposition (ms) from a hop list: admission
+    wait / route / worker queue / service / reply, plus total. For a
+    redelivered request the LAST occurrence of each hop wins (the
+    attempt that produced the reply); earlier occurrences show up in
+    `retries`/`redeliveries` counts instead of corrupting the stage
+    math."""
+    last: Dict[str, dict] = {}
+    for h in hops:
+        if isinstance(h, dict) and "hop" in h and "t" in h:
+            last[h["hop"]] = h
+    out: Dict[str, float] = {}
+    for key, a, b in HOP_STAGES:
+        if a in last and b in last:
+            dt = (last[b]["t"] - last[a]["t"]) * 1e3
+            if dt >= 0:
+                out[key] = round(dt, 3)
+    ts = [h["t"] for h in hops
+          if isinstance(h, dict) and "t" in h]
+    if len(ts) >= 2:
+        out["total_ms"] = round((max(ts) - min(ts)) * 1e3, 3)
+    n_send = sum(1 for h in hops if isinstance(h, dict)
+                 and h.get("hop") == "client_send")
+    if n_send > 1:
+        out["retries"] = n_send - 1
+    n_re = sum(1 for h in hops if isinstance(h, dict)
+               and h.get("hop") == "reoffer")
+    if n_re:
+        out["redeliveries"] = n_re
+    return out
+
+
+#: histogram bucket upper bounds (seconds) for per-element proctime —
+#: log-spaced 10µs → 10s, the range a pipeline stage can plausibly
+#: occupy; rendered as Prometheus `le` buckets by serving/metrics.py
+HIST_BOUNDS_S = tuple(
+    round(10.0 ** (e / 3.0), 9) for e in range(-15, 4))  # 1e-5 .. 10.0
+
+
+class _Hist:
+    """Fixed-bound cumulative histogram: monotone counts (never
+    recomputed from a windowed reservoir — two consecutive metric
+    scrapes must never see a bucket count decrease)."""
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self):
+        self.counts = [0] * (len(HIST_BOUNDS_S) + 1)   # +1 = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def record(self, v: float) -> None:
+        self.counts[bisect.bisect_left(HIST_BOUNDS_S, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def add_counts(self, counts: List[int], s: float, n: int) -> None:
+        for i, c in enumerate(counts[:len(self.counts)]):
+            self.counts[i] += c
+        self.sum += s
+        self.count += n
+
+    def snapshot(self) -> dict:
+        return {"bounds": list(HIST_BOUNDS_S),
+                "counts": list(self.counts),
+                "sum": self.sum, "count": self.count}
 
 
 def percentile(sorted_vals: List[float], p: float) -> float:
@@ -111,6 +265,9 @@ class NullTracer:
     def record_worker_event(self, name, wid, kind, t, **args):
         pass
 
+    def record_request(self, name, trace_id, hops, t, **args):
+        pass
+
     def instant(self, name, label, t=None, **args):
         pass
 
@@ -164,6 +321,27 @@ class Tracer:
         # a post-mortem needs the full spawn/kill/restart/degraded
         # sequence even after a chaos run wraps the ring
         self._worker_events: List[Tuple[str, int, str, float, dict]] = []
+        # element name -> cumulative proctime histogram (seconds).
+        # Cumulative by construction so the metrics plane can render
+        # Prometheus buckets that never decrease between scrapes.
+        self._hists: Dict[str, _Hist] = {}
+        # completed request timelines (name, trace_id, t_done, hops,
+        # args): kept whole (bounded) so end-to-end timelines survive
+        # ring wrap; rendered as async b/n/e tracks in to_chrome_trace
+        self._requests: List[Tuple[str, str, float, list, dict]] = []
+        self._max_requests = 4096
+        self._requests_dropped = 0
+        # -- worker-side shipping state (enable_shipping/ship_delta) --
+        self._shipping = False
+        self._ship_samples: Dict[str, List[float]] = {}
+        self._shipped_events = 0
+        self._ship_prev: Dict[str, Any] = {}
+        # -- parent-side child-merge state (ingest_child) --
+        # wid -> ring of offset-adjusted child events (own drop budget,
+        # so a wrapped parent ring never silently eats child telemetry)
+        self._child_events: Dict[int, Deque[_Event]] = {}
+        self._child_meta: Dict[int, dict] = {}
+        self._child_max_events = max(1024, max_events // 4)
 
     # -- scheduler hooks ---------------------------------------------------
     def source_emit(self, name: str, buf, t: float) -> None:
@@ -181,6 +359,10 @@ class Tracer:
 
     def record_process(self, name: str, buf, t0: float, t1: float) -> None:
         self._append("X", "element", name, "process", t0, t1 - t0, None)
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = _Hist()
+        h.record(t1 - t0)
         src_ts = self._buf_source_ts(buf)
         if src_ts is not None:
             r = self._interlat.get(name)
@@ -188,6 +370,12 @@ class Tracer:
                 r = self._interlat[name] = deque(
                     maxlen=self._max_latency_samples)
             r.append(t1 - src_ts)
+            if self._shipping:
+                s = self._ship_samples.get(name)
+                if s is None:
+                    s = self._ship_samples[name] = []
+                if len(s) < self._max_latency_samples:
+                    s.append(t1 - src_ts)
 
     def record_timer(self, name: str, t0: float, t1: float) -> None:
         self._append("X", "element", name, "timer", t0, t1 - t0, None)
@@ -311,11 +499,227 @@ class Tracer:
             c[kind] = c.get(kind, 0) + 1
         return out
 
+    def record_request(self, name: str, trace_id: str, hops: List[dict],
+                       t: float, **args) -> None:
+        """One completed request timeline: `hops` is the trace-context
+        hop list that came back with the reply (edge/query.py or
+        serving/pool.py). Kept whole (bounded FIFO) so timelines
+        survive ring wrap; to_chrome_trace renders each as an async
+        b/n/e track keyed by trace_id."""
+        if len(self._requests) >= self._max_requests:
+            del self._requests[:self._max_requests // 4]
+            self._requests_dropped += self._max_requests // 4
+        self._requests.append(
+            (name, trace_id, t, [dict(h) for h in hops
+                                 if isinstance(h, dict)], dict(args)))
+        self._append("i", "request", name, "request_done", t, 0.0,
+                     dict(args, trace_id=trace_id, hops=len(hops)))
+
+    def requests(self) -> List[Tuple[str, str, float, list, dict]]:
+        return list(self._requests)
+
     def instant(self, name: str, label: str, t: Optional[float] = None,
                 **args) -> None:
         if t is None:
             t = time.perf_counter()
         self._append("i", "element", name, label, t, 0.0, args or None)
+
+    # -- worker-side shipping ----------------------------------------------
+    def enable_shipping(self) -> None:
+        """Mark this tracer as a worker-side child that will be drained
+        by periodic `ship_delta()` calls (serving/worker.py heartbeat
+        thread). Turns on the interlatency sample side-buffer; without
+        shipping enabled that buffer is never touched."""
+        self._shipping = True
+
+    def ship_delta(self) -> Optional[dict]:
+        """Drain everything recorded since the last ship into one
+        picklable payload for the supervisor pipe, or None when nothing
+        happened. Counters and histograms ship as DELTAS, not
+        cumulative values: the parent adds them, which keeps pool-level
+        totals monotone across worker restarts (a replacement worker
+        simply resumes contributing deltas from zero)."""
+        prev = self._ship_prev
+        payload: Dict[str, Any] = {}
+
+        events = []
+        try:
+            while True:
+                events.append(self._events.popleft())
+        except IndexError:
+            pass
+        if events:
+            self._shipped_events += len(events)
+            payload["events"] = events
+        total_prev = prev.get("total_events", 0)
+        if self._total_events != total_prev:
+            payload["events_total_delta"] = self._total_events - total_prev
+            prev["total_events"] = self._total_events
+        dropped = max(0, self._total_events - self._shipped_events
+                      - len(self._events))
+        drop_prev = prev.get("events_dropped", 0)
+        if dropped != drop_prev:
+            payload["events_dropped_delta"] = dropped - drop_prev
+            prev["events_dropped"] = dropped
+
+        hist_prev = prev.setdefault("hists", {})
+        hist_out = {}
+        for name, h in self._hists.items():
+            p = hist_prev.get(name)
+            if p is None:
+                p = hist_prev[name] = {
+                    "counts": [0] * len(h.counts), "sum": 0.0, "count": 0}
+            if h.count != p["count"]:
+                hist_out[name] = {
+                    "counts": [c - pc for c, pc
+                               in zip(h.counts, p["counts"])],
+                    "sum": h.sum - p["sum"],
+                    "count": h.count - p["count"],
+                }
+                p["counts"] = list(h.counts)
+                p["sum"], p["count"] = h.sum, h.count
+        if hist_out:
+            payload["hists"] = hist_out
+
+        forced_prev = prev.setdefault("forced", {})
+        forced_out = {}
+        for name, n in self._forced.items():
+            d = n - forced_prev.get(name, 0)
+            if d:
+                forced_out[name] = d
+                forced_prev[name] = n
+        if forced_out:
+            payload["forced"] = forced_out
+
+        shed_prev = prev.setdefault("sheds", {})
+        shed_out: Dict[str, Dict[str, int]] = {}
+        for name, causes in self._sheds.items():
+            p = shed_prev.setdefault(name, {})
+            for cause, n in causes.items():
+                d = n - p.get(cause, 0)
+                if d:
+                    shed_out.setdefault(name, {})[cause] = d
+                    p[cause] = n
+        if shed_out:
+            payload["sheds"] = shed_out
+
+        if self._ship_samples:
+            payload["interlat"] = self._ship_samples
+            self._ship_samples = {}
+
+        for key, src in (("swaps", self._swaps),
+                         ("worker_events", self._worker_events),
+                         ("requests", self._requests)):
+            i = prev.get(f"n_{key}", 0)
+            if len(src) > i:
+                payload[key] = src[i:]
+                prev[f"n_{key}"] = len(src)
+
+        gauges = {name: g["peak"] for name, g in self._gauges.items()}
+        if gauges != prev.get("gauges"):
+            payload["gauges"] = gauges
+            prev["gauges"] = dict(gauges)
+        inflight = {name: g["peak"] for name, g in self._inflight.items()}
+        if inflight != prev.get("inflight"):
+            payload["inflight"] = inflight
+            prev["inflight"] = dict(inflight)
+
+        return payload or None
+
+    # -- parent-side child merge -------------------------------------------
+    def ingest_child(self, wid: int, pid: int, payload: dict,
+                     offset_s: float = 0.0,
+                     label: Optional[str] = None) -> None:
+        """Merge one `ship_delta()` payload from worker slot `wid`.
+        Child element names are namespaced `w{wid}/` so per-element
+        stats never collide across workers; child events land in a
+        per-worker ring (own drop budget) with `offset_s` applied, so a
+        wrapped parent ring never silently eats child telemetry and
+        `to_chrome_trace()` can render one process track group per
+        worker."""
+        meta = self._child_meta.get(wid)
+        if meta is None:
+            meta = self._child_meta[wid] = {
+                "pid": pid, "label": label or f"worker{wid}",
+                "offset_s": offset_s, "events_total": 0,
+                "events_dropped_child": 0, "batches": 0}
+        else:
+            # a restarted slot reuses the ring but tracks the new pid
+            meta["pid"] = pid
+            meta["offset_s"] = offset_s
+            if label:
+                meta["label"] = label
+        meta["batches"] += 1
+        pfx = f"w{wid}/"
+
+        events = payload.get("events")
+        if events:
+            ring = self._child_events.get(wid)
+            if ring is None:
+                ring = self._child_events[wid] = deque(
+                    maxlen=self._child_max_events)
+            for ev in events:
+                ph, cat, name, lbl, ts, dur, args = ev
+                ring.append((ph, cat, name, lbl, ts + offset_s, dur,
+                             args))
+            meta["events_total"] += len(events)
+        meta["events_dropped_child"] += payload.get(
+            "events_dropped_delta", 0)
+
+        for name, h in payload.get("hists", {}).items():
+            dst = self._hists.get(pfx + name)
+            if dst is None:
+                dst = self._hists[pfx + name] = _Hist()
+            dst.add_counts(h["counts"], h["sum"], h["count"])
+
+        for name, d in payload.get("forced", {}).items():
+            key = pfx + name
+            self._forced[key] = self._forced.get(key, 0) + d
+
+        for name, causes in payload.get("sheds", {}).items():
+            c = self._sheds.setdefault(pfx + name, {})
+            for cause, d in causes.items():
+                c[cause] = c.get(cause, 0) + d
+
+        for name, samples in payload.get("interlat", {}).items():
+            r = self._interlat.get(pfx + name)
+            if r is None:
+                r = self._interlat[pfx + name] = deque(
+                    maxlen=self._max_latency_samples)
+            r.extend(samples)
+
+        for name, t, args in payload.get("swaps", ()):
+            self._swaps.append((pfx + name, t + offset_s, dict(args)))
+        for name, w, kind, t, args in payload.get("worker_events", ()):
+            self._worker_events.append(
+                (pfx + name, w, kind, t + offset_s, dict(args)))
+        for name, tid_, t, hops, args in payload.get("requests", ()):
+            self.record_request(pfx + name, tid_, hops, t + offset_s,
+                                **args)
+
+        for name, peak in payload.get("gauges", {}).items():
+            g = self._gauges.setdefault(pfx + name, {"peak": 0})
+            if peak > g["peak"]:
+                g["peak"] = peak
+        for name, peak in payload.get("inflight", {}).items():
+            g = self._inflight.setdefault(pfx + name, {"peak": 0})
+            if peak > g["peak"]:
+                g["peak"] = peak
+
+    def children(self) -> Dict[int, dict]:
+        """Per-worker merge bookkeeping: pid, label, clock offset,
+        events ingested, and the two drop budgets (child-reported +
+        parent-ring)."""
+        out = {}
+        for wid, meta in self._child_meta.items():
+            m = dict(meta)
+            ring = self._child_events.get(wid)
+            kept = len(ring) if ring is not None else 0
+            m["events_kept"] = kept
+            m["events_dropped"] = (m["events_dropped_child"]
+                                   + max(0, m["events_total"] - kept))
+            out[wid] = m
+        return out
 
     # -- internals ---------------------------------------------------------
     def _append(self, ph: str, cat: str, name: str, label: str,
@@ -357,8 +761,33 @@ class Tracer:
         return list(self._events)
 
     @property
+    def total_events(self) -> int:
+        """Monotone count of every event ever recorded in the tree
+        (never decreases when the ring wraps — the metrics-plane
+        counter; ring length is `len(events())`)."""
+        n = self._total_events
+        for m in self._child_meta.values():
+            n += m["events_total"]
+        return n
+
+    @property
     def events_dropped(self) -> int:
-        return max(0, self._total_events - len(self._events))
+        """Events lost anywhere in the tree: this ring's wrap losses
+        (events shipped to a parent are NOT drops) plus, on a pool
+        parent, every child's wrap losses — child-reported and
+        parent-ring alike. The cross-process ring-wrap tests pin this
+        staying exact."""
+        own = max(0, self._total_events - self._shipped_events
+                  - len(self._events))
+        for m in self.children().values():
+            own += m["events_dropped"]
+        return own
+
+    def hists(self) -> Dict[str, dict]:
+        """Per-element cumulative proctime histograms (snapshot dicts);
+        on a pool parent, includes `w{wid}/`-prefixed merged child
+        histograms."""
+        return {name: h.snapshot() for name, h in self._hists.items()}
 
     def interlatency(self) -> Dict[str, dict]:
         """Per-element end-to-end latency percentiles (ms) from source
@@ -392,46 +821,126 @@ class Tracer:
             "inflight": self.inflight_gauges(),
             "sheds": self.shed_counts(),
             "workers": self.worker_counts(),
+            "requests": len(self._requests) + self._requests_dropped,
+            "children": {str(wid): m
+                         for wid, m in self.children().items()},
         }
 
     def to_chrome_trace(self, pipeline_name: str = "pipeline") -> dict:
         """Trace Event Format dict — `json.dump` it and load the file in
-        Perfetto or chrome://tracing. One track (tid) per element, in
-        order of first appearance; ts/dur in µs relative to tracer
-        creation."""
-        trace: List[dict] = [{
-            "ph": "M", "name": "process_name", "pid": 0, "tid": 0,
-            "args": {"name": pipeline_name},
-        }]
-        tids: Dict[str, int] = {}
+        Perfetto or chrome://tracing.
 
-        def tid_of(name: str) -> int:
+        Track layout: pid 0 is this process (one tid per element, in
+        order of first appearance); each ingested worker gets its own
+        pid (= wid + 1) and so renders as its own Perfetto *process*
+        track group, named from the handshake label. Completed request
+        timelines render as async b/n/e events keyed by trace_id on a
+        dedicated "requests" track, one "n" instant per hop — the
+        end-to-end admission→worker→reply view. ts/dur in µs relative
+        to tracer creation."""
+        trace: List[dict] = []
+        tids_by_pid: Dict[int, Dict[str, int]] = {}
+
+        def add_process(pid: int, pname: str) -> None:
+            trace.append({"ph": "M", "name": "process_name",
+                          "pid": pid, "tid": 0,
+                          "args": {"name": pname}})
+
+        def tid_of(pid: int, name: str) -> int:
+            tids = tids_by_pid.setdefault(pid, {})
             t = tids.get(name)
             if t is None:
                 t = tids[name] = len(tids) + 1
                 trace.append({"ph": "M", "name": "thread_name",
-                              "pid": 0, "tid": t,
+                              "pid": pid, "tid": t,
                               "args": {"name": name}})
             return t
 
-        for ph, cat, name, label, ts, dur, args in list(self._events):
-            us = round((ts - self._t0) * 1e6, 3)
-            if ph == "X":
-                ev = {"ph": "X", "cat": cat, "name": label, "pid": 0,
-                      "tid": tid_of(name), "ts": us,
-                      "dur": round(dur * 1e6, 3)}
-                if args:
-                    ev["args"] = dict(args)
-            elif ph == "C":
-                track = ("inflight" if cat == "inflight"
-                         else "queue")
-                ev = {"ph": "C", "cat": cat, "name": f"{track}:{name}",
-                      "pid": 0, "tid": 0, "ts": us,
-                      "args": {"depth": args}}
-            else:  # "i" instant, scoped to the element's thread track
-                ev = {"ph": "i", "cat": cat, "name": label, "pid": 0,
-                      "tid": tid_of(name), "ts": us, "s": "t"}
-                if args:
-                    ev["args"] = dict(args)
-            trace.append(ev)
+        def emit(pid: int, events) -> None:
+            for ph, cat, name, label, ts, dur, args in events:
+                us = round((ts - self._t0) * 1e6, 3)
+                if ph == "X":
+                    ev = {"ph": "X", "cat": cat, "name": label,
+                          "pid": pid, "tid": tid_of(pid, name),
+                          "ts": us, "dur": round(dur * 1e6, 3)}
+                    if args:
+                        ev["args"] = dict(args)
+                elif ph == "C":
+                    track = ("inflight" if cat == "inflight"
+                             else "queue")
+                    ev = {"ph": "C", "cat": cat,
+                          "name": f"{track}:{name}",
+                          "pid": pid, "tid": 0, "ts": us,
+                          "args": {"depth": args}}
+                else:  # "i" instant, scoped to the element's track
+                    ev = {"ph": "i", "cat": cat, "name": label,
+                          "pid": pid, "tid": tid_of(pid, name),
+                          "ts": us, "s": "t"}
+                    if args:
+                        ev["args"] = dict(args)
+                trace.append(ev)
+
+        add_process(0, pipeline_name)
+        emit(0, list(self._events))
+        for wid in sorted(self._child_events):
+            meta = self._child_meta.get(wid, {})
+            add_process(wid + 1,
+                        f"{meta.get('label', f'worker{wid}')} "
+                        f"(pid {meta.get('pid', '?')})")
+            emit(wid + 1, list(self._child_events[wid]))
+
+        # async request timelines: one b/n.../e chain per trace_id on
+        # the parent's "requests" track; hop name + stamping pid in args
+        req_tid = None
+        for name, trace_id, _t, hops, rargs in self._requests:
+            ts_hops = [h for h in hops if "t" in h]
+            if len(ts_hops) < 2:
+                continue
+            if req_tid is None:
+                req_tid = tid_of(0, "requests")
+            ts0 = min(h["t"] for h in ts_hops)
+            ts1 = max(h["t"] for h in ts_hops)
+            base = {"cat": "request", "id": trace_id, "pid": 0,
+                    "tid": req_tid, "name": f"req:{trace_id}"}
+            trace.append(dict(
+                base, ph="b", ts=round((ts0 - self._t0) * 1e6, 3),
+                args=dict(rargs, server=name)))
+            for h in sorted(ts_hops, key=lambda h: h["t"]):
+                extra = {k: v for k, v in h.items()
+                         if k not in ("hop", "t")}
+                trace.append(dict(
+                    base, ph="n",
+                    ts=round((h["t"] - self._t0) * 1e6, 3),
+                    args=dict(extra, hop=h.get("hop", "?"))))
+            trace.append(dict(
+                base, ph="e", ts=round((ts1 - self._t0) * 1e6, 3)))
         return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def merge_chrome_traces(docs: List[dict],
+                        labels: Optional[List[str]] = None) -> dict:
+    """Merge several Trace Event Format documents (each from
+    `to_chrome_trace`) into one, remapping pids so every input keeps
+    its own process track groups — the `trace --merge` CLI. `labels`
+    (optional, parallel to `docs`) prefix each input's process names so
+    the Perfetto sidebar says which file a track came from."""
+    merged: List[dict] = []
+    base = 0
+    for i, doc in enumerate(docs):
+        events = doc.get("traceEvents", []) if isinstance(doc, dict) \
+            else list(doc)
+        label = labels[i] if labels and i < len(labels) else None
+        top = 0
+        for ev in events:
+            pid = ev.get("pid", 0)
+            top = max(top, pid if isinstance(pid, int) else 0)
+            ev = dict(ev, pid=(pid if isinstance(pid, int) else 0)
+                      + base)
+            if (label and ev.get("ph") == "M"
+                    and ev.get("name") == "process_name"):
+                args = dict(ev.get("args") or {})
+                args["name"] = f"{label}/{args.get('name', '?')}"
+                ev["args"] = args
+            merged.append(ev)
+        base += top + 1
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}

@@ -1,0 +1,448 @@
+"""Pull-based metrics plane: typed series → Prometheus text → HTTP.
+
+The third leg of the observability tentpole (docs/observability.md):
+`metrics_snapshot()` flattens everything the runtime already counts —
+tracer histograms/counters (runtime/tracing.py), admission conservation
+counters (traffic/admission.py), pool supervision stats
+(serving/pool.py), and any extra numeric gauges the caller owns — into
+typed counter/gauge/histogram series; `render_prometheus()` turns them
+into the text exposition format; `MetricsServer` serves them over a
+tiny stdlib HTTP endpoint (``GET /metrics``); `top_view()` scrapes any
+such endpoint and renders a live terminal table (`python -m
+nnstreamer_tpu top`).
+
+Monotonicity contract (pinned by tests/test_metrics.py): every series
+typed ``counter`` here is backed by a cumulative source — admission
+totals, pool lifetime counters, the tracer's delta-merged child
+counters and fixed-bound cumulative histograms — so two consecutive
+scrapes under load NEVER see a counter or histogram bucket decrease.
+Anything windowed (ring length, queue depth, percentiles) is typed
+``gauge``.
+
+The HTTP handler is deliberately dependency-free (http.server from the
+stdlib) and runs entirely host-side: it reads counters under their own
+locks and never touches device state, so it sits outside the
+device-adjacent sync rules nnlint enforces (NNL002 scope note in
+analysis/rules.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nnstreamer_tpu.core.log import get_logger
+
+log = get_logger("serving.metrics")
+
+#: one exposition series: type is counter | gauge | histogram; samples
+#: are (labels, value) pairs — value is a float for counter/gauge and a
+#: {"bounds", "counts", "sum", "count"} dict (tracing._Hist.snapshot
+#: layout, per-bucket counts) for histogram
+Series = Dict[str, Any]
+
+_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _series(name: str, typ: str, help_: str,
+            samples: List[Tuple[Dict[str, str], Any]]) -> Series:
+    return {"name": name, "type": typ, "help": help_, "samples": samples}
+
+
+def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
+                     pool: Optional[dict] = None,
+                     extra: Optional[Dict[str, float]] = None,
+                     namespace: str = "nns") -> List[Series]:
+    """Flatten runtime state into typed series.
+
+    tracer     — a runtime.tracing.Tracer (ignored when None/inactive)
+    admission  — AdmissionQueue.counters() snapshot
+    pool       — WorkerPool.stats() snapshot
+    extra      — arbitrary numeric gauges {name: value} the caller owns
+                 (backend cache sizes, build info, …)
+    """
+    ns = namespace
+    out: List[Series] = []
+
+    if admission:
+        for key, help_ in (("offered", "requests seen at the door"),
+                           ("admitted", "requests admitted"),
+                           ("replied", "requests answered with RESULT")):
+            out.append(_series(f"{ns}_admission_{key}_total", "counter",
+                               f"admission: {help_}",
+                               [({}, float(admission[key]))]))
+        out.append(_series(
+            f"{ns}_admission_rejected_total", "counter",
+            "at-the-door refusals by cause (BUSY, never queued)",
+            [({"cause": c}, float(v))
+             for c, v in sorted(admission["rejected"].items())] or
+            [({"cause": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_admission_shed_total", "counter",
+            "post-admission sheds by cause (BUSY after queueing)",
+            [({"cause": c}, float(v))
+             for c, v in sorted(admission["shed"].items())] or
+            [({"cause": "none"}, 0.0)]))
+        out.append(_series(f"{ns}_admission_depth", "gauge",
+                           "requests queued right now",
+                           [({}, float(admission["depth"]))]))
+        out.append(_series(f"{ns}_admission_inflight", "gauge",
+                           "requests dequeued but not yet replied",
+                           [({}, float(admission["inflight"]))]))
+        out.append(_series(f"{ns}_admission_depth_peak", "gauge",
+                           "admission queue high-water mark",
+                           [({}, float(admission["depth_peak"]))]))
+
+    if pool:
+        p = pool.get("pool", {})
+        for key, help_ in (("restarts", "worker restarts"),
+                           ("kills", "supervisor kills (hang/deadline)"),
+                           ("reoffered", "frames redelivered after a "
+                                         "worker death")):
+            out.append(_series(f"{ns}_pool_{key}_total", "counter",
+                               f"pool: {help_}",
+                               [({}, float(p.get(key, 0)))]))
+        for key, help_ in (("live", "live workers"),
+                           ("ready", "ready workers"),
+                           ("pending", "router backlog"),
+                           ("degraded", "slots disabled by the circuit"),
+                           ("epoch", "model swap epoch")):
+            out.append(_series(f"{ns}_pool_{key}", "gauge",
+                               f"pool: {help_}",
+                               [({}, float(p.get(key, 0)))]))
+        workers = pool.get("workers", [])
+        if workers:
+            out.append(_series(
+                f"{ns}_worker_replied_total", "counter",
+                "per-worker goodput (frames answered)",
+                [({"wid": str(w["wid"])}, float(w["replied"]))
+                 for w in workers]))
+            out.append(_series(
+                f"{ns}_worker_restarts_total", "counter",
+                "per-worker slot restarts",
+                [({"wid": str(w["wid"])}, float(w["restarts"]))
+                 for w in workers]))
+            out.append(_series(
+                f"{ns}_worker_inflight", "gauge",
+                "frames dispatched to the worker, unanswered",
+                [({"wid": str(w["wid"])}, float(w["inflight"]))
+                 for w in workers]))
+            out.append(_series(
+                f"{ns}_worker_up", "gauge",
+                "1 when the slot is ready, else 0 (state label says "
+                "why)",
+                [({"wid": str(w["wid"]), "state": w["state"]},
+                  1.0 if w["state"] == "ready" else 0.0)
+                 for w in workers]))
+
+    if tracer is not None and getattr(tracer, "active", False):
+        hists = tracer.hists()
+        if hists:
+            out.append(_series(
+                f"{ns}_element_proctime_seconds", "histogram",
+                "per-element process() latency (w<wid>/ prefix = "
+                "merged from that worker process)",
+                [({"element": name}, h)
+                 for name, h in sorted(hists.items())]))
+        forced = tracer.forced_syncs()
+        if forced:
+            out.append(_series(
+                f"{ns}_forced_syncs_total", "counter",
+                "semantic host syncs per element (runtime/sync.py)",
+                [({"element": n}, float(v))
+                 for n, v in sorted(forced.items())]))
+        sheds = tracer.shed_counts()
+        if sheds:
+            out.append(_series(
+                f"{ns}_trace_sheds_total", "counter",
+                "sheds/rejections as seen by the tracer, per server "
+                "and cause",
+                [({"server": srv, "cause": c}, float(v))
+                 for srv, causes in sorted(sheds.items())
+                 for c, v in sorted(causes.items())]))
+        out.append(_series(
+            f"{ns}_trace_events_total", "counter",
+            "trace events recorded pool-wide (monotone; ring length "
+            "is bounded)", [({}, float(tracer.total_events))]))
+        out.append(_series(
+            f"{ns}_trace_events_dropped_total", "counter",
+            "trace events lost to ring wrap, children included",
+            [({}, float(tracer.events_dropped))]))
+        s = tracer.summary()
+        out.append(_series(
+            f"{ns}_trace_requests_total", "counter",
+            "completed request timelines recorded",
+            [({}, float(s.get("requests", 0)))]))
+        queues = tracer.queue_gauges()
+        if queues:
+            out.append(_series(
+                f"{ns}_queue_depth_peak", "gauge",
+                "per-queue high-water mark",
+                [({"queue": n}, float(g.get("peak", 0)))
+                 for n, g in sorted(queues.items())]))
+
+    if extra:
+        for name, value in sorted(extra.items()):
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                continue
+            out.append(_series(f"{ns}_{name}", "gauge",
+                               "caller-supplied gauge", [({}, v)]))
+    return out
+
+
+# -- text exposition ---------------------------------------------------------
+
+def escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote,
+    newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def render_prometheus(series: List[Series]) -> str:
+    """Serialize series to the text exposition format (one # HELP and
+    # TYPE line per family; histograms expand to cumulative le-buckets
+    + _sum + _count)."""
+    lines: List[str] = []
+    for s in series:
+        name, typ = s["name"], s["type"]
+        help_ = s.get("help", "").replace("\\", "\\\\") \
+            .replace("\n", "\\n")
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {typ}")
+        for labels, value in s["samples"]:
+            if typ == "histogram":
+                bounds = value["bounds"]
+                counts = value["counts"]
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    bl = dict(labels, le=_fmt(b))
+                    lines.append(
+                        f"{name}_bucket{_labels_str(bl)} {cum}")
+                cum += counts[len(bounds)] if len(counts) > len(bounds) \
+                    else 0
+                bl = dict(labels, le="+Inf")
+                lines.append(f"{name}_bucket{_labels_str(bl)} {cum}")
+                lines.append(f"{name}_sum{_labels_str(labels)} "
+                             f"{repr(float(value['sum']))}")
+                lines.append(f"{name}_count{_labels_str(labels)} "
+                             f"{int(value['count'])}")
+            else:
+                lines.append(
+                    f"{name}{_labels_str(labels)} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[str, dict]:
+    """Minimal exposition parser (tests + `top`): returns
+    {family: {"type", "help", "samples": {sample_line_name+labels:
+    value}}}. Handles escaped label values; not a full PromQL lexer —
+    exactly the subset render_prometheus emits."""
+    out: Dict[str, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            fam, _, help_ = rest.partition(" ")
+            out.setdefault(fam, {"samples": {}})["help"] = help_
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, typ = rest.partition(" ")
+            out.setdefault(fam, {"samples": {}})["type"] = typ
+        elif line.startswith("#"):
+            continue
+        else:
+            key, _, val = line.rpartition(" ")
+            base = key.split("{", 1)[0]
+            fam = base
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix) and \
+                        base[:-len(suffix)] in out:
+                    fam = base[:-len(suffix)]
+                    break
+            v = float("inf") if val == "+Inf" else float(val)
+            out.setdefault(fam, {"samples": {}})["samples"][key] = v
+    return out
+
+
+# -- HTTP endpoint -----------------------------------------------------------
+
+class MetricsServer:
+    """Stdlib HTTP exposition endpoint.
+
+    ``collect`` returns the current series list (called per scrape, on
+    the HTTP thread — it must only read counters under their own
+    locks). Routes: ``/metrics`` (text exposition), ``/healthz``
+    (JSON), ``/`` (pointer). Serving uses ThreadingHTTPServer so a
+    slow scraper cannot wedge a concurrent /healthz probe.
+    """
+
+    def __init__(self, collect: Callable[[], List[Series]],
+                 host: str = "127.0.0.1", port: int = 0,
+                 health: Optional[Callable[[], dict]] = None):
+        import http.server
+
+        self._collect = collect
+        self._health = health
+        self.scrapes = 0
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):           # noqa: N802 (stdlib contract)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    try:
+                        body = render_prometheus(
+                            outer._collect()).encode()
+                    except Exception as e:   # a scrape must not 500 the
+                        log.warning("metrics collect failed: %s", e)
+                        self.send_error(503, "collect failed")
+                        return
+                    outer.scrapes += 1
+                    self._ok(body, _CONTENT_TYPE)
+                elif path == "/healthz":
+                    info = {"ok": True, "scrapes": outer.scrapes}
+                    if outer._health is not None:
+                        try:
+                            info.update(outer._health())
+                        except Exception as e:
+                            info = {"ok": False, "error": str(e)}
+                    self._ok(json.dumps(info).encode(),
+                             "application/json")
+                elif path == "/":
+                    self._ok(b"nnstreamer_tpu metrics: GET /metrics\n",
+                             "text/plain")
+                else:
+                    self.send_error(404)
+
+            def _ok(self, body: bytes, ctype: str) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass                      # scrape spam stays off stderr
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http",
+            daemon=True)
+        self._thread.start()
+        log.info("metrics endpoint on http://%s:%d/metrics",
+                 host, self.port)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def scrape(url: str, timeout_s: float = 5.0) -> str:
+    """GET one exposition document (stdlib urllib; localhost scrapes)."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return r.read().decode()
+
+
+# -- terminal top view --------------------------------------------------------
+
+#: families the top view rates/ranks first, in display order
+_TOP_KEY_FAMILIES = (
+    "nns_admission_offered_total", "nns_admission_admitted_total",
+    "nns_admission_replied_total", "nns_admission_rejected_total",
+    "nns_admission_shed_total", "nns_worker_replied_total",
+    "nns_pool_restarts_total", "nns_trace_events_total",
+)
+
+
+def top_table(prev: Dict[str, dict], cur: Dict[str, dict],
+              dt_s: float) -> List[str]:
+    """Render one refresh of the top view from two parsed scrapes:
+    counters as rates over the interval, gauges as current values."""
+    lines = [f"{'series':<56} {'value':>14} {'rate/s':>10}"]
+    lines.append("-" * 82)
+
+    def rows(order):
+        for fam in order:
+            info = cur.get(fam)
+            if info is None:
+                continue
+            typ = info.get("type", "gauge")
+            for key, v in sorted(info["samples"].items()):
+                if key.endswith("_sum") or "_bucket{" in key or \
+                        key.endswith("_count"):
+                    continue
+                rate = ""
+                if typ == "counter" and fam in prev:
+                    pv = prev[fam]["samples"].get(key)
+                    if pv is not None and dt_s > 0:
+                        rate = f"{max(0.0, (v - pv) / dt_s):.1f}"
+                disp = key if len(key) <= 56 else key[:53] + "..."
+                lines.append(f"{disp:<56} {v:>14.10g} {rate:>10}")
+
+    rows([f for f in _TOP_KEY_FAMILIES if f in cur])
+    rows(sorted(f for f in cur
+                if f not in _TOP_KEY_FAMILIES
+                and cur[f].get("type") != "histogram"))
+    return lines
+
+
+def top_view(url: str, interval_s: float = 1.0,
+             iterations: int = 0, out=None) -> None:
+    """Live terminal view over any exposition endpoint: scrape, diff,
+    redraw. iterations=0 runs until interrupted."""
+    import sys
+
+    out = out or sys.stdout
+    prev: Dict[str, dict] = {}
+    prev_t = time.monotonic()
+    n = 0
+    while True:
+        try:
+            cur = parse_prometheus(scrape(url))
+        except OSError as e:
+            out.write(f"scrape {url} failed: {e}\n")
+            return
+        now = time.monotonic()
+        lines = top_table(prev, cur, now - prev_t)
+        out.write("\x1b[2J\x1b[H" if out.isatty() else "")
+        out.write(f"nnstreamer_tpu top — {url} "
+                  f"(interval {interval_s:.1f}s)\n")
+        out.write("\n".join(lines) + "\n")
+        out.flush()
+        prev, prev_t = cur, now
+        n += 1
+        if iterations and n >= iterations:
+            return
+        time.sleep(interval_s)

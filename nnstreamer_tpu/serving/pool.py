@@ -65,7 +65,7 @@ from nnstreamer_tpu.core.errors import StreamError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.edge.query import QueryServer
 from nnstreamer_tpu.edge.wire import encode_buffer
-from nnstreamer_tpu.runtime.tracing import NULL_TRACER
+from nnstreamer_tpu.runtime.tracing import NULL_TRACER, get_trace_ctx
 from nnstreamer_tpu.serving.worker import RID_META, WorkerSpec, worker_main
 from nnstreamer_tpu.tensor.info import TensorsSpec
 
@@ -98,15 +98,32 @@ class _Request:
     surviving TensorBuffer."""
 
     __slots__ = ("rid", "client_id", "pts", "payload", "attempts",
-                 "t_sent")
+                 "t_sent", "traced", "hops")
 
-    def __init__(self, rid: int, client_id, pts, payload: bytes):
+    def __init__(self, rid: int, client_id, pts, payload: bytes,
+                 traced: bool = False):
         self.rid = rid
         self.client_id = client_id
         self.pts = pts
         self.payload = payload
         self.attempts = 0             # deliveries so far
         self.t_sent = 0.0
+        # parent-side hop records (dispatch/reoffer): the payload is
+        # already-encoded bytes when the router touches it, so router
+        # hops are kept here and merged into the reply's trace context
+        # at _on_result — this is what makes a redelivered frame's
+        # timeline show BOTH the dead and the replacement worker (the
+        # dead worker's own stamps died with it; the parent's dispatch
+        # record carries its wid/pid)
+        self.traced = traced
+        self.hops: List[dict] = []
+
+    def hop(self, name: str, **extra) -> None:
+        if self.traced:
+            rec = {"hop": name, "t": time.perf_counter(),
+                   "pid": os.getpid()}
+            rec.update(extra)
+            self.hops.append(rec)
 
 
 class _Slot:
@@ -125,6 +142,10 @@ class _Slot:
         self.started_t = 0.0
         self.last_hb = 0.0            # parent-clock arrival time
         self.inflight: Dict[int, _Request] = {}
+        # perf_counter skew vs this worker (≈0 on Linux, where
+        # perf_counter is the system-wide CLOCK_MONOTONIC); sampled at
+        # the ready handshake, applied when merging its trace deltas
+        self.clock_offset_s = 0.0
         self.restart_times: Deque[float] = deque()
         self.backoff_s = 0.0
         self.next_restart_t = 0.0
@@ -160,6 +181,12 @@ class WorkerPool:
         if per_worker_queue < 1:
             raise ValueError("per_worker_queue must be >= 1")
         self.qs = qs
+        # a traced pool runs traced workers: the child spins up its own
+        # Tracer and ships deltas back over the pipe ("tr" lane)
+        if getattr(qs.tracer, "active", False) and not spec.trace:
+            import dataclasses
+
+            spec = dataclasses.replace(spec, trace=True)
         self.spec = spec
         self.name = name
         self.n_workers = workers
@@ -245,7 +272,7 @@ class WorkerPool:
         """Start a worker in `slot` (under `_lock`)."""
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
         proc = self._ctx.Process(
-            target=worker_main, args=(child_conn, self.spec),
+            target=worker_main, args=(child_conn, self.spec, slot.wid),
             name=f"{self.name}-w{slot.wid}", daemon=True)
         proc.start()
         child_conn.close()            # child's end lives in the child
@@ -285,9 +312,26 @@ class WorkerPool:
                 with self._lock:
                     if slot.state == STARTING:
                         slot.state = READY
-                self._adopt_out_spec(msg[1])
+                info = msg[1]
+                t_child = info.get("t_perf") if isinstance(info, dict) \
+                    else None
+                if t_child is not None:
+                    # perf_counter is the system-wide CLOCK_MONOTONIC
+                    # on Linux, so a small delta here is just pipe
+                    # latency — only a genuinely different clock base
+                    # (>1s apart) is treated as skew to correct
+                    raw = time.perf_counter() - float(t_child)
+                    slot.clock_offset_s = raw if abs(raw) > 1.0 else 0.0
+                self._adopt_out_spec(info)
                 self._event(slot.wid, "ready", pid=slot.pid)
                 self._dispatch_evt.set()
+            elif tag == "tr":
+                tr = self.tracer
+                if tr.active:
+                    tr.ingest_child(
+                        slot.wid, slot.pid or 0, msg[1],
+                        offset_s=slot.clock_offset_s,
+                        label=f"{self.name}-w{slot.wid}")
             elif tag == "swap_ack":
                 with self._lock:
                     acks = self._swap_acks
@@ -330,6 +374,16 @@ class WorkerPool:
             self.qs.send_busy(req.client_id, req.pts, "worker_error")
             return
         buf.meta.pop(RID_META, None)
+        if req.hops:
+            # merge the parent-side router hops (dispatch/reoffer) into
+            # the reply's trace context, in time order: one timeline
+            # per trace_id even across a redelivery
+            ctx = get_trace_ctx(buf.meta)
+            if ctx is not None:
+                ctx["hops"].extend(req.hops)
+                ctx["hops"].sort(
+                    key=lambda h: h.get("t", 0.0)
+                    if isinstance(h, dict) else 0.0)
         self.qs.reply(int(req.client_id), buf.with_tensors(
             buf.tensors, pts=req.pts))
         self._dispatch_evt.set()
@@ -397,7 +451,8 @@ class WorkerPool:
             rid = self._next_rid
         client_id = buf.meta.pop("client_id", None)
         buf.meta[RID_META] = rid
-        return _Request(rid, client_id, buf.pts, encode_buffer(buf))
+        return _Request(rid, client_id, buf.pts, encode_buffer(buf),
+                        traced=get_trace_ctx(buf.meta) is not None)
 
     def _dispatch(self, req: _Request) -> bool:
         """Send to the least-outstanding READY worker with queue room;
@@ -412,6 +467,8 @@ class WorkerPool:
             req.attempts += 1
             req.t_sent = time.monotonic()
             slot.inflight[req.rid] = req
+        req.hop("dispatch", wid=slot.wid, wpid=slot.pid,
+                attempt=req.attempts)
         try:
             with slot.send_lock:
                 slot.conn.send(("req", req.rid, req.payload))
@@ -421,6 +478,8 @@ class WorkerPool:
             with self._lock:
                 slot.inflight.pop(req.rid, None)
                 req.attempts -= 1
+            if req.hops:
+                req.hops.pop()
             return False
         return True
 
@@ -507,6 +566,8 @@ class WorkerPool:
                 with self._lock:
                     self._pending.appendleft(req)
                 self.reoffered += 1
+                req.hop("reoffer", wid=slot.wid, cause=cause,
+                        attempt=req.attempts)
                 self._event(slot.wid, "reoffer", pts=req.pts,
                             attempts=req.attempts)
             else:

@@ -73,6 +73,12 @@ class WorkerSpec:
     dims: str = "8:1"                     # accepted input dims (HELLO)
     types: str = "float32"
     hb_interval_s: float = 0.1            # heartbeat period
+    # run a child-side Tracer and ship its deltas over the pipe ("tr"
+    # messages on the heartbeat cadence); set automatically by a traced
+    # pool. Costs the echo path a decode/encode per frame (hop stamps
+    # need the meta), so it defaults off to keep the known-capacity
+    # semantics exact for untraced chaos/flood runs.
+    trace: bool = False
     # chaos hooks (tests / harness only; all inert by default)
     crash_pts: Optional[int] = None       # os._exit(3) on this pts
     hang_pts: Optional[int] = None        # sleep forever on this pts
@@ -104,20 +110,29 @@ class _Heartbeat(threading.Thread):
     beating; only a wedged process — native hang, hard GIL capture —
     goes silent and trips the supervisor's hb_timeout."""
 
-    def __init__(self, conn, send_lock, interval_s: float):
+    def __init__(self, conn, send_lock, interval_s: float, tracer=None):
         super().__init__(name="pool-worker-hb", daemon=True)
         self._conn = conn
         self._lock = send_lock
         self._interval = max(0.01, interval_s)
+        self._tracer = tracer
         self._stop = threading.Event()
 
     def run(self) -> None:
         seq = 0
         while not self._stop.wait(self._interval):
             seq += 1
+            # trace deltas ride the heartbeat cadence as their own
+            # pipe lane: drained event batches + monotone counter /
+            # histogram deltas (runtime/tracing.py ship_delta)
+            delta = self._tracer.ship_delta() \
+                if self._tracer is not None and self._tracer.active \
+                else None
             try:
                 with self._lock:
                     self._conn.send(("hb", seq, time.monotonic()))
+                    if delta is not None:
+                        self._conn.send(("tr", delta))
             except (OSError, ValueError, BrokenPipeError):
                 # parent gone: nothing left to serve, don't linger as
                 # an orphan
@@ -130,12 +145,17 @@ class _Heartbeat(threading.Thread):
 class _EchoService:
     """Known-capacity service: sleep then echo the payload bytes
     untouched (no decode on the hot path unless a chaos hook needs the
-    pts)."""
+    pts, or tracing needs the meta for hop stamps)."""
 
-    def __init__(self, spec: WorkerSpec):
+    def __init__(self, spec: WorkerSpec, tracer=None, wid: int = 0):
+        from nnstreamer_tpu.runtime.tracing import NULL_TRACER
+
         self._spec = spec
+        self._tracer = tracer or NULL_TRACER
+        self._wid = wid
         self._needs_pts = (spec.crash_pts is not None
                            or spec.hang_pts is not None)
+        self._needs_decode = self._needs_pts or self._tracer.active
 
     def ready_info(self) -> dict:
         # echo's out spec is its in spec
@@ -143,7 +163,8 @@ class _EchoService:
                 "out_types": self._spec.types}
 
     def serve(self, rid: int, payload: bytes, reply) -> None:
-        if self._needs_pts:
+        buf = None
+        if self._needs_decode:
             from nnstreamer_tpu.edge.wire import decode_buffer
 
             buf, _ = decode_buffer(payload)
@@ -151,6 +172,20 @@ class _EchoService:
                 os._exit(3)
             if buf.pts == self._spec.hang_pts:
                 time.sleep(3600)          # wedged: supervisor's problem
+        tr = self._tracer
+        if tr.active and buf is not None:
+            from nnstreamer_tpu.edge.wire import encode_buffer
+            from nnstreamer_tpu.runtime.tracing import stamp_hop
+
+            stamp_hop(buf.meta, "worker_recv", wid=self._wid)
+            t0 = time.perf_counter()
+            if self._spec.service_ms > 0:
+                time.sleep(self._spec.service_ms / 1e3)
+            t1 = time.perf_counter()
+            tr.record_process("echo", buf, t0, t1)
+            stamp_hop(buf.meta, "worker_done", wid=self._wid)
+            reply(("res", rid, encode_buffer(buf)))
+            return
         if self._spec.service_ms > 0:
             time.sleep(self._spec.service_ms / 1e3)
         reply(("res", rid, payload))
@@ -167,13 +202,18 @@ class _PipelineService:
     request to result by the RID_META stamp that rides buffer meta
     end-to-end."""
 
-    def __init__(self, spec: WorkerSpec, reply):
+    def __init__(self, spec: WorkerSpec, reply, tracer=None,
+                 wid: int = 0):
         import queue as _queue
 
         import nnstreamer_tpu as nns
         from nnstreamer_tpu.edge.wire import encode_buffer
+        from nnstreamer_tpu.runtime.tracing import (
+            NULL_TRACER, stamp_hop)
 
         self._reply = reply
+        self._tracer = tracer or NULL_TRACER
+        self._wid = wid
         self._outq: "_queue.Queue" = _queue.Queue()
         desc = (f"appsrc name=_pool_src dims={spec.dims} "
                 f"types={spec.types} ! {spec.pipeline} ! "
@@ -182,7 +222,11 @@ class _PipelineService:
         self._src = pipe.get("_pool_src")
         sink = pipe.get("_pool_sink")
         sink.props["new_data"] = self._outq.put
-        self.runner = nns.PipelineRunner(pipe).start()
+        # a traced worker hands ITS tracer to the runner: the child's
+        # pipeline elements record spans locally, shipped as deltas
+        self.runner = nns.PipelineRunner(
+            pipe, trace=self._tracer if self._tracer.active
+            else False).start()
         out_spec = sink.in_specs[0] if sink.in_specs else None
         dims, types = "", ""
         if out_spec is not None and hasattr(out_spec, "to_strings"):
@@ -199,6 +243,7 @@ class _PipelineService:
                 rid = buf.meta.pop(RID_META, None)
                 if rid is None:
                     continue          # not ours (defensive)
+                stamp_hop(buf.meta, "worker_done", wid=wid)
                 reply(("res", int(rid), encode_buffer(buf)))
 
         self._collector = threading.Thread(
@@ -210,6 +255,7 @@ class _PipelineService:
 
     def serve(self, rid: int, payload: bytes, reply) -> None:
         from nnstreamer_tpu.edge.wire import decode_buffer
+        from nnstreamer_tpu.runtime.tracing import stamp_hop
 
         # runner death is worker-fatal, not request-scoped: the
         # supervisor restarts the whole process
@@ -217,6 +263,7 @@ class _PipelineService:
         if err is not None:
             raise err
         buf, _ = decode_buffer(payload)
+        stamp_hop(buf.meta, "worker_recv", wid=self._wid)
         self._src.push(buf)           # RID_META already rides buf.meta
 
     def close(self) -> None:
@@ -271,7 +318,7 @@ def _handle_swap(service, spec: WorkerSpec, state: dict, phase: str,
     return False, f"unknown swap phase {phase!r}"
 
 
-def worker_main(conn, spec: WorkerSpec) -> None:
+def worker_main(conn, spec: WorkerSpec, wid: int = 0) -> None:
     """Child entry point (multiprocessing spawn target).
 
     The loop is deliberately sequential per worker — concurrency comes
@@ -286,7 +333,14 @@ def worker_main(conn, spec: WorkerSpec) -> None:
         except (OSError, ValueError, BrokenPipeError):
             os._exit(0)               # parent gone — never orphan
 
-    hb = _Heartbeat(conn, send_lock, spec.hb_interval_s)
+    tracer = None
+    if spec.trace:
+        from nnstreamer_tpu.runtime.tracing import Tracer
+
+        tracer = Tracer()
+        tracer.enable_shipping()
+
+    hb = _Heartbeat(conn, send_lock, spec.hb_interval_s, tracer)
     hb.start()
     if spec.crash_after_s is not None:
         # chaos: die abruptly after t seconds (circuit-breaker tests);
@@ -299,14 +353,18 @@ def worker_main(conn, spec: WorkerSpec) -> None:
     service = None
     try:
         if spec.kind == "pipeline":
-            service = _PipelineService(spec, reply)
+            service = _PipelineService(spec, reply, tracer, wid)
         else:
-            service = _EchoService(spec)
+            service = _EchoService(spec, tracer, wid)
     except BaseException as e:
         reply(("fatal", _pickle_exc(e)))
         os._exit(4)
 
-    reply(("ready", dict(service.ready_info(), pid=os.getpid())))
+    # t_perf lets the parent sample this worker's monotonic-clock
+    # offset at handshake (pool.py "ready" handler) so shipped trace
+    # timestamps align on one pool-wide timeline
+    reply(("ready", dict(service.ready_info(), pid=os.getpid(),
+                         wid=wid, t_perf=time.perf_counter())))
     swap_state: dict = {}
     try:
         while True:
@@ -332,6 +390,13 @@ def worker_main(conn, spec: WorkerSpec) -> None:
         hb.stop()
         if service is not None:
             service.close()
+    if tracer is not None:
+        # final drain: a graceful stop must not strand the tail of the
+        # trace in the child (the heartbeat cadence may not have fired
+        # since the last frame)
+        delta = tracer.ship_delta()
+        if delta is not None:
+            reply(("tr", delta))
     reply(("bye",))
     try:
         conn.close()

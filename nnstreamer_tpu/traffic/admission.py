@@ -51,6 +51,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from nnstreamer_tpu.runtime.tracing import stamp_hop
+
 SHED_POLICIES = ("reject-newest", "reject-oldest", "deadline-drop")
 
 #: TensorBuffer.meta key: per-request latency budget in ms, measured
@@ -162,6 +164,8 @@ class AdmissionQueue:
                     return d
             self._admitted += 1
             self._q.append((item, now, expiry))
+            if isinstance(meta, dict):
+                stamp_hop(meta, "admit", depth=len(self._q))
             if len(self._q) > self._depth_peak:
                 self._depth_peak = len(self._q)
             self._cv.notify()
@@ -213,6 +217,7 @@ class AdmissionQueue:
             item, _, _ = self._q.popleft()
             if item is not None:          # None = teardown sentinel
                 self._inflight += 1
+                stamp_hop(getattr(item, "meta", None), "dequeue")
             return item
 
     def put_nowait(self, item) -> None:

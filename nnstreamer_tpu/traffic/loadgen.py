@@ -28,6 +28,7 @@ SLO report fields (``run_open_loop`` return value): see docs/traffic.md.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -39,7 +40,8 @@ from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.traffic.admission import DEADLINE_META
 from nnstreamer_tpu.edge import protocol as P
 from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
-from nnstreamer_tpu.runtime.tracing import percentile
+from nnstreamer_tpu.runtime.tracing import (
+    ensure_trace_ctx, get_trace_ctx, hop_spans, percentile)
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
 
 log = get_logger("traffic.loadgen")
@@ -93,7 +95,8 @@ def run_open_loop(host: str, port: int, *, dims: str,
                   drain_timeout_s: float = 15.0,
                   hello_timeout_s: float = 10.0,
                   depth_probe: Optional[Callable[[], int]] = None,
-                  depth_sample_ms: float = 25.0) -> dict:
+                  depth_sample_ms: float = 25.0,
+                  trace: bool = False) -> dict:
     """Drive one live query server open-loop; return the SLO report.
 
     make_frame(i) builds request i's TensorBuffer (its pts is forced to
@@ -101,12 +104,22 @@ def run_open_loop(host: str, port: int, *, dims: str,
     the server is in-process, samples its admission-queue depth on a
     timeline; remote servers still get depth points from every BUSY
     payload.
+
+    ``trace=True`` gives every frame a trace context
+    (runtime/tracing.py): the server stack stamps its hops into the
+    meta and the reply carries them home, so the report gains a
+    ``hop_breakdown`` — the per-stage latency decomposition (admission
+    wait / route / worker queue / service / reply) of the worst-p99
+    request. The client_send/client_recv hops are recorded LOCALLY
+    from the send/complete clocks, not serialized, so the send path
+    stays one pre-encoded sendall per frame.
     """
     n = len(arrivals)
     if n == 0:
         raise ValueError("arrivals is empty")
     done: Dict[int, float] = {}      # pts -> completion t
     busy: Dict[int, dict] = {}       # pts -> BUSY payload
+    traces: Dict[int, dict] = {}     # pts -> reply trace ctx
     evt_lock = threading.Lock()
     all_answered = threading.Event()
     hello_q: List[tuple] = []
@@ -129,6 +142,10 @@ def run_open_loop(host: str, port: int, *, dims: str,
                     return
                 if buf.pts is not None:
                     done[int(buf.pts)] = now
+                    if trace:
+                        ctx = get_trace_ctx(buf.meta)
+                        if ctx:
+                            traces[int(buf.pts)] = ctx
             elif mtype == P.T_BUSY:
                 try:
                     info = json.loads(payload.decode())
@@ -161,6 +178,8 @@ def run_open_loop(host: str, port: int, *, dims: str,
         frames = []
         for i in range(n):
             buf = make_frame(i)
+            if trace:
+                ensure_trace_ctx(buf.meta)
             frames.append(encode_buffer(
                 buf.with_tensors(buf.tensors, pts=i)))
 
@@ -251,6 +270,32 @@ def run_open_loop(host: str, port: int, *, dims: str,
         retry_hints.sort()
         report["retry_after_ms_p50"] = round(
             percentile(retry_hints, 50), 1)
+    if trace and lat_ms:
+        # worst-p99 point: the completed request at the p99 latency
+        # rank — decompose ITS end-to-end time by hop, from the trace
+        # context its reply carried home
+        per = {i: (done[i] - sent_at[i]) * 1e3
+               for i in done if i < n_sent}
+        p99v = percentile(lat_ms, 99)
+        at_p99 = [i for i, v in per.items() if v >= p99v]
+        pick = min(at_p99, key=lambda i: per[i]) if at_p99 else None
+        if pick is not None:
+            hops = [{"hop": "client_send", "t": sent_at[pick],
+                     "pid": os.getpid()}]
+            hops += list(traces.get(pick, {}).get("hops", []))
+            hops.append({"hop": "client_recv", "t": done[pick],
+                         "pid": os.getpid()})
+            spans = hop_spans(hops)
+            report["hop_breakdown"] = {
+                "pts": pick,
+                "latency_ms": round(per[pick], 2),
+                "trace_id": traces.get(pick, {}).get("id"),
+                "hops": [h["hop"] for h in
+                         sorted(hops, key=lambda h: h.get("t", 0.0))],
+                "spans": {k: (round(v, 3) if isinstance(v, float)
+                              else v) for k, v in spans.items()},
+            }
+        report["traced_replies"] = len(traces)
     if tl:
         # downsample the timeline to <= 200 points, keep the peak honest
         step = max(1, len(tl) // 200)
@@ -331,7 +376,7 @@ def run_against_echo(*, pattern: str = "poisson", load_x: float = 2.0,
                      max_pending: int = 16, max_inflight: int = 0,
                      shed_policy: str = "reject-newest",
                      p99_budget_ms: Optional[float] = None,
-                     seed: int = 0) -> dict:
+                     seed: int = 0, trace: bool = False) -> dict:
     """One self-contained harness run: bounded echo server + open-loop
     load at `load_x` × its capacity. The shape bench/CLI/tests share."""
     rng = np.random.default_rng(seed)
@@ -367,7 +412,7 @@ def run_against_echo(*, pattern: str = "poisson", load_x: float = 2.0,
             arrivals=arrivals,
             make_frame=make_frame,
             p99_budget_ms=p99_budget_ms,
-            depth_probe=srv.depth_probe)
+            depth_probe=srv.depth_probe, trace=trace)
         report["pattern"] = pattern
         report["load_x"] = load_x
         report["service_ms"] = service_ms
@@ -406,6 +451,7 @@ def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,
                      p99_budget_ms: float = 90.0, seed: int = 0,
                      kill_at_s: Optional[float] = None, kills: int = 1,
                      recovery_timeout_s: Optional[float] = None,
+                     trace: bool = False,
                      **pool_kwargs) -> dict:
     """Chaos-kill harness run: open-loop load at `load_x` × a worker
     POOL's aggregate capacity, with `kills` SIGKILLs of rng-chosen
@@ -464,7 +510,7 @@ def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,
                 types=pool.spec.types, arrivals=arrivals,
                 make_frame=lambda i: TensorBuffer.of(x, pts=i),
                 p99_budget_ms=p99_budget_ms,
-                depth_probe=pqs.depth_probe)
+                depth_probe=pqs.depth_probe, trace=trace)
         finally:
             for t in timers:
                 t.cancel()

@@ -1,0 +1,230 @@
+"""Metrics export plane (serving/metrics.py): snapshot flattening,
+Prometheus text exposition (parsed line-by-line), the stdlib HTTP
+endpoint, and the scrape-monotonicity contract — two consecutive
+scrapes under load never see a counter or histogram bucket decrease."""
+
+import re
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.runtime.tracing import Tracer
+from nnstreamer_tpu.serving.metrics import (
+    MetricsServer, escape_label_value, metrics_snapshot,
+    parse_prometheus, render_prometheus, scrape, top_table)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+
+def _admission(offered=10, admitted=8, replied=7, depth=1, inflight=0):
+    return {"offered": offered, "admitted": admitted, "replied": replied,
+            "rejected": {"queue_full": offered - admitted},
+            "shed": {"expired": admitted - replied - depth - inflight},
+            "depth": depth, "inflight": inflight, "depth_peak": 4,
+            "max_pending": 8, "max_inflight": 0,
+            "shed_policy": "reject-newest"}
+
+
+def _pool(replied=(4, 3)):
+    return {"pool": {"workers": len(replied), "live": len(replied),
+                     "ready": len(replied), "degraded": 0, "restarts": 1,
+                     "kills": 0, "reoffered": 2, "pending": 0,
+                     "epoch": 0},
+            "workers": [{"wid": i, "pid": 100 + i, "state": "ready",
+                         "inflight": 0, "hb_age_ms": 1.0, "restarts": i,
+                         "kills": 0, "replied": r}
+                        for i, r in enumerate(replied)]}
+
+
+def _traced(n=5, name="echo"):
+    tr = Tracer()
+    buf = TensorBuffer.of(np.ones((2,), np.float32))
+    t0 = time.perf_counter()
+    for i in range(n):
+        tr.record_process(name, buf, t0, t0 + 1e-4 * (i + 1))
+    return tr
+
+
+class TestExposition:
+    def test_type_and_help_line_per_family(self):
+        text = render_prometheus(metrics_snapshot(
+            tracer=_traced(), admission=_admission(), pool=_pool()))
+        parsed = parse_prometheus(text)
+        for fam in ("nns_admission_offered_total",
+                    "nns_admission_rejected_total",
+                    "nns_admission_depth",
+                    "nns_pool_restarts_total",
+                    "nns_worker_replied_total",
+                    "nns_element_proctime_seconds",
+                    "nns_trace_events_total"):
+            assert fam in parsed, f"family {fam} missing"
+            assert parsed[fam].get("type"), f"no TYPE line for {fam}"
+            assert parsed[fam].get("help"), f"no HELP line for {fam}"
+        # _total families are counters; bare gauges are gauges
+        assert parsed["nns_admission_offered_total"]["type"] == "counter"
+        assert parsed["nns_admission_depth"]["type"] == "gauge"
+        assert parsed["nns_element_proctime_seconds"]["type"] \
+            == "histogram"
+        # every non-comment line is "name{labels} value" — no stray
+        # formats a scraper would reject
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert re.match(
+                r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \S+$', line), \
+                f"malformed exposition line: {line!r}"
+
+    def test_label_escaping_round_trips(self):
+        assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+        tr = _traced(2, name='we"ird\\el\nem')
+        text = render_prometheus(metrics_snapshot(tracer=tr))
+        # raw newline inside a quoted label value would break
+        # line-oriented parsers
+        for line in text.splitlines():
+            assert "\r" not in line
+        assert '\\"ird' in text and "\\\\el" in text and "\\nem" in text
+
+    def test_histogram_buckets_cumulative_with_inf(self):
+        tr = _traced(4)
+        text = render_prometheus(metrics_snapshot(tracer=tr))
+        fam = parse_prometheus(text)["nns_element_proctime_seconds"]
+        buckets = sorted(
+            (float("inf") if 'le="+Inf"' in k else
+             float(re.search(r'le="([^"]+)"', k).group(1)), v)
+            for k, v in fam["samples"].items() if "_bucket{" in k)
+        vals = [v for _, v in buckets]
+        assert vals == sorted(vals)          # cumulative ⇒ monotone
+        assert buckets[-1][0] == float("inf")
+        assert vals[-1] == 4                 # +Inf bucket == _count
+        count = [v for k, v in fam["samples"].items()
+                 if k.endswith("_count}") or "_count{" in k]
+        assert count == [4]
+
+    def test_counter_families_never_negative(self):
+        series = metrics_snapshot(admission=_admission(), pool=_pool())
+        for s in series:
+            if s["type"] == "counter":
+                for _, v in s["samples"]:
+                    assert v >= 0, s["name"]
+
+    def test_parse_handles_bucket_sum_count_suffixes(self):
+        tr = _traced(3)
+        parsed = parse_prometheus(render_prometheus(
+            metrics_snapshot(tracer=tr)))
+        fam = parsed["nns_element_proctime_seconds"]
+        # suffixed sample lines are attributed to the base family, not
+        # invented as families of their own
+        assert "nns_element_proctime_seconds_bucket" not in parsed
+        assert any("_sum{" in k or k.endswith("_sum}")
+                   for k in fam["samples"])
+
+
+class TestMetricsServer:
+    def test_scrapes_are_monotone_under_load(self):
+        tr = _traced(2)
+        state = {"offered": 10}
+
+        def collect():
+            return metrics_snapshot(
+                tracer=tr, admission=_admission(state["offered"]),
+                pool=_pool())
+
+        srv = MetricsServer(collect)
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            p1 = parse_prometheus(scrape(url))
+            # the plane keeps counting between scrapes
+            state["offered"] += 7
+            buf = TensorBuffer.of(np.ones((2,), np.float32))
+            t0 = time.perf_counter()
+            for _ in range(3):
+                tr.record_process("echo", buf, t0, t0 + 2e-4)
+            p2 = parse_prometheus(scrape(url))
+            for fam, info in p1.items():
+                if info.get("type") not in ("counter", "histogram"):
+                    continue
+                for k, v in info["samples"].items():
+                    v2 = p2[fam]["samples"].get(k)
+                    assert v2 is not None and v2 >= v, (fam, k, v, v2)
+            # and actually increased where we counted
+            assert p2["nns_admission_offered_total"]["samples"][
+                "nns_admission_offered_total"] == 17.0
+        finally:
+            srv.close()
+
+    def test_healthz_and_unknown_path(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        srv = MetricsServer(lambda: [],
+                            health=lambda: {"workers": 2})
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/healthz",
+                                        timeout=5) as r:
+                info = json.loads(r.read().decode())
+            assert info["ok"] and info["workers"] == 2
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/nope", timeout=5)
+            assert ei.value.code == 404
+        finally:
+            srv.close()
+
+    def test_collect_failure_yields_503_not_crash(self):
+        import urllib.error
+        import urllib.request
+
+        calls = {"n": 0}
+
+        def collect():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom")
+            return metrics_snapshot(admission=_admission())
+
+        srv = MetricsServer(collect)
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=5)
+            assert ei.value.code == 503
+            # endpoint survives and serves the next scrape
+            assert "nns_admission_offered_total" in scrape(url)
+        finally:
+            srv.close()
+
+    def test_content_type_is_exposition_format(self):
+        import urllib.request
+
+        srv = MetricsServer(lambda: metrics_snapshot(
+            admission=_admission()))
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics",
+                    timeout=5) as r:
+                ctype = r.headers["Content-Type"]
+            assert ctype.startswith("text/plain")
+            assert "version=0.0.4" in ctype
+        finally:
+            srv.close()
+
+
+class TestTopView:
+    def test_counter_rates_and_gauges(self):
+        p1 = parse_prometheus(render_prometheus(metrics_snapshot(
+            admission=_admission(offered=100))))
+        p2 = parse_prometheus(render_prometheus(metrics_snapshot(
+            admission=_admission(offered=150))))
+        lines = "\n".join(top_table(p1, p2, dt_s=2.0))
+        # 50 more offered over 2s → 25.0/s
+        m = re.search(r"nns_admission_offered_total\s+150\s+25\.0",
+                      lines)
+        assert m, lines
+        assert "nns_admission_depth" in lines
+
+    def test_histogram_families_stay_out_of_table(self):
+        cur = parse_prometheus(render_prometheus(metrics_snapshot(
+            tracer=_traced())))
+        lines = "\n".join(top_table({}, cur, 1.0))
+        assert "proctime_seconds_bucket" not in lines

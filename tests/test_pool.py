@@ -294,3 +294,95 @@ class TestNoOrphans:
             pqs.close()
         assert len(pids) == 3             # 2 initial + 1 restart
         assert not any(proc_alive(p) for p in pids)
+
+
+# -- distributed tracing across the pool (ISSUE 11) --------------------------
+
+@pytest.mark.chaos
+class TestPoolTracing:
+    def test_redelivery_keeps_trace_id_across_workers(self):
+        """ISSUE 11 regression: a frame redelivered after a worker
+        SIGKILL keeps its ORIGINAL trace id — the merged timeline shows
+        the dead worker's dispatch hop AND the replacement's, under one
+        id. A fresh id on re-offer would sever the two attempts."""
+        from nnstreamer_tpu.runtime.tracing import Tracer, hop_spans
+
+        tr = Tracer()
+        rep = run_against_pool(
+            n=160, service_ms=15.0, workers=2, load_x=1.8, kills=1,
+            seed=3, max_pending=32, p99_budget_ms=400.0, trace=True,
+            tracer=tr)
+        assert rep["lost"] == 0
+        assert rep["conserved"]
+        assert rep["orphans"] == []
+        assert rep["pool"]["pool"]["reoffered"] >= 1, \
+            "kill landed on an idle worker: no redelivery to test"
+        redelivered = []
+        for name, tid, t, hops, args in tr.requests():
+            disp = [h for h in hops if h.get("hop") == "dispatch"]
+            if len(disp) >= 2:
+                redelivered.append((tid, hops, disp))
+        assert redelivered, "no completed request carries 2 dispatches"
+        for tid, hops, disp in redelivered:
+            hop_names = [h["hop"] for h in hops]
+            assert "reoffer" in hop_names
+            # both attempts live under the one id: the dead worker's
+            # pid (captured by the parent at dispatch time) differs
+            # from the replacement's
+            wpids = {h.get("wpid") for h in disp}
+            assert len(wpids) == 2, (tid, disp)
+            spans = hop_spans(hops)
+            assert spans["redeliveries"] >= 1
+            # stage math comes from the attempt that replied
+            assert spans.get("service_ms", 0) > 0
+
+    def test_worker_tracers_merge_into_pool_summary(self):
+        """Each worker's own Tracer ships deltas over the heartbeat
+        lane; the parent merges them into one summary and one Chrome
+        trace with a track group per worker process."""
+        from nnstreamer_tpu.runtime.tracing import (
+            Tracer, ensure_trace_ctx)
+
+        tr = Tracer()
+        pqs = _echo_pool(service_ms=2.0, tracer=tr)
+        try:
+            x = np.ones((8, 1), np.float32)
+
+            def mk(i):
+                b = TensorBuffer.of(x, pts=i)
+                ensure_trace_ctx(b.meta)
+                return b
+
+            rep = run_open_loop(
+                "127.0.0.1", pqs.port, dims="8:1",
+                arrivals=poisson_arrivals(150.0, 30),
+                make_frame=mk, p99_budget_ms=500.0)
+            assert rep["completed"] == 30 and rep["lost"] == 0
+            # heartbeat interval bounds how long a delta can lag
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                kids = tr.children()
+                if kids and sum(k["events_total"]
+                                for k in kids.values()) >= 30:
+                    break
+                time.sleep(0.05)
+            kids = tr.children()
+            assert kids, "no worker shipped a trace delta"
+            assert sum(k["events_total"] for k in kids.values()) >= 30
+            # per-element histograms arrive namespaced per worker
+            hists = tr.hists()
+            assert any(n.startswith("w") and n.endswith("/echo")
+                       for n in hists)
+            assert sum(h["count"] for n, h in hists.items()
+                       if "/echo" in n) == 30
+            # one process track group per live worker in the export
+            doc = tr.to_chrome_trace("pool")
+            pids = {e["pid"] for e in doc["traceEvents"]}
+            assert len(pids) >= 1 + len(kids)
+            # request timelines span admission -> worker -> reply
+            assert any(
+                {"admit", "worker_recv", "reply"} <=
+                {h.get("hop") for h in hops}
+                for _, _, _, hops, _ in tr.requests())
+        finally:
+            pqs.close()

@@ -379,3 +379,247 @@ class TestCLI:
         captured = capsys.readouterr()
         assert "element report" in captured.out
         assert "interlatency" in captured.out
+
+
+# -- distributed tracing (request contexts, child shipping, merge) -----------
+
+from nnstreamer_tpu.runtime.tracing import (  # noqa: E402
+    HIST_BOUNDS_S, TRACE_CTX_META, ensure_trace_ctx, get_trace_ctx,
+    hop_spans, merge_chrome_traces, stamp_hop)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer  # noqa: E402
+
+
+class TestTraceContext:
+    def test_ensure_creates_once_and_reuses_id(self):
+        meta = {}
+        ctx = ensure_trace_ctx(meta)
+        assert len(ctx["id"]) == 16 and ctx["hops"] == []
+        # the retry invariant: a re-offered buffer keeps its id
+        assert ensure_trace_ctx(meta)["id"] == ctx["id"]
+        assert meta[TRACE_CTX_META] is ctx
+
+    def test_get_never_creates(self):
+        meta = {}
+        assert get_trace_ctx(meta) is None
+        assert meta == {}
+        assert get_trace_ctx(None) is None
+        assert get_trace_ctx({"_trace_ctx": "junk"}) is None
+
+    def test_stamp_is_noop_without_ctx(self):
+        # the tracer-off hot path: stamping sites run unguarded on
+        # every frame, so without a context they must not mutate meta,
+        # allocate a context, or return a record
+        meta = {"pts": 3}
+        assert stamp_hop(meta, "admit") is None
+        assert meta == {"pts": 3}
+        assert stamp_hop(None, "admit") is None
+        assert stamp_hop("not-a-dict", "admit") is None
+
+    def test_stamp_appends_with_extras(self):
+        meta = {}
+        ensure_trace_ctx(meta)
+        rec = stamp_hop(meta, "dispatch", wid=1, attempt=0)
+        assert rec["hop"] == "dispatch" and rec["wid"] == 1
+        assert rec["pid"] > 0 and rec["t"] > 0
+        assert get_trace_ctx(meta)["hops"] == [rec]
+
+    def test_hop_spans_decomposition(self):
+        hops = [{"hop": h, "t": t} for h, t in (
+            ("client_send", 1.000), ("admit", 1.001), ("dequeue", 1.003),
+            ("dispatch", 1.004), ("worker_recv", 1.010),
+            ("worker_done", 1.030), ("reply", 1.031))]
+        s = hop_spans(hops)
+        assert s["admission_wait_ms"] == pytest.approx(2.0, abs=1e-6)
+        assert s["route_ms"] == pytest.approx(1.0, abs=1e-6)
+        assert s["worker_queue_ms"] == pytest.approx(6.0, abs=1e-6)
+        assert s["service_ms"] == pytest.approx(20.0, abs=1e-6)
+        assert s["reply_ms"] == pytest.approx(1.0, abs=1e-6)
+        assert s["total_ms"] == pytest.approx(31.0, abs=1e-6)
+        assert "retries" not in s and "redeliveries" not in s
+
+    def test_hop_spans_redelivery_last_attempt_wins(self):
+        hops = [{"hop": h, "t": t} for h, t in (
+            ("client_send", 0.0), ("client_send", 0.050),   # one retry
+            ("admit", 0.051), ("dequeue", 0.052),
+            ("dispatch", 0.053), ("reoffer", 0.080),        # dead worker
+            ("dispatch", 0.081), ("worker_recv", 0.082),
+            ("worker_done", 0.092), ("reply", 0.093))]
+        s = hop_spans(hops)
+        assert s["retries"] == 1
+        assert s["redeliveries"] == 1
+        # stage math uses the LAST dispatch, not the dead one
+        assert s["worker_queue_ms"] == pytest.approx(1.0, abs=1e-6)
+
+    def test_wire_codec_carries_nested_ctx(self):
+        from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
+
+        buf = TensorBuffer.of(np.ones((4,), np.float32), pts=7)
+        ensure_trace_ctx(buf.meta)
+        stamp_hop(buf.meta, "client_send", pts=7)
+        out, _ = decode_buffer(encode_buffer(buf))
+        ctx = get_trace_ctx(out.meta)
+        assert ctx is not None
+        assert ctx["id"] == get_trace_ctx(buf.meta)["id"]
+        assert ctx["hops"][0]["hop"] == "client_send"
+
+
+class TestChildShipping:
+    def _child_with_work(self, n=5):
+        child = Tracer()
+        child.enable_shipping()
+        buf = TensorBuffer.of(np.ones((2,), np.float32))
+        t0 = time.perf_counter()
+        for i in range(n):
+            child.record_process("echo", buf, t0 + i, t0 + i + 0.001)
+        return child
+
+    def test_ship_delta_then_quiet_returns_none(self):
+        child = self._child_with_work()
+        delta = child.ship_delta()
+        assert delta["events_total_delta"] == 5
+        assert delta["hists"]["echo"]["count"] == 5
+        assert child.ship_delta() is None      # nothing new
+
+    def test_deltas_not_cumulative(self):
+        child = self._child_with_work(3)
+        child.ship_delta()
+        buf = TensorBuffer.of(np.ones((2,), np.float32))
+        t0 = time.perf_counter()
+        child.record_process("echo", buf, t0, t0 + 0.001)
+        d2 = child.ship_delta()
+        assert d2["events_total_delta"] == 1
+        assert d2["hists"]["echo"]["count"] == 1
+
+    def test_parent_merge_namespaces_and_counts(self):
+        parent = Tracer()
+        child = self._child_with_work(4)
+        parent.ingest_child(0, 111, child.ship_delta(), label="pool-w0")
+        assert parent.hists()["w0/echo"]["count"] == 4
+        kids = parent.children()
+        assert kids[0]["pid"] == 111 and kids[0]["events_total"] == 4
+        assert kids[0]["events_dropped"] == 0
+        assert parent.summary()["children"]["0"]["label"] == "pool-w0"
+
+    def test_restart_resumes_totals_monotone(self):
+        # a replacement worker ships deltas from zero; parent totals
+        # must keep rising, never reset
+        parent = Tracer()
+        child = self._child_with_work(3)
+        parent.ingest_child(0, 111, child.ship_delta())
+        total_before = parent.total_events
+        replacement = self._child_with_work(2)     # fresh process
+        parent.ingest_child(0, 222, replacement.ship_delta())
+        assert parent.total_events == total_before + 2
+        assert parent.hists()["w0/echo"]["count"] == 5
+        assert parent.children()[0]["pid"] == 222  # new pid tracked
+
+    def test_clock_offset_applied_to_child_events(self):
+        parent = Tracer()
+        buf = TensorBuffer.of(np.ones((2,), np.float32))
+        t0 = time.perf_counter()
+        parent.record_process("router", buf, t0, t0 + 1e-4)
+        child = self._child_with_work(1)
+        parent.ingest_child(0, 111, child.ship_delta(), offset_s=100.0)
+        doc = parent.to_chrome_trace("p")
+        parent_spans = [e for e in doc["traceEvents"]
+                        if e.get("ph") == "X" and e.get("pid") == 0]
+        child_spans = [e for e in doc["traceEvents"]
+                       if e.get("ph") == "X" and e.get("pid") == 1]
+        assert parent_spans and child_spans
+        # Chrome ts is µs (normalized to trace start): the 100s skew
+        # correction must push the child span ~100s past the parent's
+        gap_us = child_spans[0]["ts"] - parent_spans[0]["ts"]
+        assert gap_us >= 99.0 * 1e6
+
+    def test_ring_wrap_keeps_child_drop_accounting_exact(self):
+        # satellite: child batches arriving after the PARENT ring
+        # wrapped must keep events_dropped and per-element counters
+        # exact — the per-child ring has its own drop budget
+        parent = Tracer(max_events=64)     # child rings: max(1024, 16)
+        # wrap the parent's own ring completely
+        buf = TensorBuffer.of(np.ones((2,), np.float32))
+        t0 = time.perf_counter()
+        for i in range(200):
+            parent.record_process("parent_el", buf, t0, t0 + 1e-4)
+        assert parent.events_dropped > 0
+        parent_dropped = parent.events_dropped
+        # now a child ships MORE events than its parent-side ring holds
+        child = Tracer()
+        child.enable_shipping()
+        for i in range(1500):
+            child.record_process("echo", buf, t0, t0 + 1e-4)
+        parent.ingest_child(0, 111, child.ship_delta())
+        kids = parent.children()
+        assert kids[0]["events_total"] == 1500
+        assert kids[0]["events_kept"] == 1024
+        assert kids[0]["events_dropped"] == 1500 - 1024
+        # pool-level totals: monotone counter and exact drop sum
+        assert parent.total_events == 200 + 1500
+        assert parent.events_dropped == parent_dropped + (1500 - 1024)
+        # histogram counters survive wrap exactly (kept-whole, not ring)
+        assert parent.hists()["w0/echo"]["count"] == 1500
+
+    def test_child_ring_wrap_reported_by_child(self):
+        # the CHILD's own ring can wrap between ships: its self-reported
+        # drop delta must flow into the parent's accounting
+        parent = Tracer()
+        child = Tracer(max_events=32)
+        child.enable_shipping()
+        buf = TensorBuffer.of(np.ones((2,), np.float32))
+        t0 = time.perf_counter()
+        for i in range(100):
+            child.record_process("echo", buf, t0, t0 + 1e-4)
+        delta = child.ship_delta()
+        assert delta["events_dropped_delta"] == 100 - 32
+        parent.ingest_child(0, 111, delta)
+        assert parent.children()[0]["events_dropped"] == 100 - 32
+        assert parent.events_dropped >= 100 - 32
+
+    def test_requests_merge_with_offset(self):
+        parent = Tracer()
+        child = Tracer()
+        child.enable_shipping()
+        hops = [{"hop": "worker_recv", "t": 1.0},
+                {"hop": "worker_done", "t": 1.002}]
+        child.record_request("svc", "abcd1234abcd1234", hops, 1.002)
+        parent.ingest_child(1, 99, child.ship_delta(), offset_s=2.0)
+        reqs = parent.requests()
+        assert len(reqs) == 1
+        name, tid, t, _, _ = reqs[0]
+        assert name == "w1/svc" and tid == "abcd1234abcd1234"
+        assert t == pytest.approx(3.002)
+
+
+class TestMergeChromeTraces:
+    def test_pid_remap_no_collisions(self):
+        def mkdoc():
+            tr = Tracer()
+            child = Tracer()
+            child.enable_shipping()
+            buf = TensorBuffer.of(np.ones((2,), np.float32))
+            t0 = time.perf_counter()
+            child.record_process("echo", buf, t0, t0 + 1e-4)
+            tr.record_process("router", buf, t0, t0 + 1e-4)
+            tr.ingest_child(0, 1, child.ship_delta())
+            return tr.to_chrome_trace("p")
+
+        a, b = mkdoc(), mkdoc()
+        merged = merge_chrome_traces([a, b], labels=["runA", "runB"])
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        names = {e["pid"]: e["args"]["name"]
+                 for e in merged["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        assert len(pids) == 4            # 2 docs x (parent + 1 worker)
+        assert sum(1 for n in names.values()
+                   if n.startswith("runA/")) == 2
+        assert sum(1 for n in names.values()
+                   if n.startswith("runB/")) == 2
+        total = len(a["traceEvents"]) + len(b["traceEvents"])
+        assert len(merged["traceEvents"]) == total
+
+
+class TestHistBounds:
+    def test_bounds_cover_service_range(self):
+        assert HIST_BOUNDS_S[0] == pytest.approx(1e-5)
+        assert HIST_BOUNDS_S[-1] == pytest.approx(10.0)
+        assert list(HIST_BOUNDS_S) == sorted(HIST_BOUNDS_S)
