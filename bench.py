@@ -1823,6 +1823,38 @@ def traffic_serve() -> dict:
             and kill["conserved"] and not kill["orphans"]
             and out["kill_goodput_win"]):
         out["unverified"] = True   # ship the numbers, flag the claim
+    # mesh partition acceptance point (BENCH_TRAFFIC_MESH_GATE=1; off
+    # by default — it spins 2 pool hosts + a chaos proxy and its
+    # lease-expiry wait adds wall time): blackhole one of two hosts
+    # mid-flood at 1.5x aggregate capacity. Gate: zero lost, per-host
+    # conservation exact, fence within 2x the lease, and at least one
+    # cross-host redelivery carrying a single trace id (the frame's
+    # story survives the failover).
+    if os.environ.get("BENCH_TRAFFIC_MESH_GATE") == "1":
+        from nnstreamer_tpu.traffic import run_against_mesh
+
+        mesh = run_against_mesh(
+            hosts=2, workers_per_host=1, pattern="poisson",
+            load_x=1.5, n=240, service_ms=pool_ms, max_pending=64,
+            p99_budget_ms=250.0, seed=42, lease_s=1.0,
+            max_redeliver=2)
+        mpt = _traffic_point(mesh)
+        mpt.update({k: mesh[k] for k in (
+            "recovered", "fence_detect_s", "conserved",
+            "redelivered", "perhost_replied_sum", "seed")
+            if k in mesh})
+        mpt["orphans"] = len(mesh["orphans"])
+        mpt["cross_host_trace"] = any(
+            len(ex.get("hosts", [])) >= 2
+            for ex in mesh.get("redelivered_examples", []))
+        out["mesh_blackhole_x1.5"] = mpt
+        out["mesh_gate_ok"] = (
+            mesh["lost"] == 0 and mesh["conserved"]
+            and mesh.get("recovered", False)
+            and not mesh["orphans"] and mpt["cross_host_trace"])
+        if not out["mesh_gate_ok"]:
+            out["unverified"] = True   # ship the numbers, flag it
+        _family_partial(dict(out))
     return out
 
 

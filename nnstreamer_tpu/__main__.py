@@ -29,6 +29,11 @@
                                                        #  worker mid-flood
     python -m nnstreamer_tpu serve --workers 4         # supervised worker
                                                        #  pool (SIGTERM drains)
+    python -m nnstreamer_tpu mesh --listen             # multi-host router
+                                                       #  (pools join with
+                                                       #  serve --join)
+    python -m nnstreamer_tpu mesh --hosts 2            # partition-chaos
+                                                       #  demo + SLO report
     python -m nnstreamer_tpu lint [--json]             # project static
                                                        #  analysis (nnlint)
 """
@@ -305,6 +310,16 @@ def _serve_main(argv) -> int:
     ap.add_argument("--trace-out", default=None, metavar="FILE",
                     help="write the merged multi-process Chrome trace "
                          "here at drain (also turns on the pool tracer)")
+    ap.add_argument("--join", default=None, metavar="HOST:PORT",
+                    help="register this pool as a host of a mesh "
+                         "router (python -m nnstreamer_tpu mesh "
+                         "--listen); the pool keeps serving its own "
+                         "port too")
+    ap.add_argument("--join-name", default=None,
+                    help="host name advertised to the router "
+                         "(default host:port of this pool)")
+    ap.add_argument("--zone", default="",
+                    help="locality zone advertised to the router")
     args = ap.parse_args(argv)
 
     from nnstreamer_tpu.serving.pool import PooledQueryServer
@@ -343,6 +358,17 @@ def _serve_main(argv) -> int:
                              health=lambda: {"pool": pqs.stats()["pool"]})
         print(f"metrics on http://{args.metrics_host}:{msrv.port}"
               f"/metrics", file=sys.stderr)
+    agent = None
+    if args.join:
+        from nnstreamer_tpu.serving.mesh import pool_join
+
+        rhost, _, rport = args.join.rpartition(":")
+        agent = pool_join(
+            pqs, rhost or "127.0.0.1", int(rport),
+            name=args.join_name or f"{args.host}:{pqs.port}",
+            zone=args.zone)
+        print(f"joined mesh router {args.join} as "
+              f"{agent.name!r}", file=sys.stderr)
     print(f"pool serving on {args.host}:{pqs.port} "
           f"({args.workers} worker(s); SIGTERM/^C drains)",
           file=sys.stderr)
@@ -358,6 +384,8 @@ def _serve_main(argv) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if agent is not None:
+            agent.stop()
         pqs.close()
         if msrv is not None:
             msrv.close()
@@ -367,6 +395,136 @@ def _serve_main(argv) -> int:
             print(f"chrome trace written to {args.trace_out}",
                   file=sys.stderr)
     return 0
+
+
+def _mesh_main(argv) -> int:
+    """`mesh` subcommand. Two modes:
+
+    --listen: run a MeshRouter until ^C — clients dial it like any
+    query server; pools join with `serve --join HOST:PORT`.
+
+    default (demo): the chaos acceptance drill from docs/robustness.md —
+    spin up N local pool hosts behind one router, flood it open-loop
+    above aggregate capacity while one host is blackholed mid-flood,
+    and print the SLO + conservation report. Exit 0 iff nothing was
+    lost and the per-host counters conserve."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu mesh",
+        description="multi-host serving mesh: router (--listen) or "
+                    "partition-chaos demo (docs/robustness.md)")
+    ap.add_argument("--listen", action="store_true",
+                    help="run a router until ^C instead of the demo")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="router port (0 picks a free one, printed)")
+    ap.add_argument("--id", type=int, default=0, help="server pair id")
+    ap.add_argument("--dims", default="8:1",
+                    help="accepted input dims (HELLO contract)")
+    ap.add_argument("--types", default="float32")
+    ap.add_argument("--max-pending", type=int, default=64)
+    ap.add_argument("--shed-policy", default="reject-newest",
+                    choices=("reject-newest", "reject-oldest",
+                             "deadline-drop"))
+    ap.add_argument("--lease-s", type=float, default=2.0,
+                    help="host lease: silent for this long => fenced")
+    ap.add_argument("--max-redeliver", type=int, default=1,
+                    help="cross-host re-offers per frame after a fence")
+    ap.add_argument("--zone", default="",
+                    help="router zone (locality-aware routing)")
+    ap.add_argument("--stats-every", type=float, default=0.0,
+                    help="--listen: print router stats JSON every N s")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="--listen: Prometheus exposition with per-host "
+                         "series on http://HOST:PORT/metrics")
+    # demo mode
+    ap.add_argument("--hosts", type=int, default=2,
+                    help="demo: local pool hosts to spin up")
+    ap.add_argument("--workers-per-host", type=int, default=1)
+    ap.add_argument("--pattern", default="poisson",
+                    choices=("poisson", "bursty"))
+    ap.add_argument("--load-x", type=float, default=1.5,
+                    help="demo: offered load vs aggregate capacity")
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--service-ms", type=float, default=20.0)
+    ap.add_argument("--blackhole-at", type=float, default=None,
+                    help="demo: partition one host at t seconds "
+                         "(default: the median arrival)")
+    ap.add_argument("--heal-after", type=float, default=None,
+                    help="demo: heal the partition after N more "
+                         "seconds and wait for the host to rejoin")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--budget-ms", type=float, default=250.0)
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw report JSON only")
+    args = ap.parse_args(argv)
+
+    if args.listen:
+        from nnstreamer_tpu.serving.mesh import MeshRouter
+
+        router = MeshRouter(
+            host=args.host, port=args.port, sid=args.id,
+            dims=args.dims, types=args.types,
+            max_pending=args.max_pending, shed_policy=args.shed_policy,
+            lease_s=args.lease_s, max_redeliver=args.max_redeliver,
+            zone=args.zone)
+        msrv = None
+        if args.metrics_port is not None:
+            from nnstreamer_tpu.serving.metrics import (
+                MetricsServer, metrics_snapshot)
+
+            def collect():
+                s = router.stats()
+                return metrics_snapshot(admission=s.get("admission"),
+                                        mesh=s)
+
+            msrv = MetricsServer(collect, host=args.host,
+                                 port=args.metrics_port,
+                                 health=lambda: router.stats()["mesh"])
+            print(f"metrics on http://{args.host}:{msrv.port}/metrics",
+                  file=sys.stderr)
+        print(f"mesh router on {args.host}:{router.port} "
+              f"(lease {args.lease_s}s; join pools with: python -m "
+              f"nnstreamer_tpu serve --join {args.host}:{router.port}; "
+              f"^C stops)", file=sys.stderr)
+        last = time.monotonic()
+        try:
+            while True:
+                time.sleep(0.2)
+                if args.stats_every and \
+                        time.monotonic() - last >= args.stats_every:
+                    last = time.monotonic()
+                    print(json.dumps(router.stats(), default=float),
+                          file=sys.stderr)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            router.close()
+            if msrv is not None:
+                msrv.close()
+        return 0
+
+    from nnstreamer_tpu.traffic import run_against_mesh
+
+    report = run_against_mesh(
+        hosts=args.hosts, workers_per_host=args.workers_per_host,
+        pattern=args.pattern, load_x=args.load_x, n=args.requests,
+        service_ms=args.service_ms, max_pending=args.max_pending,
+        p99_budget_ms=args.budget_ms, seed=args.seed,
+        lease_s=args.lease_s, max_redeliver=args.max_redeliver,
+        blackhole_at_s=args.blackhole_at, heal_after_s=args.heal_after)
+    if args.json:
+        print(json.dumps(report, default=float))
+    else:
+        report.pop("queue_depth_timeline", None)
+        print(json.dumps(report, indent=2, default=float))
+        ex = (report.get("redelivered_examples") or [None])[0]
+        if ex:
+            print(f"cross-host redelivery: pts={ex['pts']} "
+                  f"trace={ex['trace_id']} hosts={ex['hosts']}",
+                  file=sys.stderr)
+    ok = report.get("lost", 1) == 0 and report.get("conserved", False)
+    return 0 if ok else 1
 
 
 def _top_main(argv) -> int:
@@ -565,6 +723,8 @@ def main(argv=None) -> int:
         return _traffic_main(argv[1:])
     if argv and argv[0] == "serve":
         return _serve_main(argv[1:])
+    if argv and argv[0] == "mesh":
+        return _mesh_main(argv[1:])
     if argv and argv[0] == "top":
         return _top_main(argv[1:])
     if argv and argv[0] == "lint":

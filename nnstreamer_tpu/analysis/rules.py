@@ -13,6 +13,9 @@ NNL006 picklable-errors   every public error class carries the
                           __reduce__ round-trip contract
 NNL007 thread-audit       every thread is daemon or joined/cancelled on
                           a close path
+NNL008 socket-audit       every socket in the serving path has a
+                          deadline (timeout kwarg / settimeout) or is
+                          owned by a reader/accept thread
 
 Every rule is pure AST — nothing here imports the code under analysis.
 Heuristics err toward silence (a missed finding is a review problem; a
@@ -622,10 +625,86 @@ class ThreadAudit(Rule):
                 return
 
 
+class SocketAudit(Rule):
+    rule_id = "NNL008"
+    title = "socket-audit"
+    rationale = (
+        "a socket with no deadline is an unbounded wait: an outbound "
+        "dial into a blackholed address sits in the OS connect retry "
+        "cycle (~2 minutes of SYN retransmits) wedging the dialing "
+        "thread, and a blocking recv with no owner thread wedges "
+        "whoever calls it — the mesh lease detector can fence a dead "
+        "host in seconds only if no layer below it blocks for minutes")
+
+    #: the serving path: every socket here sits under real traffic
+    SCOPE = ("edge/", "serving/", "traffic/")
+    #: creation calls we audit (module-qualified only: a bare .socket
+    #: attribute or local create_connection helper is out of scope —
+    #: heuristics err toward silence)
+    DIAL_CALLS = ("socket.create_connection", "_socket.create_connection")
+    RAW_CALLS = ("socket.socket", "_socket.socket")
+
+    def check(self, module: Module, project: Project):
+        if not any(f"/{d}" in f"/{module.path}" for d in self.SCOPE):
+            return
+        thread_owned = self._thread_owned_names(module.tree)
+        src = module.src
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d in self.DIAL_CALLS:
+                # create_connection(addr, timeout) — second positional
+                # or timeout= kwarg bounds the dial
+                if len(node.args) >= 2 \
+                        or any(k.arg == "timeout" for k in node.keywords):
+                    continue
+                yield node, (
+                    "outbound dial without a connect timeout: pass "
+                    "timeout= (DEFAULT_CONNECT_TIMEOUT_S) — the OS "
+                    "default is minutes of SYN retries and the dialing "
+                    "thread wedges for all of them")
+            elif d in self.RAW_CALLS:
+                target = ThreadAudit._assign_target(module, node)
+                if target and (f"{target}.settimeout" in src
+                               or target in thread_owned):
+                    continue
+                yield node, (
+                    "socket in the serving path with no deadline "
+                    "discipline: call settimeout(), or hand it to a "
+                    "dedicated reader/accept thread (NNL007-audited) "
+                    "whose close path unblocks it")
+
+    @staticmethod
+    def _thread_owned_names(tree: ast.AST) -> Set[str]:
+        """Names (x / self.x attrs) referenced inside a function that
+        some threading.Thread/Timer in this module runs as target=.
+        A socket owned by such a thread is bounded by the thread's
+        lifecycle, which NNL007 separately audits."""
+        targets: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and dotted(node.func).split(".")[-1] in ("Thread",
+                                                             "Timer"):
+                for k in node.keywords:
+                    if k.arg == "target":
+                        targets.add(dotted(k.value).split(".")[-1])
+        owned: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in targets:
+                for inner in ast.walk(node):
+                    if isinstance(inner, ast.Attribute):
+                        owned.add(inner.attr)
+                    elif isinstance(inner, ast.Name):
+                        owned.add(inner.id)
+        return owned
+
+
 #: registry, in catalog order
 ALL_RULES: List[Rule] = [
     ElementContract(), ForcedSync(), LockDiscipline(), JitPurity(),
-    SpawnSafety(), PicklableErrors(), ThreadAudit(),
+    SpawnSafety(), PicklableErrors(), ThreadAudit(), SocketAudit(),
 ]
 
 

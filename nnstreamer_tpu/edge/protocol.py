@@ -45,9 +45,27 @@ T_PONG = 8
 # {"pts", "cause", "queue_depth", "retry_after_ms"} — enough for the
 # client to back off instead of timing out blind (traffic/admission.py)
 T_BUSY = 9
+# mesh control plane (serving/mesh.py). REGISTER: a worker host joins
+# the router, JSON ad {"name", "capacity_rps", "dims", "types",
+# "out_dims", "out_types", "versions", "zone", "lease_s"}. LEASE:
+# heartbeat renewal, JSON {"name", "counters"} — the router fences a
+# host whose lease expires (silent-host detection, not just conn EOF).
+# SWAP/SWAP_ACK: two-phase version swap broadcast, JSON
+# {"phase": "prepare"|"commit"|"abort", "model", "version", "epoch"}.
+T_REGISTER = 10
+T_REGISTER_ACK = 11
+T_LEASE = 12
+T_SWAP = 13
+T_SWAP_ACK = 14
 
 #: hard cap on a single message (matches wire.MAX_FRAME_BYTES intent)
 MAX_MSG = 1 << 31
+
+#: default outbound connect timeout. The OS default (no timeout on the
+#: connect() syscall) is ~2 minutes of SYN retries — a blackholed peer
+#: would wedge the dialing thread for that long. A few seconds fails
+#: fast into the caller's retry path instead (docs/robustness.md).
+DEFAULT_CONNECT_TIMEOUT_S = 5.0
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -250,7 +268,9 @@ class MsgClient:
 
     def __init__(self, host: str, port: int, *, on_message: Callable,
                  on_close: Optional[Callable] = None,
-                 connect_timeout: float = 10.0, retries: int = 3):
+                 connect_timeout: Optional[float] = None, retries: int = 3):
+        if connect_timeout is None:
+            connect_timeout = DEFAULT_CONNECT_TIMEOUT_S
         self.host, self.port = host, port
         self._on_message = on_message
         self._on_close = on_close

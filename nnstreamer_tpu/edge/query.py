@@ -432,6 +432,13 @@ class TensorQueryClient(Element):
         # exactly the per-frame sync the TPU design avoids). Ordering is
         # preserved: one TCP connection, FIFO server pipeline.
         "max_in_flight": PropDef(int, 1, "1 = reference per-frame sync"),
+        # Bounds the TCP dial itself (SYN + handshake), distinct from
+        # timeout= which bounds per-frame replies on an established
+        # connection. 0 falls back to protocol.DEFAULT_CONNECT_TIMEOUT_S;
+        # without a bound a dial into a dead/filtered address would sit
+        # in the OS connect retry cycle (~minutes) wedging negotiate().
+        "connect_timeout": PropDef(
+            float, 0.0, "TCP connect timeout, s (0 = default)"),
     }
 
     def __init__(self, name=None, **props):
@@ -485,8 +492,9 @@ class TensorQueryClient(Element):
                 f"connect_type must be tcp|hybrid, got "
                 f"{self.props['connect_type']!r}")
         try:
-            self._client = P.MsgClient(host, port,
-                                       on_message=self._on_message)
+            self._client = P.MsgClient(
+                host, port, on_message=self._on_message,
+                connect_timeout=self.props["connect_timeout"] or None)
         except StreamError as e:
             self.fail_negotiation(str(e))
         dims, types, _ = spec.to_strings()

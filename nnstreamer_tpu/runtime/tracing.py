@@ -131,7 +131,7 @@ HOP_STAGES = (
 )
 
 
-def hop_spans(hops: List[dict]) -> Dict[str, float]:
+def hop_spans(hops: List[dict]) -> Dict[str, Any]:
     """Per-stage latency decomposition (ms) from a hop list: admission
     wait / route / worker queue / service / reply, plus total. For a
     redelivered request the LAST occurrence of each hop wins (the
@@ -142,7 +142,7 @@ def hop_spans(hops: List[dict]) -> Dict[str, float]:
     for h in hops:
         if isinstance(h, dict) and "hop" in h and "t" in h:
             last[h["hop"]] = h
-    out: Dict[str, float] = {}
+    out: Dict[str, Any] = {}
     for key, a, b in HOP_STAGES:
         if a in last and b in last:
             dt = (last[b]["t"] - last[a]["t"]) * 1e3
@@ -160,6 +160,16 @@ def hop_spans(hops: List[dict]) -> Dict[str, float]:
                and h.get("hop") == "reoffer")
     if n_re:
         out["redeliveries"] = n_re
+    # host-level hops (serving/mesh.py): the router's dispatch records
+    # carry the host name — a cross-host redelivered request lists
+    # every host its timeline touched, in first-dispatch order
+    hosts: List[str] = []
+    for h in hops:
+        if isinstance(h, dict) and h.get("hop") == "dispatch" \
+                and "host" in h and str(h["host"]) not in hosts:
+            hosts.append(str(h["host"]))
+    if hosts:
+        out["hosts"] = hosts
     return out
 
 

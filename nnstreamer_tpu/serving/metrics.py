@@ -53,6 +53,7 @@ def _series(name: str, typ: str, help_: str,
 
 def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                      pool: Optional[dict] = None,
+                     mesh: Optional[dict] = None,
                      extra: Optional[Dict[str, float]] = None,
                      namespace: str = "nns") -> List[Series]:
     """Flatten runtime state into typed series.
@@ -60,6 +61,11 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
     tracer     — a runtime.tracing.Tracer (ignored when None/inactive)
     admission  — AdmissionQueue.counters() snapshot
     pool       — WorkerPool.stats() snapshot
+    mesh       — MeshRouter.stats() snapshot: per-host labelled series
+                 (the `host` label) + mesh-wide gauges; the router's
+                 own admission counters ride the `admission` arg, so
+                 Σ nns_host_replied_total == nns_admission_replied_total
+                 is checkable from one scrape
     extra      — arbitrary numeric gauges {name: value} the caller owns
                  (backend cache sizes, build info, …)
     """
@@ -136,6 +142,67 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                 [({"wid": str(w["wid"]), "state": w["state"]},
                   1.0 if w["state"] == "ready" else 0.0)
                  for w in workers]))
+
+    if mesh:
+        m = mesh.get("mesh", {})
+        for key, help_ in (("reoffered", "frames redelivered after a "
+                                         "host fence"),
+                           ("busy_reroutes", "frames retried on a "
+                                             "different host after BUSY"),
+                           ("stale_results", "host results for already-"
+                                             "settled requests")):
+            out.append(_series(f"{ns}_mesh_{key}_total", "counter",
+                               f"mesh: {help_}",
+                               [({}, float(m.get(key, 0)))]))
+        for key, help_ in (("hosts", "registered hosts"),
+                           ("ready", "hosts holding a live lease"),
+                           ("fenced", "hosts cut out of the mesh"),
+                           ("pending", "router backlog"),
+                           ("epoch", "mesh swap epoch")):
+            out.append(_series(f"{ns}_mesh_{key}", "gauge",
+                               f"mesh: {help_}",
+                               [({}, float(m.get(key, 0)))]))
+        hosts = mesh.get("hosts", [])
+        if hosts:
+            out.append(_series(
+                f"{ns}_host_replied_total", "counter",
+                "per-host goodput (frames answered); summed over hosts "
+                "this equals nns_admission_replied_total — the "
+                "cross-host conservation check",
+                [({"host": str(h["host"])}, float(h["replied"]))
+                 for h in hosts]))
+            out.append(_series(
+                f"{ns}_host_busies_total", "counter",
+                "per-host typed BUSY refusals seen by the router",
+                [({"host": str(h["host"])}, float(h["busies"]))
+                 for h in hosts]))
+            out.append(_series(
+                f"{ns}_host_outstanding", "gauge",
+                "frames dispatched to the host, unanswered",
+                [({"host": str(h["host"])}, float(h["outstanding"]))
+                 for h in hosts]))
+            out.append(_series(
+                f"{ns}_host_lease_age_ms", "gauge",
+                "ms since the host's last lease renewal",
+                [({"host": str(h["host"])}, float(h["lease_age_ms"]))
+                 for h in hosts]))
+            out.append(_series(
+                f"{ns}_host_up", "gauge",
+                "1 when the host holds a live lease, else 0 (state "
+                "label says why)",
+                [({"host": str(h["host"]), "state": h["state"]},
+                  1.0 if h["state"] == "READY" else 0.0)
+                 for h in hosts]))
+            # lease renewals carry each host's LOCAL admission
+            # counters: the remote half of the conservation ledger
+            remote = [(h, h.get("remote") or {}) for h in hosts]
+            if any(r for _, r in remote):
+                for key in ("offered", "admitted", "replied"):
+                    out.append(_series(
+                        f"{ns}_host_local_{key}_total", "counter",
+                        f"host-local admission {key} (lease-carried)",
+                        [({"host": str(h["host"])}, float(r[key]))
+                         for h, r in remote if key in r]))
 
     if tracer is not None and getattr(tracer, "active", False):
         hists = tracer.hists()

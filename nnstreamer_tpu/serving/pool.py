@@ -216,6 +216,7 @@ class WorkerPool:
         self.degraded = 0             # slots disabled by the circuit
         self.reoffered = 0
         self.last_worker_error: Optional[BaseException] = None
+        self._resident_versions: Dict[str, list] = {}
         self._all_pids: List[int] = []   # every pid ever spawned
         self._router: Optional[threading.Thread] = None
         self._supervisor: Optional[threading.Thread] = None
@@ -344,7 +345,13 @@ class WorkerPool:
 
     def _adopt_out_spec(self, info: dict) -> None:
         """First ready worker declares the pool's output spec (HELLO
-        contract) unless the owner already set one."""
+        contract) unless the owner already set one. The worker's
+        resident ``store://`` versions ride the same ready info — the
+        mesh REGISTER ad advertises them for locality routing."""
+        versions = info.get("versions")
+        if isinstance(versions, dict) and versions:
+            with self._lock:
+                self._resident_versions = versions
         if self.qs.out_spec is not None:
             return
         dims, types = info.get("out_dims"), info.get("out_types")
@@ -353,6 +360,12 @@ class WorkerPool:
                 self.qs.out_spec = TensorsSpec.from_strings(dims, types)
             except ValueError:
                 pass
+
+    def resident_versions(self) -> Dict[str, list]:
+        """{model name: [resident versions]} as the most recent ready
+        worker reported them (empty for echo pools)."""
+        with self._lock:
+            return dict(self._resident_versions)
 
     def _on_result(self, slot: _Slot, rid: int, payload: bytes) -> None:
         from nnstreamer_tpu.edge.wire import decode_buffer

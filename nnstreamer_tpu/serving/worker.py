@@ -194,6 +194,16 @@ class _EchoService:
         pass
 
 
+def _resident_versions() -> dict:
+    """{model name: [versions]} resident in THIS process's store —
+    advertised through the pool's ready info so a mesh REGISTER ad can
+    route for model locality without an extra round trip."""
+    from nnstreamer_tpu.serving.store import get_store
+
+    store = get_store()
+    return {n: sorted(store.entry(n).versions) for n in store.names()}
+
+
 class _PipelineService:
     """One full pipeline copy: appsrc ! <spec.pipeline> ! tensor_sink.
 
@@ -251,7 +261,9 @@ class _PipelineService:
         self._collector.start()
 
     def ready_info(self) -> dict:
-        return dict(self._out_info)
+        info = dict(self._out_info)
+        info["versions"] = _resident_versions()
+        return info
 
     def serve(self, rid: int, payload: bytes, reply) -> None:
         from nnstreamer_tpu.edge.wire import decode_buffer

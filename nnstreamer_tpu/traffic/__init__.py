@@ -8,7 +8,11 @@ discipline real traffic forces onto it:
   and exhaustive per-cause accounting (nothing is ever silently lost).
 - loadgen.py   — open-loop Poisson / bursty (Markov-modulated on/off)
   load harness with a latency-SLO report: goodput at a p99 budget,
-  shed rate, queue-depth timeline.
+  shed rate, queue-depth timeline. `run_against_mesh` floods a
+  multi-host MeshRouter while a host is partitioned mid-flood.
+- netchaos.py  — deterministic seeded network fault injection
+  (delay/drop/duplicate/blackhole/slow-close) at message granularity,
+  between any two query-wire peers.
 
 Surfaces: `tensor_query_serversrc` admission properties (max_pending /
 max_inflight / shed_policy), `tensor_query_client` BUSY backpressure
@@ -20,17 +24,20 @@ from nnstreamer_tpu.traffic.admission import (
     DEADLINE_META, SHED_POLICIES, AdmissionDecision, AdmissionQueue)
 from nnstreamer_tpu.traffic.loadgen import (
     EchoServer, bursty_arrivals, poisson_arrivals, run_against_echo,
-    run_against_pool, run_open_loop)
+    run_against_mesh, run_against_pool, run_open_loop)
+from nnstreamer_tpu.traffic.netchaos import ChaosProxy
 
 __all__ = [
     "AdmissionDecision",
     "AdmissionQueue",
+    "ChaosProxy",
     "DEADLINE_META",
     "SHED_POLICIES",
     "EchoServer",
     "bursty_arrivals",
     "poisson_arrivals",
     "run_against_echo",
+    "run_against_mesh",
     "run_against_pool",
     "run_open_loop",
 ]

@@ -44,6 +44,7 @@ queue).
 
 from __future__ import annotations
 
+import math
 import queue as _queue
 import threading
 import time
@@ -199,10 +200,20 @@ class AdmissionQueue:
 
     def _retry_after_locked(self) -> float:
         """Suggested client backoff: expected time for the current queue
-        to drain at the EWMA service rate, clamped to [1ms, 10s]."""
-        if self._ewma_reply_s is None:
+        to drain at the EWMA service rate, clamped to [1ms, 10s].
+
+        Cold start: before the first reply lands the EWMA has no
+        samples — a freshly joined host must still advertise a finite,
+        positive hint (a zero/degenerate backoff would turn every BUSY
+        into an immediate-retry hot loop against the emptiest host in
+        the mesh), so the default and a non-finite/non-positive EWMA
+        both fall back to `_DEFAULT_RETRY_MS`."""
+        ewma = self._ewma_reply_s
+        if ewma is None or not math.isfinite(ewma) or ewma <= 0.0:
             return _DEFAULT_RETRY_MS
-        est = (len(self._q) + 1) * self._ewma_reply_s * 1e3
+        est = (len(self._q) + 1) * ewma * 1e3
+        if not math.isfinite(est):
+            return 10_000.0
         return min(max(est, 1.0), 10_000.0)
 
     # -- queue.Queue-compatible consumer side ------------------------------

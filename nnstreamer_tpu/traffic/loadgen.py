@@ -296,6 +296,28 @@ def run_open_loop(host: str, port: int, *, dims: str,
                               else v) for k, v in spans.items()},
             }
         report["traced_replies"] = len(traces)
+        # redelivery audit: replies whose trace context carries a
+        # router/mesh "reoffer" hop survived a worker death or a host
+        # fence — list which workers/hosts each one touched, proving
+        # one trace_id spans the whole failover
+        redelivered = []
+        for i, ctx in traces.items():
+            hops = [h for h in ctx.get("hops", [])
+                    if isinstance(h, dict)]
+            if not any(h.get("hop") == "reoffer" for h in hops):
+                continue
+            redelivered.append({
+                "pts": i,
+                "trace_id": ctx.get("id"),
+                "hosts": sorted({str(h["host"]) for h in hops
+                                 if h.get("hop") == "dispatch"
+                                 and "host" in h}),
+                "wids": sorted({int(h["wid"]) for h in hops
+                                if h.get("hop") == "dispatch"
+                                and "wid" in h}),
+            })
+        report["redelivered"] = len(redelivered)
+        report["redelivered_examples"] = redelivered[:3]
     if tl:
         # downsample the timeline to <= 200 points, keep the peak honest
         step = max(1, len(tl) // 200)
@@ -544,3 +566,182 @@ def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,
     finally:
         if not closed:
             pqs.close()
+
+
+#: query-server ids the mesh harness burns through (the registry is
+#: process-wide; a crashed run must not leave a stale id in the way)
+_mesh_sids = None
+
+
+def _next_mesh_sid() -> int:
+    global _mesh_sids
+    if _mesh_sids is None:
+        import itertools
+
+        _mesh_sids = itertools.count(9500)
+    return next(_mesh_sids)
+
+
+def run_against_mesh(*, hosts: int = 2, workers_per_host: int = 1,
+                     pattern: str = "poisson", load_x: float = 1.5,
+                     n: int = 300, service_ms: float = 20.0,
+                     max_pending: int = 64,
+                     p99_budget_ms: float = 250.0, seed: int = 0,
+                     lease_s: float = 1.0, max_redeliver: int = 2,
+                     blackhole_at_s: Optional[float] = None,
+                     blackhole_host: Optional[int] = 0,
+                     heal_after_s: Optional[float] = None,
+                     drain_timeout_s: float = 15.0,
+                     trace: bool = True,
+                     **mesh_kwargs) -> dict:
+    """Chaos-partition harness run: a `MeshRouter` fronting `hosts`
+    worker-pool hosts (each a separate PR-10 subprocess pool joined by
+    a `HostAgent`), flooded open-loop at `load_x` × the mesh's
+    aggregate capacity while host `blackhole_host` is silently
+    partitioned mid-flood through a netchaos proxy (set it to None for
+    a fault-free run).
+
+    The acceptance contract this encodes (ISSUE 12): zero lost —
+    every request resolves as RESULT or typed BUSY; `conserved` — the
+    router's two admission invariants hold exactly; the fence lands
+    within the lease budget (`fence_detect_s`); and with ``trace=True``
+    a cross-host redelivered frame keeps ONE trace_id whose dispatch
+    hops show both hosts (`redelivered_examples`).
+
+    With `heal_after_s`, the partition heals that many seconds after it
+    starts and the report waits for the agent's rejoin
+    (`rejoined`) — the full fence → re-offer → rejoin cycle.
+    """
+    from nnstreamer_tpu.serving.mesh import MeshRouter, pool_join
+    from nnstreamer_tpu.serving.pool import PooledQueryServer, proc_alive
+    from nnstreamer_tpu.traffic.netchaos import ChaosProxy
+
+    if hosts < 1:
+        raise ValueError(f"hosts must be >= 1, got {hosts}")
+    rng = np.random.default_rng(seed)
+    router = MeshRouter(
+        sid=_next_mesh_sid(), dims="8:1", types="float32",
+        max_pending=max_pending, lease_s=lease_s,
+        max_redeliver=max_redeliver, **mesh_kwargs)
+    pools: List = []
+    agents: List = []
+    proxies: List = []
+    timers: List[threading.Timer] = []
+    t_bh = [None]                    # monotonic blackhole instant
+    try:
+        for k in range(hosts):
+            pqs = PooledQueryServer.echo(
+                workers=workers_per_host, service_ms=service_ms,
+                sid=_next_mesh_sid(), max_pending=max_pending)
+            pools.append(pqs)
+            r_host, r_port = "127.0.0.1", router.port
+            if blackhole_host is not None and k == blackhole_host:
+                proxy = ChaosProxy("127.0.0.1", router.port, seed=seed)
+                proxies.append(proxy)
+                r_host, r_port = proxy.host, proxy.port
+            agents.append(pool_join(
+                pqs, r_host, r_port, name=f"host{k}",
+                connect_timeout_s=2.0))
+        if not router.wait_hosts(hosts, timeout_s=10.0):
+            raise StreamError(
+                f"mesh harness: only {router.ready_hosts()}/{hosts} "
+                f"hosts registered")
+
+        capacity = hosts * workers_per_host * 1e3 / service_ms
+        arrivals = _arrivals_for(pattern, load_x * capacity, n, rng)
+        if blackhole_at_s is None:
+            blackhole_at_s = float(arrivals[len(arrivals) // 2])
+        if proxies:
+            proxy = proxies[0]
+
+            def do_blackhole():
+                t_bh[0] = time.monotonic()
+                proxy.blackhole()
+
+            t = threading.Timer(blackhole_at_s, do_blackhole)
+            t.daemon = True
+            timers.append(t)
+            if heal_after_s is not None:
+                t2 = threading.Timer(blackhole_at_s + heal_after_s,
+                                     proxy.heal)
+                t2.daemon = True
+                timers.append(t2)
+
+        x = np.ones((8, 1), np.float32)
+        for t in timers:
+            t.start()
+        try:
+            report = run_open_loop(
+                "127.0.0.1", router.port, dims="8:1", types="float32",
+                arrivals=arrivals,
+                make_frame=lambda i: TensorBuffer.of(x, pts=i),
+                p99_budget_ms=p99_budget_ms,
+                drain_timeout_s=drain_timeout_s,
+                depth_probe=router.depth_probe, trace=trace)
+        finally:
+            for t in timers:
+                t.cancel()
+        c = router.admission_counters()
+        stats = router.stats()
+        report.update({
+            "pattern": pattern, "load_x": load_x,
+            "service_ms": service_ms, "hosts": hosts,
+            "workers_per_host": workers_per_host,
+            "capacity_rps": round(capacity, 1),
+            "seed": int(seed),
+            "lease_s": lease_s,
+            "conserved": _conservation_ok(c),
+            "admission": c,
+            "mesh": stats,
+            # every router reply maps to exactly one host reply: the
+            # cross-host form of the conservation contract
+            "perhost_replied_sum": sum(h["replied"]
+                                       for h in stats["hosts"]),
+        })
+        if t_bh[0] is not None:
+            fences = [e for e in router.events
+                      if e[2] == "fence" and e[0] >= t_bh[0]]
+            detect_s = (fences[0][0] - t_bh[0]) if fences else None
+            report["blackhole_at_s"] = round(blackhole_at_s, 3)
+            report["fence_detect_s"] = \
+                round(detect_s, 3) if detect_s is not None else None
+            # fenced within the lease budget (+ the supervisor's poll
+            # cadence): the lease, not luck, found the silent host
+            report["recovered"] = bool(
+                fences and detect_s <= 2.0 * lease_s
+                and report["lost"] == 0 and report["conserved"])
+            if heal_after_s is not None:
+                # the flood may drain before the heal timer fires (it
+                # was cancelled with the rest) — the harness owns the
+                # schedule, so heal at the promised offset regardless
+                wait = (t_bh[0] + heal_after_s) - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
+                proxies[0].heal()        # idempotent if timer won
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline and \
+                        router.ready_hosts() < hosts:
+                    time.sleep(0.05)
+                report["rejoined"] = router.ready_hosts() >= hosts
+        # orphan audit must run AFTER close(): a pid still alive once
+        # every pool drained is a leaked child
+        all_pids = [p for pqs in pools
+                    for p in pqs.pool.all_pids_ever()]
+        _mesh_teardown(agents, proxies, pools, router)
+        agents, proxies, pools = [], [], []
+        router = None
+        report["orphans"] = [p for p in all_pids if proc_alive(p)]
+        return report
+    finally:
+        _mesh_teardown(agents, proxies, pools, router)
+
+
+def _mesh_teardown(agents, proxies, pools, router) -> None:
+    for a in agents:
+        a.stop()
+    for p in proxies:
+        p.close()
+    for pqs in pools:
+        pqs.close()
+    if router is not None:
+        router.close()

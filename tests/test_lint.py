@@ -395,6 +395,69 @@ def test_nnl007_silent_on_owned_threads():
     assert_silent("NNL007", {REPO_PATHS["runtime"]: GOOD_THREAD})
 
 
+# -- NNL008 socket-audit -----------------------------------------------------
+
+EDGE_PATH = "nnstreamer_tpu/edge/fix.py"
+
+BAD_SOCKET = '''
+import socket
+
+def dial(host, port):
+    return socket.create_connection((host, port))   # unbounded dial
+
+class Poller:
+    def __init__(self):
+        self._sock = socket.socket()                # no deadline, no owner
+
+    def poll(self):
+        return self._sock.recv(4)
+'''
+
+GOOD_SOCKET = '''
+import socket
+import threading
+
+def dial(host, port):
+    return socket.create_connection((host, port), 5.0)
+
+def dial_kw(host, port):
+    return socket.create_connection((host, port), timeout=5.0)
+
+class Poller:
+    def __init__(self):
+        self._sock = socket.socket()
+        self._sock.settimeout(2.0)                  # bounded
+
+class Server:
+    def __init__(self):
+        self._srv = socket.socket()                 # accept-thread-owned
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while True:
+            self._srv.accept()
+'''
+
+
+def test_nnl008_fires_on_unbounded_sockets():
+    findings = assert_fires("NNL008", {EDGE_PATH: BAD_SOCKET}, n_min=2)
+    msgs = " | ".join(f.message for f in findings)
+    assert "connect timeout" in msgs       # the dial arm
+    assert "deadline discipline" in msgs   # the raw-socket arm
+
+
+def test_nnl008_silent_on_bounded_or_thread_owned():
+    assert_silent("NNL008", {EDGE_PATH: GOOD_SOCKET})
+
+
+def test_nnl008_scoped_to_serving_path():
+    # the same unbounded sockets outside edge/serving/traffic are
+    # someone else's problem (tooling, tests, offline scripts)
+    assert_silent("NNL008", {REPO_PATHS["runtime"]: BAD_SOCKET})
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_inline_suppression_waives_a_finding():

@@ -1,0 +1,579 @@
+"""Multi-host serving mesh (serving/mesh.py + traffic/netchaos.py):
+host registration, lease-based liveness, cross-host failover, two-phase
+swap, and deterministic network fault injection.
+
+The load-bearing invariants are the same conservation pair the
+single-host admission plane enforces, now summed ACROSS hosts — a
+fenced host's in-flight frames are re-offered or typed-BUSY, never
+silently lost (ISSUE 12 acceptance)."""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu.edge.protocol as P
+from nnstreamer_tpu.core.errors import StreamError
+from nnstreamer_tpu.edge import QueryServer
+from nnstreamer_tpu.serving.mesh import HostAgent, MeshRouter
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.traffic import ChaosProxy, EchoServer
+from nnstreamer_tpu.traffic.loadgen import poisson_arrivals, run_open_loop
+
+_sid = itertools.count(8800)
+
+
+@pytest.fixture(autouse=True)
+def _clean_servers():
+    yield
+    QueryServer.reset_all()
+
+
+def _conserved(c: dict) -> bool:
+    return (c["offered"] == c["admitted"] + sum(c["rejected"].values())
+            and c["admitted"] == c["replied"] + sum(c["shed"].values())
+            + c["depth"] + c["inflight"])
+
+
+def _router(**kw) -> MeshRouter:
+    kw.setdefault("sid", next(_sid))
+    kw.setdefault("dims", "8:1")
+    kw.setdefault("types", "float32")
+    return MeshRouter(**kw)
+
+
+def _join_echo(router: MeshRouter, name: str, *, via_port=None,
+               service_ms: float = 5.0, reconnect=True, **echo_kw):
+    """EchoServer + a HostAgent registering it with `router` (optionally
+    through a chaos proxy at via_port). Returns (echo, agent)."""
+    echo = EchoServer(service_ms=service_ms, **echo_kw)
+    agent = HostAgent(
+        "127.0.0.1", via_port if via_port is not None else router.port,
+        name=name, local_port=echo.port, dims="8:1", types="float32",
+        capacity_rps=1e3 / max(service_ms, 1e-3),
+        connect_timeout_s=2.0, reconnect=reconnect).start()
+    return echo, agent
+
+
+def _flood(router: MeshRouter, n: int, rate: float, *, seed=0,
+           trace=True, **kw) -> dict:
+    x = np.zeros((8, 1), np.float32)
+    return run_open_loop(
+        "127.0.0.1", router.port, dims="8:1", types="float32",
+        arrivals=poisson_arrivals(rate, n, np.random.default_rng(seed)),
+        make_frame=lambda i: TensorBuffer.of(x, pts=i),
+        depth_probe=router.depth_probe, trace=trace, **kw)
+
+
+def _stop_all(*objs):
+    for o in objs:
+        if o is None:
+            continue
+        for meth in ("stop", "close"):
+            fn = getattr(o, meth, None)
+            if fn is not None:
+                fn()
+                break
+
+
+# -- registration + routing --------------------------------------------------
+
+class TestMeshBasics:
+    def test_two_hosts_conserve_and_split_load(self):
+        router = _router()
+        ha = hb = aa = ab = None
+        try:
+            ha, aa = _join_echo(router, "hA")
+            hb, ab = _join_echo(router, "hB")
+            assert router.wait_hosts(2, timeout_s=10)
+            r = _flood(router, 60, 200.0)
+            assert r["completed"] == 60 and r["lost"] == 0
+            assert r["rejected"] == 0
+            c = router.admission_counters()
+            assert _conserved(c)
+            st = router.stats()
+            per_host = {h["host"]: h["replied"] for h in st["hosts"]}
+            # both hosts served, and the per-host ledger sums exactly
+            # to the router's replied count (cross-host conservation)
+            assert set(per_host) == {"hA", "hB"}
+            assert all(v > 0 for v in per_host.values())
+            assert sum(per_host.values()) == c["replied"]
+        finally:
+            _stop_all(aa, ab, ha, hb, router)
+
+    def test_incompatible_host_caps_refused(self):
+        router = _router(dims="8:1", types="float32")
+        echo = agent = None
+        try:
+            echo = EchoServer(dims="4:1", service_ms=1.0)
+            agent = HostAgent(
+                "127.0.0.1", router.port, name="wrong",
+                local_port=echo.port, dims="4:1", types="float32",
+                reconnect=False)
+            with pytest.raises(StreamError, match="no REGISTER_ACK"):
+                agent.start(timeout_s=2.0)
+            assert router.ready_hosts() == 0
+        finally:
+            _stop_all(agent, echo, router)
+
+    def test_wait_hosts_times_out_without_hosts(self):
+        router = _router()
+        try:
+            assert not router.wait_hosts(1, timeout_s=0.2)
+        finally:
+            router.close()
+
+    def test_reregistration_replaces_incarnation_keeps_counters(self):
+        router = _router()
+        echo = a1 = a2 = None
+        try:
+            # reconnect=False: when a2 replaces this incarnation the
+            # fenced a1 must not re-register and flap the name back
+            echo, a1 = _join_echo(router, "hA", reconnect=False)
+            assert router.wait_hosts(1, timeout_s=10)
+            r = _flood(router, 10, 100.0, trace=False)
+            assert r["completed"] == 10
+            replied_before = router.stats()["hosts"][0]["replied"]
+            assert replied_before == 10
+            # same name, new connection: the old incarnation is fenced
+            # and its monotone counters carry over
+            a2 = HostAgent(
+                "127.0.0.1", router.port, name="hA",
+                local_port=echo.port, dims="8:1", types="float32").start()
+            assert router.wait_hosts(1, timeout_s=10)
+            st = router.stats()
+            assert st["mesh"]["ready"] == 1
+            assert st["hosts"][0]["replied"] == replied_before
+            kinds = [(h, k) for _, h, k, _ in router.events]
+            assert ("hA", "fence") in kinds
+        finally:
+            _stop_all(a1, a2, echo, router)
+
+
+# -- lease liveness + cross-host failover ------------------------------------
+
+class TestLeaseFailover:
+    def test_blackhole_fences_reoffers_and_keeps_one_trace(self):
+        """The acceptance drill, in-process: two hosts, one blackholed
+        mid-flood. Zero lost, conservation exact across hosts, fence
+        within the lease budget, and a redelivered frame's single trace
+        shows BOTH hosts."""
+        router = _router(lease_s=0.6, max_redeliver=2)
+        proxy = ha = hb = aa = ab = None
+        try:
+            proxy = ChaosProxy("127.0.0.1", router.port, seed=3)
+            ha, aa = _join_echo(router, "hA", via_port=proxy.port,
+                                service_ms=60.0)
+            hb, ab = _join_echo(router, "hB", service_ms=5.0)
+            assert router.wait_hosts(2, timeout_s=10)
+            t_bh = [0.0]
+
+            def cut():
+                t_bh[0] = time.monotonic()
+                proxy.blackhole()
+
+            timer = threading.Timer(0.15, cut)
+            timer.start()
+            try:
+                r = _flood(router, 40, 120.0, drain_timeout_s=20.0)
+            finally:
+                timer.cancel()
+            assert r["completed"] == 40 and r["lost"] == 0
+            assert _conserved(router.admission_counters())
+            fences = [(t, h, d) for t, h, k, d in router.events
+                      if k == "fence" and t >= t_bh[0]]
+            assert fences, "blackholed host was never fenced"
+            t_f, h_f, cause = fences[0]
+            assert h_f == "hA" and cause == "lease_expired"
+            assert t_f - t_bh[0] <= 2 * 0.6 + 0.5, \
+                "fence detection blew the lease budget"
+            assert router.reoffered >= 1
+            # the cross-host story: one trace id, both hosts on it
+            redelivered = r.get("redelivered_examples") or []
+            assert redelivered, "no redelivered frame carried a trace"
+            ex = redelivered[0]
+            assert ex["hosts"] == ["hA", "hB"]
+            assert len(ex["trace_id"]) == 16
+        finally:
+            _stop_all(aa, ab, proxy, ha, hb, router)
+
+    def test_heal_lets_the_host_rejoin(self):
+        router = _router(lease_s=0.5)
+        proxy = echo = agent = None
+        try:
+            proxy = ChaosProxy("127.0.0.1", router.port, seed=1)
+            echo, agent = _join_echo(router, "hA", via_port=proxy.port)
+            assert router.wait_hosts(1, timeout_s=10)
+            proxy.blackhole()
+            deadline = time.monotonic() + 5
+            while router.ready_hosts() > 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert router.ready_hosts() == 0, "partition never detected"
+            proxy.heal()
+            # the agent's reconnect loop re-dials through the healed
+            # proxy and re-registers under the same name
+            assert router.wait_hosts(1, timeout_s=10), \
+                "host never rejoined after heal"
+            st = router.stats()["hosts"][0]
+            assert st["state"] == "READY" and st["host"] == "hA"
+        finally:
+            _stop_all(agent, proxy, echo, router)
+
+    def test_shed_when_no_alternative_host(self):
+        """One host, fenced with frames in flight, nothing to re-offer
+        to: frames come back as typed BUSY(host_lost) — counted, never
+        lost."""
+        router = _router(lease_s=0.5, max_redeliver=2)
+        proxy = echo = agent = None
+        try:
+            proxy = ChaosProxy("127.0.0.1", router.port, seed=2)
+            echo, agent = _join_echo(router, "only",
+                                     via_port=proxy.port,
+                                     service_ms=80.0)
+            assert router.wait_hosts(1, timeout_s=10)
+            timer = threading.Timer(0.1, proxy.blackhole)
+            timer.start()
+            try:
+                r = _flood(router, 12, 80.0, drain_timeout_s=12.0)
+            finally:
+                timer.cancel()
+            assert r["lost"] == 0
+            assert r["completed"] + r["rejected"] == 12
+            assert r["rejected"] > 0
+            assert r["busy_causes"].get("host_lost", 0) > 0
+            c = router.admission_counters()
+            assert _conserved(c)
+            assert c["shed"].get("host_lost", 0) > 0
+        finally:
+            _stop_all(agent, proxy, echo, router)
+
+
+# -- typed-BUSY retry to a different host ------------------------------------
+
+class TestBusyReroute:
+    def test_host_busy_retries_on_sibling(self):
+        # hA advertises a high capacity but its admission plane is
+        # 1-deep and slow — the honest least-outstanding router keeps
+        # offering it frames it refuses. Every typed BUSY must be
+        # absorbed by re-offering to hB: the client sees zero
+        # rejections.
+        router = _router(busy_retry=2)
+        ha = hb = aa = ab = None
+        try:
+            ha = EchoServer(service_ms=50.0, max_pending=1,
+                            max_inflight=1)
+            aa = HostAgent(
+                "127.0.0.1", router.port, name="hA",
+                local_port=ha.port, dims="8:1", types="float32",
+                capacity_rps=500.0).start()   # the lie under test
+            hb, ab = _join_echo(router, "hB", service_ms=5.0,
+                                max_pending=64)
+            assert router.wait_hosts(2, timeout_s=10)
+            r = _flood(router, 30, 150.0, drain_timeout_s=20.0)
+            assert r["completed"] == 30 and r["lost"] == 0
+            assert r["rejected"] == 0
+            st = router.stats()
+            assert st["mesh"]["busy_reroutes"] >= 1, \
+                "no BUSY ever rerouted — the fixture is vacuous"
+            assert _conserved(router.admission_counters())
+        finally:
+            _stop_all(aa, ab, ha, hb, router)
+
+
+# -- two-phase swap ----------------------------------------------------------
+
+class _SwapHost:
+    """EchoServer + agent with a scriptable on_swap hook."""
+
+    def __init__(self, router, name, results=None):
+        self.calls = []
+        self.results = dict(results or {})
+        self.echo = EchoServer(service_ms=1.0)
+        # reconnect=False: a host fenced by a failed commit must STAY
+        # fenced for the assertion, not quietly re-register
+        self.agent = HostAgent(
+            "127.0.0.1", router.port, name=name,
+            local_port=self.echo.port, dims="8:1", types="float32",
+            versions={"m": [0]}, on_swap=self._on_swap,
+            reconnect=False).start()
+
+    def _on_swap(self, phase, model, version):
+        self.calls.append((phase, model, version))
+        return self.results.get(phase, True)
+
+    def stop(self):
+        self.agent.stop()
+        self.echo.stop()
+
+
+class TestMeshSwap:
+    def test_commit_bumps_epoch_on_all_ok(self):
+        router = _router()
+        a = b = None
+        try:
+            a = _SwapHost(router, "hA")
+            b = _SwapHost(router, "hB")
+            assert router.wait_hosts(2, timeout_s=10)
+            rep = router.swap("m", 1, timeout_s=10)
+            assert rep["ok"], rep
+            assert rep["epoch"] == 1 and router.epoch == 1
+            for h in (a, b):
+                phases = [p for p, _, _ in h.calls]
+                assert phases == ["prepare", "commit"]
+            st = router.stats()
+            assert all(1 in h["versions"]["m"] for h in st["hosts"])
+        finally:
+            _stop_all(a, b, router)
+
+    def test_prepare_failure_aborts_everywhere_nobody_fenced(self):
+        router = _router()
+        a = b = None
+        try:
+            a = _SwapHost(router, "hA")
+            b = _SwapHost(router, "hB",
+                          results={"prepare": (False, "no space")})
+            assert router.wait_hosts(2, timeout_s=10)
+            rep = router.swap("m", 1, timeout_s=10)
+            assert not rep["ok"]
+            assert router.epoch == 0
+            # all-or-none: the healthy host saw prepare then abort,
+            # never commit — and stays READY
+            assert [p for p, _, _ in a.calls] == ["prepare", "abort"]
+            assert router.ready_hosts() == 2
+        finally:
+            _stop_all(a, b, router)
+
+    def test_commit_failure_fences_the_divergent_host(self):
+        router = _router()
+        a = b = None
+        try:
+            a = _SwapHost(router, "hA")
+            b = _SwapHost(router, "hB",
+                          results={"commit": (False, "load failed")})
+            assert router.wait_hosts(2, timeout_s=10)
+            rep = router.swap("m", 1, timeout_s=10)
+            assert not rep["ok"]
+            assert router.epoch == 0, \
+                "epoch must not move on a failed commit"
+            # the host that acked prepare but failed commit would be
+            # serving a different version than its siblings: fenced
+            st = {h["host"]: h for h in router.stats()["hosts"]}
+            assert st["hB"]["state"] == "FENCED"
+            assert st["hB"]["fence_cause"] == "swap_commit_failed"
+            assert st["hA"]["state"] == "READY"
+        finally:
+            _stop_all(a, b, router)
+
+
+# -- deterministic network fault injection -----------------------------------
+
+def _proxy_echo_run(n=30, **faults):
+    """Send n frames through proxy→echo, wait for the replies that
+    survive the faults, return (proxy stats, replied pts set)."""
+    import queue as _q
+
+    from nnstreamer_tpu.edge.wire import encode_buffer, peek_pts
+
+    echo = EchoServer(service_ms=1.0, max_pending=64)
+    proxy = ChaosProxy("127.0.0.1", echo.port, **faults)
+    got = set()
+    hello = _q.Queue()
+
+    def on_msg(mtype, payload):
+        if mtype == P.T_RESULT:
+            got.add(peek_pts(payload))
+        elif mtype == P.T_HELLO_ACK:
+            hello.put(True)
+
+    cli = P.MsgClient("127.0.0.1", proxy.port, on_message=on_msg)
+    try:
+        cli.send(P.T_HELLO, b'{"dims": "8:1", "types": "float32"}')
+        hello.get(timeout=10)
+        x = np.zeros((8, 1), np.float32)
+        for i in range(n):
+            cli.send(P.T_DATA, encode_buffer(TensorBuffer.of(x, pts=i)))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            s = proxy.stats()
+            settled = s["dropped"] + s["forwarded"]
+            if len(got) >= n or settled >= 2 * n:
+                time.sleep(0.3)     # let stragglers land
+                break
+            time.sleep(0.02)
+        return proxy.stats(), set(got)
+    finally:
+        cli.close()
+        proxy.close()
+        echo.stop()
+
+
+class TestNetChaos:
+    def test_same_seed_same_fault_schedule(self):
+        s1, got1 = _proxy_echo_run(seed=11, drop_p=0.3)
+        s2, got2 = _proxy_echo_run(seed=11, drop_p=0.3)
+        assert s1["dropped"] == s2["dropped"] > 0
+        assert s1["forwarded"] == s2["forwarded"]
+        assert got1 == got2, "per-frame outcomes must reproduce"
+        s3, _ = _proxy_echo_run(seed=12, drop_p=0.3)
+        assert (s3["dropped"], s3["forwarded"]) \
+            != (s1["dropped"], s1["forwarded"]), \
+            "different seed produced the identical schedule (suspicious)"
+
+    def test_duplicates_are_injected_not_corrupted(self):
+        # dup_p=1: every unspared message is sent twice. The echo
+        # server answers each copy — message-level duplication must
+        # never corrupt framing, so ALL replies decode.
+        s, got = _proxy_echo_run(n=10, seed=0, dup_p=1.0)
+        assert s["duplicated"] > 0
+        assert got == set(range(10))
+
+    def test_delay_shifts_latency_not_outcomes(self):
+        t0 = time.monotonic()
+        s, got = _proxy_echo_run(n=8, seed=0, delay_ms=30.0)
+        assert got == set(range(8))
+        assert s["delayed"] > 0
+        assert time.monotonic() - t0 >= 0.03
+
+    def test_blackhole_discards_and_withholds_fin(self):
+        echo = EchoServer(service_ms=1.0)
+        proxy = ChaosProxy("127.0.0.1", echo.port, seed=0)
+        closed = threading.Event()
+        cli = P.MsgClient("127.0.0.1", proxy.port,
+                          on_message=lambda *a: None,
+                          on_close=lambda: closed.set())
+        try:
+            cli.send(P.T_HELLO, b'{"dims": "8:1", "types": "float32"}')
+            time.sleep(0.2)
+            proxy.blackhole()
+            cli.send(P.T_HELLO, b"{}")
+            time.sleep(0.3)
+            # a partition is silence, not a clean close: the peer must
+            # NOT learn anything (that is what the lease is for)
+            assert not closed.is_set()
+            assert proxy.stats()["discarded"] >= 1
+            proxy.heal()
+            assert closed.wait(5), "heal must close severed routes"
+        finally:
+            cli.close()
+            proxy.close()
+            echo.stop()
+
+    def test_slow_close_wedges_then_closes(self):
+        echo = EchoServer(service_ms=1.0)
+        proxy = ChaosProxy("127.0.0.1", echo.port, seed=0)
+        closed = threading.Event()
+        cli = P.MsgClient("127.0.0.1", proxy.port,
+                          on_message=lambda *a: None,
+                          on_close=lambda: closed.set())
+        try:
+            cli.send(P.T_HELLO, b'{"dims": "8:1", "types": "float32"}')
+            time.sleep(0.2)
+            t0 = time.monotonic()
+            proxy.slow_close(linger_s=0.3)
+            assert closed.wait(5), "slow_close never closed"
+            assert time.monotonic() - t0 >= 0.25, \
+                "closed immediately — the linger (wedge) phase is the " \
+                "point"
+        finally:
+            cli.close()
+            proxy.close()
+            echo.stop()
+
+
+# -- outbound connect timeouts (satellite: edge dial bound) ------------------
+
+class TestConnectTimeout:
+    @staticmethod
+    def _saturated_listener():
+        """A listening socket whose accept queue is full and never
+        drained: further connects hang in SYN limbo — exactly the
+        silent-blackhole shape a raw connect() waits ~2min on."""
+        import socket
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(0)
+        port = srv.getsockname()[1]
+        fillers = []
+        for _ in range(4):   # overfill the tiny backlog
+            f = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            f.setblocking(False)
+            try:
+                f.connect_ex(("127.0.0.1", port))
+            except OSError:
+                pass
+            fillers.append(f)
+        time.sleep(0.1)
+        return srv, port, fillers
+
+    def test_msgclient_dial_bounded(self):
+        srv, port, fillers = self._saturated_listener()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(StreamError, match="cannot connect"):
+                P.MsgClient("127.0.0.1", port,
+                            on_message=lambda *a: None,
+                            connect_timeout=0.3, retries=1)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 5.0, (
+                f"dial took {elapsed:.1f}s — the connect timeout never "
+                f"reached the socket (OS default is ~minutes)")
+        finally:
+            for f in fillers:
+                f.close()
+            srv.close()
+
+    def test_query_client_exposes_connect_timeout_prop(self):
+        from nnstreamer_tpu.edge.query import TensorQueryClient
+
+        pd = TensorQueryClient.PROPS["connect_timeout"]
+        assert pd.default == 0.0
+
+    def test_default_connect_timeout_is_finite(self):
+        assert 0 < P.DEFAULT_CONNECT_TIMEOUT_S < 60
+
+
+# -- the chaos harness over real pool hosts ----------------------------------
+
+class TestMeshHarness:
+    def test_pool_blackhole_smoke(self):
+        """Tier-1-safe end-to-end: 2 subprocess pool hosts behind one
+        router, one blackholed mid-flood. Everything the full flood
+        gates on, at a size that fits the tier-1 clock."""
+        from nnstreamer_tpu.traffic import run_against_mesh
+
+        r = run_against_mesh(hosts=2, workers_per_host=1, n=40,
+                             service_ms=10.0, load_x=1.2, seed=0,
+                             lease_s=0.8, max_redeliver=2)
+        assert r["lost"] == 0 and r["conserved"]
+        assert r["completed"] + r["rejected"] == 40
+        assert r["recovered"], (
+            f"fence took {r.get('fence_detect_s')}s against a "
+            f"{r['lease_s']}s lease")
+        assert r["perhost_replied_sum"] == \
+            r["admission"]["replied"]
+        assert r["orphans"] == []
+        ex = r.get("redelivered_examples") or []
+        assert ex and len(ex[0]["hosts"]) == 2, \
+            "no frame was redelivered across hosts with one trace id"
+
+    @pytest.mark.mesh
+    @pytest.mark.slow
+    def test_pool_blackhole_full_flood_with_heal(self):
+        """The full ISSUE 12 acceptance: 1.5x aggregate capacity, a
+        mid-flood partition, and a heal — the fenced host must rejoin
+        and the ledger must balance to the last frame."""
+        from nnstreamer_tpu.traffic import run_against_mesh
+
+        r = run_against_mesh(hosts=2, workers_per_host=2, n=300,
+                             service_ms=20.0, load_x=1.5, seed=42,
+                             lease_s=1.0, max_redeliver=2,
+                             heal_after_s=2.0)
+        assert r["lost"] == 0 and r["conserved"]
+        assert r["recovered"]
+        assert r["rejoined"], "healed host never re-registered"
+        assert r["perhost_replied_sum"] == r["admission"]["replied"]
+        assert r["orphans"] == []

@@ -36,6 +36,29 @@ def _pool(replied=(4, 3)):
                         for i, r in enumerate(replied)]}
 
 
+def _mesh(replied=(9, 31)):
+    """router.stats()-shaped snapshot: per-host replied sums to the
+    admission plane's replied — the cross-host conservation check."""
+    return {
+        "mesh": {"hosts": len(replied), "ready": len(replied) - 1,
+                 "fenced": 1, "epoch": 2, "reoffered": 3,
+                 "busy_reroutes": 1, "stale_results": 0, "pending": 0,
+                 "lease_s": 1.0},
+        "hosts": [
+            {"host": f"host{i}", "state": "READY" if i else "FENCED",
+             "zone": "", "capacity_rps": 100.0, "outstanding": i,
+             "replied": r, "busies": i, "lease_age_ms": 12.5,
+             "fence_cause": None if i else "lease_expired",
+             "versions": {},
+             "remote": {"offered": r + 1, "admitted": r,
+                        "replied": r - 1}}
+            for i, r in enumerate(replied)],
+        "admission": _admission(offered=41, admitted=40,
+                                replied=sum(replied), depth=0,
+                                inflight=0),
+    }
+
+
 def _traced(n=5, name="echo"):
     tr = Tracer()
     buf = TensorBuffer.of(np.ones((2,), np.float32))
@@ -208,6 +231,47 @@ class TestMetricsServer:
             assert "version=0.0.4" in ctype
         finally:
             srv.close()
+
+
+class TestMeshExposition:
+    def test_host_labels_round_trip(self):
+        """ISSUE 12 satellite: per-host series survive the full
+        render → parse cycle with their host labels intact, and the
+        per-host goodput sums to the admission plane's replied — the
+        cross-host conservation check, as scraped."""
+        snap = _mesh(replied=(9, 31))
+        parsed = parse_prometheus(render_prometheus(metrics_snapshot(
+            admission=snap["admission"], mesh=snap)))
+        rep = parsed["nns_host_replied_total"]["samples"]
+        by_host = {re.search(r'host="([^"]+)"', k).group(1): v
+                   for k, v in rep.items()}
+        assert by_host == {"host0": 9.0, "host1": 31.0}
+        adm = parsed["nns_admission_replied_total"]["samples"][
+            "nns_admission_replied_total"]
+        assert sum(by_host.values()) == adm == 40.0
+        # mesh-level counters/gauges made it through too
+        assert parsed["nns_mesh_reoffered_total"]["samples"][
+            "nns_mesh_reoffered_total"] == 3.0
+        assert parsed["nns_mesh_fenced"]["samples"][
+            "nns_mesh_fenced"] == 1.0
+        # up gauge keys on host AND state so a flap is visible as a
+        # label change, not a silent value swap
+        up = parsed["nns_host_up"]["samples"]
+        fenced = [k for k, v in up.items() if v == 0.0]
+        assert len(fenced) == 1
+        assert 'host="host0"' in fenced[0]
+        assert 'state="FENCED"' in fenced[0]
+
+    def test_lease_carried_remote_counters_exported(self):
+        snap = _mesh(replied=(9, 31))
+        parsed = parse_prometheus(render_prometheus(metrics_snapshot(
+            mesh=snap)))
+        for key in ("offered", "admitted", "replied"):
+            fam = parsed[f"nns_host_local_{key}_total"]
+            assert fam["type"] == "counter"
+            assert len(fam["samples"]) == 2
+        local = parsed["nns_host_local_replied_total"]["samples"]
+        assert sum(local.values()) == (9 - 1) + (31 - 1)
 
 
 class TestTopView:

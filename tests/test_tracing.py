@@ -450,6 +450,27 @@ class TestTraceContext:
         # stage math uses the LAST dispatch, not the dead one
         assert s["worker_queue_ms"] == pytest.approx(1.0, abs=1e-6)
 
+    def test_hop_spans_lists_hosts_in_first_dispatch_order(self):
+        """ISSUE 12 satellite: a cross-host redelivered request keeps
+        ONE trace whose dispatch hops name every host it touched —
+        the span view surfaces them in first-dispatch order."""
+        hops = [{"hop": "client_send", "t": 0.0},
+                {"hop": "admit", "t": 0.001},
+                {"hop": "dispatch", "t": 0.002, "host": "hB"},
+                {"hop": "reoffer", "t": 0.050, "cause": "host_lost"},
+                {"hop": "dispatch", "t": 0.051, "host": "hA"},
+                # second attempt on the same host must not duplicate
+                {"hop": "dispatch", "t": 0.052, "host": "hA"},
+                {"hop": "worker_recv", "t": 0.053},
+                {"hop": "worker_done", "t": 0.060},
+                {"hop": "reply", "t": 0.061}]
+        s = hop_spans(hops)
+        assert s["hosts"] == ["hB", "hA"]
+        assert s["redeliveries"] == 1
+        # single-host (or pool-local, no host key) traces stay clean
+        assert "hosts" not in hop_spans(
+            [{"hop": "dispatch", "t": 0.0}, {"hop": "reply", "t": 0.1}])
+
     def test_wire_codec_carries_nested_ctx(self):
         from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
 

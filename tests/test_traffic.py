@@ -147,6 +147,29 @@ class TestAdmissionQueue:
         d = q.offer(1)
         assert 1.0 <= d.retry_after_ms <= 10_000.0
 
+    def test_retry_after_cold_start_is_finite_positive(self):
+        """ISSUE 12 satellite: a freshly started (or freshly joined)
+        server has NO reply EWMA yet — every rejection it issues must
+        still carry a finite positive retry hint, or a retry:N:backoff
+        client divides by it garbage. Degenerate EWMA states (a stuck
+        clock, an overflowed estimate) must degrade to the clamps, not
+        to inf/NaN on the wire."""
+        q = AdmissionQueue(max_pending=1)
+        q.offer("a")
+        d = q.offer("b")                       # cold: no EWMA at all
+        assert not d.admitted
+        assert d.retry_after_ms == 50.0        # _DEFAULT_RETRY_MS
+        for bad in (0.0, -1.0, float("inf"), float("nan")):
+            q._ewma_reply_s = bad
+            d = q.offer("b")
+            assert d.retry_after_ms == 50.0, \
+                f"ewma={bad} leaked a useless hint {d.retry_after_ms}"
+        q._ewma_reply_s = 1e306                # est overflows to inf
+        d = q.offer("b")
+        import math
+        assert math.isfinite(d.retry_after_ms)
+        assert d.retry_after_ms == 10_000.0    # upper clamp
+
 
 # -- arrival processes -------------------------------------------------------
 
