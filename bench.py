@@ -1858,6 +1858,83 @@ def traffic_serve() -> dict:
     return out
 
 
+def multitenant_serve() -> dict:
+    """Multi-tenant isolation family: a weighted-fair (WFQ) admission
+    front over a 2-worker pool, one victim tenant at 0.5x its fair
+    share and one flooding tenant at 1x then 3x. Reported per point:
+    aggregate goodput plus each tenant's goodput / shed rate / p99.
+    BENCH_TRAFFIC_TENANT_GATE=1 additionally runs the noisy-neighbor
+    acceptance drill (solo-victim baseline vs contested) and gates on
+    victim goodput >= 0.9x solo, victim p99 within its deadline, shed
+    attributed to the flooder (tenant_over_share), conservation exact
+    per class and summed, and zero lost."""
+    from nnstreamer_tpu.traffic import noisy_neighbor_drill, \
+        run_multitenant
+
+    service_ms = 8.0
+    workers = 2
+    max_pending = 24
+    budget_ms = (max_pending + 2) * service_ms
+    capacity = workers * 1e3 / service_ms
+    tenants = {"victim": {"weight": 1.0, "deadline_ms": budget_ms},
+               "flood": {"weight": 1.0, "deadline_ms": budget_ms}}
+    out = {"service_ms": service_ms, "workers": workers,
+           "max_pending": max_pending, "p99_budget_ms": budget_ms,
+           "capacity_rps": capacity}
+
+    def _tenant_point(r: dict) -> dict:
+        pt = {"goodput_rps": r["goodput_rps"], "lost": r["lost"],
+              "conserved": r["conserved"]}
+        for name, g in r["groups"].items():
+            lat = g.get("latency_ms") or {}
+            pt[name] = {"goodput_rps": g["goodput_rps"],
+                        "shed_rate": g["shed_rate"],
+                        "p99_ms": lat.get("p99", 0.0)}
+        return pt
+
+    victim_rate = 0.5 * capacity / 2
+    for flood_x in (1.0, 3.0):
+        flood_rate = flood_x * capacity / 2
+        n_victim = 80
+        n_flood = max(1, int(round(n_victim / victim_rate
+                                   * flood_rate)))
+        r = run_multitenant(
+            tenants=tenants,
+            n_per_tenant={"victim": n_victim, "flood": n_flood},
+            rate_hz={"victim": victim_rate, "flood": flood_rate},
+            workers=workers, service_ms=service_ms,
+            max_pending=max_pending, p99_budget_ms=budget_ms,
+            seed=42)
+        out[f"flood_x{flood_x:g}"] = _tenant_point(r)
+        _family_partial(dict(out))
+    if os.environ.get("BENCH_TRAFFIC_TENANT_GATE") == "1":
+        drill = noisy_neighbor_drill(
+            victim_x=0.5, flood_x=3.0, n_victim=80,
+            workers=workers, service_ms=service_ms,
+            max_pending=max_pending, seed=42)
+        flood_cont = drill["contested"]["groups"]["flood"]
+        out["drill"] = {
+            "victim_goodput_ratio": drill["victim_goodput_ratio"],
+            "victim_p99_ms": drill["victim_p99_ms"],
+            "victim_p99_budget_ms": drill["victim_p99_budget_ms"],
+            "flood_shed_rate": flood_cont["shed_rate"],
+            "flood_busy_causes": flood_cont["busy_causes"],
+            "conserved": drill["conserved"],
+            "zero_lost": drill["zero_lost"],
+        }
+        p99 = drill["victim_p99_ms"]
+        out["tenant_gate_ok"] = (
+            drill["victim_goodput_ratio"] >= 0.9
+            and p99 is not None
+            and p99 <= drill["victim_p99_budget_ms"]
+            and set(flood_cont["busy_causes"]) <= {"tenant_over_share"}
+            and drill["conserved"] and drill["zero_lost"])
+        if not out["tenant_gate_ok"]:
+            out["unverified"] = True   # ship the numbers, flag it
+        _family_partial(dict(out))
+    return out
+
+
 #: pipeline configs, each its own subprocess family as well — host-path
 #: configs do per-frame D2H, and running them after anything else in
 #: one process measured 2x drift (label 157 -> 76 FPS across trials)
@@ -1886,6 +1963,7 @@ _FAMILIES = {
     "host_path": lambda: host_path(),
     "llm_serve": lambda: llm_serve(),
     "traffic": lambda: traffic_serve(),
+    "multitenant": lambda: multitenant_serve(),
 }
 for _d in OFFLOAD_DELAYS:
     _FAMILIES[f"offload_{_d}"] = (
@@ -2051,7 +2129,7 @@ def _ordered_families() -> list:
         return list(_FAMILIES)
     return (["cfg_label_device", "pallas", "transformer_prefill",
              "mxu_peak", "batch_sweep", "dyn_batch", "host_path",
-             "llm_serve", "traffic"]
+             "llm_serve", "traffic", "multitenant"]
             + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
             + [f"offload_{d}" for d in OFFLOAD_DELAYS]
             + ["int8_native", "model_swap", "chaos_smoke"])

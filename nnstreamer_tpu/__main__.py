@@ -299,6 +299,15 @@ def _serve_main(argv) -> int:
     ap.add_argument("--shed-policy", default="reject-newest",
                     choices=("reject-newest", "reject-oldest",
                              "deadline-drop"))
+    ap.add_argument("--tenants", default=None, metavar="FILE",
+                    help="tenant table JSON (docs/multitenant.md): "
+                         "weighted-fair admission per tenant class; "
+                         "when classes bind models, workers run in "
+                         "multiplex mode and route tenant->model")
+    ap.add_argument("--resident-models", type=int, default=0,
+                    help="multiplex mode: max models holding live "
+                         "compiled entries per worker (LRU eviction; "
+                         "0 = unbounded)")
     ap.add_argument("--stats-every", type=float, default=0.0,
                     help="print pool stats JSON every N seconds")
     ap.add_argument("--metrics-port", type=int, default=None,
@@ -330,7 +339,16 @@ def _serve_main(argv) -> int:
         from nnstreamer_tpu.runtime.tracing import Tracer
 
         tracer = Tracer()
-    if args.pipeline:
+    table = None
+    if args.tenants:
+        from nnstreamer_tpu.serving.tenancy import TenantTable
+
+        table = TenantTable.from_json(args.tenants)
+    if table is not None and table.models():
+        spec = WorkerSpec(kind="multiplex", dims=args.dims,
+                          types=args.types, tenants=table.to_dict(),
+                          resident_models=args.resident_models)
+    elif args.pipeline:
         spec = WorkerSpec(kind="pipeline", pipeline=args.pipeline,
                           dims=args.dims, types=args.types)
     else:
@@ -340,7 +358,7 @@ def _serve_main(argv) -> int:
         spec, workers=args.workers, sid=args.id, host=args.host,
         port=args.port, max_pending=args.max_pending,
         max_inflight=args.max_inflight, shed_policy=args.shed_policy,
-        tracer=tracer)
+        tenants=table, tracer=tracer)
     pqs.install_signal_handlers()
     msrv = None
     if args.metrics_port is not None:
@@ -608,6 +626,16 @@ def _traffic_main(argv) -> int:
                          "median arrival; needs --workers)")
     ap.add_argument("--kills", type=int, default=1,
                     help="number of staggered worker kills (--workers)")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="multi-tenant mode: N equal-weight tenant "
+                         "classes behind a weighted-fair admission "
+                         "front over a worker pool; tenant t0 floods "
+                         "at --flood x its fair share, the others "
+                         "offer 0.5x theirs; report gains per-tenant "
+                         "groups + per-class conservation")
+    ap.add_argument("--flood", type=float, default=3.0, metavar="K",
+                    help="flooding tenant's offered load as a "
+                         "multiple of its fair share (--tenants)")
     ap.add_argument("--json", action="store_true",
                     help="print the raw report JSON only")
     ap.add_argument("--trace", action="store_true",
@@ -628,6 +656,39 @@ def _traffic_main(argv) -> int:
 
     if args.trace_out:
         args.trace = True
+    if args.tenants > 0:
+        from nnstreamer_tpu.traffic import run_multitenant
+
+        if args.tenants < 2:
+            print("--tenants needs N >= 2", file=sys.stderr)
+            return 2
+        workers = args.workers or 2
+        capacity = workers * 1e3 / args.service_ms
+        fair = capacity / args.tenants
+        names = [f"t{k}" for k in range(args.tenants)]
+        budget = args.budget_ms or \
+            (args.max_pending + 2) * args.service_ms
+        rate_hz = {nm: (args.flood if k == 0 else 0.5) * fair
+                   for k, nm in enumerate(names)}
+        per = max(1, args.requests // args.tenants)
+        n_per = {nm: max(1, int(round(per * rate_hz[nm] / fair)))
+                 for nm in names}
+        report = run_multitenant(
+            tenants={nm: {"weight": 1.0, "deadline_ms": budget}
+                     for nm in names},
+            n_per_tenant=n_per, rate_hz=rate_hz,
+            workers=workers, service_ms=args.service_ms,
+            max_pending=args.max_pending,
+            shed_policy=args.shed_policy
+            if args.shed_policy != "reject-newest" else "reject-oldest",
+            p99_budget_ms=budget, seed=args.seed)
+        if args.json:
+            print(json.dumps(report, default=float))
+        else:
+            report.pop("queue_depth_timeline", None)
+            print(json.dumps(report, indent=2, default=float))
+        ok = report["lost"] == 0 and report["conserved"]
+        return 0 if ok else 1
     if args.workers > 0:
         tracer = None
         pool_kw = {}

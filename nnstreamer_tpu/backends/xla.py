@@ -1178,6 +1178,41 @@ class XLABackend(FilterBackend):
             self._dyn_jits.popitem(last=False)
         self._dyn_jits[key] = jitted
 
+    # -- residency pressure hooks (serving/tenancy.ModelResidency) ---------
+    def jit_cache_size(self) -> int:
+        """Live compiled entries (bucketed jits + the static-path jit).
+        A model with zero is 'cold': releasing it again is free."""
+        return len(self._dyn_jits) + (1 if self._jitted is not None else 0)
+
+    def release_compiled(self) -> int:
+        """Drop every compiled artifact (LRU eviction under memory
+        pressure — serving/tenancy.ModelResidency). Params, specs, and
+        store attachments stay: the next invoke recompiles the needed
+        bucket (a counted cache miss), results are bitwise unchanged.
+        Returns the number of entries released."""
+        n = self.jit_cache_size()
+        self._dyn_jits.clear()
+        self._batch_ok.clear()
+        self._jitted = None
+        return n
+
+    def resident_bytes(self) -> int:
+        """Device bytes held by this model's params (all resident store
+        versions, or the single non-store param tree)."""
+        def tree_bytes(params) -> int:
+            import jax
+
+            if params is None:
+                return 0
+            return sum(
+                getattr(a, "nbytes", 0)
+                for a in jax.tree_util.tree_leaves(params))
+
+        if self._vstates:
+            return sum(tree_bytes(vs.device_params)
+                       for vs in self._vstates.values())
+        return tree_bytes(self._device_params)
+
     def reload(self, model: Any) -> None:
         """Hot model swap (is-updatable analog): double-buffered — the new
         bundle is resolved and staged before the old one is dropped. For a

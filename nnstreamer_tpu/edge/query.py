@@ -183,17 +183,23 @@ class QueryServer:
     def reply(self, client_id: int, buf: TensorBuffer) -> None:
         # a request is "served" once its result reaches the reply path,
         # even if the client has meanwhile vanished — completion
-        # accounting must balance admission accounting
-        self.frames.note_replied()
+        # accounting must balance admission accounting. The tenant
+        # class stamped at admission rides the buffer meta end-to-end,
+        # so per-class counters settle on the same class the offer was
+        # counted under.
+        cls = buf.meta.get("_tenant_class") \
+            if isinstance(buf.meta, dict) else None
+        self.frames.note_replied(cls=cls)
         stamp_hop(buf.meta, "reply")
         if self.tracer.active:
             ctx = get_trace_ctx(buf.meta)
             if ctx is not None:
                 # server-side end of this request's timeline: the full
                 # hop list (admission→worker→reply) as it leaves us
+                extra = {"tenant": cls} if cls is not None else {}
                 self.tracer.record_request(
                     f"query_server_{self.sid}", ctx["id"], ctx["hops"],
-                    time.perf_counter(), pts=buf.pts)
+                    time.perf_counter(), pts=buf.pts, **extra)
         conn = self.server.connection(client_id) if self.server else None
         if conn is None:
             log.warning("server %d: client %d gone, dropping result",
@@ -285,12 +291,19 @@ class TensorQueryServerSrc(SourceElement):
         self._srv = QueryServer.get(self.props["id"])
         self._srv.in_spec = self.out_specs[0]
         try:
-            self._srv.frames.configure(
+            victims = self._srv.frames.configure(
                 max_pending=self.props["max_pending"],
                 max_inflight=self.props["max_inflight"],
                 shed_policy=self.props["shed_policy"])
         except ValueError as e:
             raise PipelineError(f"{self.name}: {e}") from None
+        # a policy change to deadline-drop purges already-expired
+        # queued entries (admission.configure contract): each victim
+        # is owed a BUSY, exactly as if an offer() had purged it
+        for v in victims or ():
+            if v is not None:
+                self._srv.send_busy(v.meta.get("client_id"), v.pts,
+                                    "deadline")
         # the runner hands the tracer down before start(): shed events
         # land on the pipeline's trace alongside everything else
         self._srv.tracer = self._tracer

@@ -1,0 +1,62 @@
+"""Known-answer probe models (zoo://probe_scale | probe_negate |
+probe_offset) — the multi-tenant multiplexing test fixtures.
+
+Three distinct models sharing one input contract (``(8, 1) float32``,
+the pool tests' frame shape) whose outputs are exactly predictable from
+the input: ``scale * x``, ``-x``, and ``x + offset``. A multiplex
+worker serving all three lets a test assert *which* model answered a
+frame from the numbers alone — cross-tenant routing errors, stale
+compiles after an LRU eviction, or a swap leaking into another tenant's
+traffic all become wrong arithmetic instead of silent corruption.
+
+Each builder is parametric (``zoo://probe_scale?scale=3``), so the same
+zoo name yields distinguishable *versions* for hot-swap tests: register
+``probe_scale`` with a different scale as ``@1`` and a swap flips the
+answer by a known factor.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models.zoo import register_model
+
+_ROWS = 8  # matches the serving tests' canonical 8:1 float32 frame
+
+
+def _bundle(fn, params, name: str, rows: int):
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.tensor.dtypes import DType
+    from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
+
+    spec = TensorsSpec.of(TensorInfo((rows, 1), DType.FLOAT32, name="x"))
+    return ModelBundle(fn=fn, params=params, in_spec=spec,
+                       out_spec=spec, name=name)
+
+
+@register_model("probe_scale")
+def build_scale(scale: float = 2.0, rows: int = _ROWS):
+    params = {"scale": jnp.float32(scale)}
+
+    def fn(params, x):
+        return x * params["scale"]
+
+    return _bundle(fn, params, "probe_scale", rows)
+
+
+@register_model("probe_negate")
+def build_negate(rows: int = _ROWS):
+    def fn(params, x):
+        return -x
+
+    return _bundle(fn, None, "probe_negate", rows)
+
+
+@register_model("probe_offset")
+def build_offset(offset: float = 10.0, rows: int = _ROWS):
+    params = {"offset": jnp.float32(offset)}
+
+    def fn(params, x):
+        return x + params["offset"]
+
+    return _bundle(fn, params, "probe_offset", rows)

@@ -94,7 +94,7 @@ def _load_builtins() -> None:
     import importlib
 
     for mod in ("mobilenet_v2", "ssd_mobilenet", "posenet", "lstm",
-                "transformer", "audio_classifier"):
+                "transformer", "audio_classifier", "probe"):
         try:
             importlib.import_module(f"nnstreamer_tpu.models.{mod}")
         except ImportError:

@@ -278,6 +278,9 @@ class NullTracer:
     def record_request(self, name, trace_id, hops, t, **args):
         pass
 
+    def tenant_summary(self):
+        return {}
+
     def instant(self, name, label, t=None, **args):
         pass
 
@@ -527,6 +530,41 @@ class Tracer:
 
     def requests(self) -> List[Tuple[str, str, float, list, dict]]:
         return list(self._requests)
+
+    def tenant_summary(self) -> Dict[str, dict]:
+        """Per-tenant rollup over the bounded request window: completed
+        count, completion rate across the window span, and server-side
+        latency percentiles (first recorded hop → completion). Only
+        requests recorded with a ``tenant=`` arg contribute (the query
+        server adds it when admission stamped a tenant class) — this is
+        what the ScalingController reads for per-tenant demand and what
+        metrics_snapshot exports as nns_tenant_latency gauges."""
+        acc: Dict[str, dict] = {}
+        for _name, _tid, t, hops, args in list(self._requests):
+            tenant = args.get("tenant")
+            if tenant is None:
+                continue
+            row = acc.setdefault(
+                tenant, {"count": 0, "lat": [], "t0": t, "t1": t})
+            row["count"] += 1
+            row["t0"] = min(row["t0"], t)
+            row["t1"] = max(row["t1"], t)
+            ts = [h["t"] for h in hops
+                  if isinstance(h.get("t"), (int, float))]
+            if ts:
+                row["lat"].append(max(0.0, t - min(ts)))
+        out: Dict[str, dict] = {}
+        for tenant, row in acc.items():
+            lat = sorted(row["lat"])
+            span = row["t1"] - row["t0"]
+            out[tenant] = {
+                "count": row["count"],
+                "rate_hz": (row["count"] - 1) / span
+                if row["count"] > 1 and span > 0 else float(row["count"]),
+                "p50_ms": 1e3 * percentile(lat, 50.0),
+                "p99_ms": 1e3 * percentile(lat, 99.0),
+            }
+        return out
 
     def instant(self, name: str, label: str, t: Optional[float] = None,
                 **args) -> None:

@@ -100,6 +100,52 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
         out.append(_series(f"{ns}_admission_depth_peak", "gauge",
                            "admission queue high-water mark",
                            [({}, float(admission["depth_peak"]))]))
+        classes = admission.get("classes")
+        if classes:
+            # per-tenant conservation ledger: same shape as the global
+            # admission counters, labelled by tenant class. Summing any
+            # family over the tenant label reproduces the global series
+            # — the per-class invariant is checkable from one scrape.
+            # Label cardinality is bounded by admission-time tenant
+            # name validation (serving/tenancy.validate_tenant_name).
+            for key, help_ in (
+                    ("offered", "requests seen at the door"),
+                    ("admitted", "requests admitted"),
+                    ("replied", "requests answered with RESULT")):
+                out.append(_series(
+                    f"{ns}_tenant_{key}_total", "counter",
+                    f"per-tenant admission: {help_}",
+                    [({"tenant": t}, float(c[key]))
+                     for t, c in sorted(classes.items())]))
+            out.append(_series(
+                f"{ns}_tenant_rejected_total", "counter",
+                "per-tenant at-the-door refusals by cause",
+                [({"tenant": t, "cause": cause}, float(v))
+                 for t, c in sorted(classes.items())
+                 for cause, v in sorted(c["rejected"].items())] or
+                [({"tenant": "none", "cause": "none"}, 0.0)]))
+            out.append(_series(
+                f"{ns}_tenant_shed_total", "counter",
+                "per-tenant post-admission sheds by cause",
+                [({"tenant": t, "cause": cause}, float(v))
+                 for t, c in sorted(classes.items())
+                 for cause, v in sorted(c["shed"].items())] or
+                [({"tenant": "none", "cause": "none"}, 0.0)]))
+            out.append(_series(
+                f"{ns}_tenant_depth", "gauge",
+                "per-tenant requests queued right now",
+                [({"tenant": t}, float(c["depth"]))
+                 for t, c in sorted(classes.items())]))
+            out.append(_series(
+                f"{ns}_tenant_inflight", "gauge",
+                "per-tenant requests dequeued but not yet replied",
+                [({"tenant": t}, float(c["inflight"]))
+                 for t, c in sorted(classes.items())]))
+            out.append(_series(
+                f"{ns}_tenant_weight", "gauge",
+                "per-tenant WFQ weight (scheduling share)",
+                [({"tenant": t}, float(c["weight"]))
+                 for t, c in sorted(classes.items())]))
 
     if pool:
         p = pool.get("pool", {})
@@ -249,6 +295,26 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                 "per-queue high-water mark",
                 [({"queue": n}, float(g.get("peak", 0)))
                  for n, g in sorted(queues.items())]))
+        tenants = tracer.tenant_summary() \
+            if hasattr(tracer, "tenant_summary") else {}
+        if tenants:
+            out.append(_series(
+                f"{ns}_tenant_p99_ms", "gauge",
+                "per-tenant server-side p99 latency over the request "
+                "window (admit → reply)",
+                [({"tenant": t}, float(r["p99_ms"]))
+                 for t, r in sorted(tenants.items())]))
+            out.append(_series(
+                f"{ns}_tenant_p50_ms", "gauge",
+                "per-tenant server-side median latency over the "
+                "request window",
+                [({"tenant": t}, float(r["p50_ms"]))
+                 for t, r in sorted(tenants.items())]))
+            out.append(_series(
+                f"{ns}_tenant_rate_hz", "gauge",
+                "per-tenant completion rate over the request window",
+                [({"tenant": t}, float(r["rate_hz"]))
+                 for t, r in sorted(tenants.items())]))
 
     if extra:
         for name, value in sorted(extra.items()):
@@ -448,7 +514,12 @@ def scrape(url: str, timeout_s: float = 5.0) -> str:
 _TOP_KEY_FAMILIES = (
     "nns_admission_offered_total", "nns_admission_admitted_total",
     "nns_admission_replied_total", "nns_admission_rejected_total",
-    "nns_admission_shed_total", "nns_worker_replied_total",
+    "nns_admission_shed_total",
+    # per-tenant rows: replied rate = goodput, shed/rejected rate =
+    # shed rate, p99 gauge = SLO position (all labelled by tenant)
+    "nns_tenant_replied_total", "nns_tenant_rejected_total",
+    "nns_tenant_shed_total", "nns_tenant_p99_ms",
+    "nns_worker_replied_total",
     "nns_pool_restarts_total", "nns_trace_events_total",
 )
 

@@ -98,16 +98,22 @@ class _Request:
     surviving TensorBuffer."""
 
     __slots__ = ("rid", "client_id", "pts", "payload", "attempts",
-                 "t_sent", "traced", "hops")
+                 "t_sent", "traced", "hops", "cls", "model")
 
     def __init__(self, rid: int, client_id, pts, payload: bytes,
-                 traced: bool = False):
+                 traced: bool = False, cls: Optional[str] = None,
+                 model: Optional[str] = None):
         self.rid = rid
         self.client_id = client_id
         self.pts = pts
         self.payload = payload
         self.attempts = 0             # deliveries so far
         self.t_sent = 0.0
+        # tenancy: the admission-resolved class (for per-class shed
+        # accounting on this request's failure paths) and the model it
+        # routes to (for bound-slot dispatch preference)
+        self.cls = cls
+        self.model = model
         # parent-side hop records (dispatch/reoffer): the payload is
         # already-encoded bytes when the router touches it, so router
         # hops are kept here and merged into the reply's trace context
@@ -153,6 +159,7 @@ class _Slot:
         self.kills = 0
         self.replied = 0
         self.version: Optional[tuple] = None
+        self.bound_model: Optional[str] = None   # rebind() routing hint
 
     def hb_age_s(self, now: float) -> float:
         return now - max(self.last_hb, self.started_t)
@@ -215,6 +222,8 @@ class WorkerPool:
         self.epoch = 0                # bumps on every committed swap
         self.degraded = 0             # slots disabled by the circuit
         self.reoffered = 0
+        self.rebinds = 0              # committed rebind broadcasts
+        self.tenant_table = None      # serving.tenancy.TenantTable
         self.last_worker_error: Optional[BaseException] = None
         self._resident_versions: Dict[str, list] = {}
         self._all_pids: List[int] = []   # every pid ever spawned
@@ -338,6 +347,11 @@ class WorkerPool:
                     acks = self._swap_acks
                 if acks is not None:
                     acks.put((slot.wid, msg[1], msg[2], msg[3]))
+            elif tag == "bind_ack":
+                with self._lock:
+                    acks = self._bind_acks
+                if acks is not None:
+                    acks.put((slot.wid, msg[1], msg[2], msg[3]))
             elif tag == "fatal":
                 self._note_worker_error(slot, msg[1])
             elif tag == "bye":
@@ -383,7 +397,7 @@ class WorkerPool:
             log.warning("pool %s: worker %d returned a corrupt frame "
                         "for pts=%s: %s", self.name, slot.wid,
                         req.pts, e)
-            self.qs.frames.note_failed("worker_error")
+            self.qs.frames.note_failed("worker_error", cls=req.cls)
             self.qs.send_busy(req.client_id, req.pts, "worker_error")
             return
         buf.meta.pop(RID_META, None)
@@ -416,7 +430,7 @@ class WorkerPool:
             return
         log.warning("pool %s: worker %d failed frame pts=%s: %s",
                     self.name, slot.wid, req.pts, exc)
-        self.qs.frames.note_failed("worker_error")
+        self.qs.frames.note_failed("worker_error", cls=req.cls)
         self.qs.send_busy(req.client_id, req.pts, "worker_error")
         self._dispatch_evt.set()
 
@@ -458,24 +472,44 @@ class WorkerPool:
                 self._dispatch_evt.wait(0.05)
                 self._dispatch_evt.clear()
 
+    def set_tenants(self, table) -> None:
+        """Install a `serving.tenancy.TenantTable` for tenant→model
+        routing (bound-slot dispatch preference + per-class shed
+        accounting on this pool's failure paths)."""
+        with self._lock:
+            self.tenant_table = table
+
     def _admit(self, buf) -> _Request:
         with self._lock:
             self._next_rid += 1
             rid = self._next_rid
+            table = self.tenant_table
         client_id = buf.meta.pop("client_id", None)
         buf.meta[RID_META] = rid
+        cls = buf.meta.get("_tenant_class") \
+            if isinstance(buf.meta, dict) else None
+        model = table.model_of(cls) if table is not None else None
         return _Request(rid, client_id, buf.pts, encode_buffer(buf),
-                        traced=get_trace_ctx(buf.meta) is not None)
+                        traced=get_trace_ctx(buf.meta) is not None,
+                        cls=cls, model=model)
 
     def _dispatch(self, req: _Request) -> bool:
         """Send to the least-outstanding READY worker with queue room;
-        False when no worker can take it right now."""
+        False when no worker can take it right now. A request routed to
+        a model prefers slots bound to that model (rebind()); when none
+        has room it falls back to any candidate — a multiplex worker
+        can serve every model, a bound slot is just warmer."""
         with self._lock:
             candidates = [s for s in self._slots
                           if s.state == READY
                           and len(s.inflight) < self.per_worker_queue]
             if not candidates:
                 return False
+            if req.model is not None:
+                bound = [s for s in candidates
+                         if s.bound_model == req.model]
+                if bound:
+                    candidates = bound
             slot = min(candidates, key=lambda s: len(s.inflight))
             req.attempts += 1
             req.t_sent = time.monotonic()
@@ -584,7 +618,7 @@ class WorkerPool:
                 self._event(slot.wid, "reoffer", pts=req.pts,
                             attempts=req.attempts)
             else:
-                self.qs.frames.note_failed("worker_lost")
+                self.qs.frames.note_failed("worker_lost", cls=req.cls)
                 self.qs.send_busy(req.client_id, req.pts, "worker_lost")
         # exponential backoff before the slot restarts
         slot.backoff_s = min(
@@ -704,6 +738,104 @@ class WorkerPool:
             with self._lock:
                 self._swap_acks = None
 
+    # -- replica rebinding (serving/tenancy.ScalingController) -------------
+    _bind_acks = None
+
+    def bindings(self) -> Dict[int, Optional[str]]:
+        """{wid: bound model (or None)} for every ready slot — the
+        ScalingController's view of the current replica assignment."""
+        with self._lock:
+            return {s.wid: s.bound_model for s in self._slots
+                    if s.state == READY}
+
+    @property
+    def size(self) -> int:
+        """Configured slot count (the scaler's allocation budget)."""
+        return self.n_workers
+
+    def rebind(self, mapping: Dict[int, Optional[str]],
+               timeout_s: float = 30.0) -> dict:
+        """Re-bind pool slots to models, epoch-atomically.
+
+        `mapping` is {wid: model name or None}; slots it omits keep
+        their binding. Reuses the swap broadcast's two-phase shape:
+        every targeted ready worker gets prepare, any refusal (e.g. a
+        multiplex worker without that model) aborts everywhere, and
+        only a unanimous commit flips the parent's routing table and
+        bumps the pool epoch — dispatch never sees a half-applied
+        binding. A commit failure after unanimous prepare kills that
+        worker (same reasoning as swap: it is now inconsistent)."""
+        import queue as _queue
+
+        with self._lock:
+            targets = [s for s in self._slots
+                       if s.state == READY and s.wid in mapping]
+            if not targets:
+                return {"ok": False, "error": "no ready workers in "
+                        "mapping", "epoch": self.epoch}
+            acks: "_queue.Queue" = _queue.Queue()
+            self._bind_acks = acks
+
+        def phase(ph: str, slots) -> Dict[int, tuple]:
+            got: Dict[int, tuple] = {}
+            for s in slots:
+                try:
+                    with s.send_lock:
+                        s.conn.send(("bind", ph, mapping[s.wid]))
+                except (OSError, ValueError, BrokenPipeError):
+                    got[s.wid] = (False, "worker died mid-rebind")
+            deadline = time.monotonic() + timeout_s
+            while len(got) < len(slots):
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                try:
+                    wid, ph_got, ok, err = acks.get(timeout=remain)
+                except _queue.Empty:
+                    break
+                if ph_got == ph:
+                    got[wid] = (ok, err)
+            for s in slots:
+                got.setdefault(s.wid, (False, f"no {ph} ack"))
+            return got
+
+        try:
+            prep = phase("prepare", targets)
+            report = {"mapping": {s.wid: mapping[s.wid]
+                                  for s in targets},
+                      "workers": {w: {"prepare_ok": ok, "error": err}
+                                  for w, (ok, err) in prep.items()}}
+            if not all(ok for ok, _ in prep.values()):
+                phase("abort", targets)
+                report["ok"] = False
+                report["epoch"] = self.epoch
+                self._event(-1, "rebind_abort")
+                return report
+            comm = phase("commit", targets)
+            for w, (ok, err) in comm.items():
+                report["workers"][w]["commit_ok"] = ok
+                if err:
+                    report["workers"][w]["error"] = err
+            report["ok"] = all(ok for ok, _ in comm.values())
+            if report["ok"]:
+                with self._lock:
+                    self.epoch += 1
+                    self.rebinds += 1
+                    for s in targets:
+                        s.bound_model = mapping[s.wid]
+                report["epoch"] = self.epoch
+                self._event(-1, "rebind_commit", epoch=self.epoch,
+                            bindings=len(targets))
+            else:
+                report["epoch"] = self.epoch
+                for s in targets:
+                    if not comm.get(s.wid, (True, None))[0]:
+                        self._kill(s, "rebind_commit_failed")
+            return report
+        finally:
+            with self._lock:
+                self._bind_acks = None
+
     # -- introspection -----------------------------------------------------
     @property
     def closed(self) -> bool:
@@ -758,6 +890,7 @@ class WorkerPool:
                 "restarts": s.restarts,
                 "kills": s.kills,
                 "replied": s.replied,
+                "bound_model": s.bound_model,
             } for s in self._slots]
             return {
                 "pool": {
@@ -772,6 +905,7 @@ class WorkerPool:
                     "reoffered": self.reoffered,
                     "pending": len(self._pending),
                     "epoch": self.epoch,
+                    "rebinds": self.rebinds,
                 },
                 "workers": workers,
             }
@@ -816,7 +950,7 @@ class WorkerPool:
             undispatched = list(self._pending)
             self._pending.clear()
         for req in undispatched:
-            self.qs.frames.note_failed("shutdown")
+            self.qs.frames.note_failed("shutdown", cls=req.cls)
             self.qs.send_busy(req.client_id, req.pts, "shutdown")
         # 3. drain: in-flight frames keep completing through the live
         #    reader threads until the budget expires
@@ -834,7 +968,7 @@ class WorkerPool:
                 abandoned.extend(s.inflight.values())
                 s.inflight.clear()
         for req in abandoned:
-            self.qs.frames.note_failed("shutdown")
+            self.qs.frames.note_failed("shutdown", cls=req.cls)
             self.qs.send_busy(req.client_id, req.pts, "shutdown")
         # 5. stop the supervisor, then the children: graceful stop
         #    first, escalate terminate -> kill; join readers
@@ -889,6 +1023,7 @@ class PooledQueryServer:
                  sid: int = 0, host: str = "127.0.0.1", port: int = 0,
                  max_pending: int = 64, max_inflight: int = 0,
                  shed_policy: str = "reject-newest",
+                 tenants=None,
                  tracer=None, ready_timeout_s: float = 30.0,
                  **pool_kwargs):
         self.qs = QueryServer.get(sid)
@@ -899,10 +1034,23 @@ class PooledQueryServer:
         self.qs.frames.configure(max_pending=max_pending,
                                  max_inflight=max_inflight,
                                  shed_policy=shed_policy)
+        # tenancy: one table drives all three layers — the WFQ
+        # admission front, the pool's tenant→model dispatch routing,
+        # and (for multiplex workers) the spec's child-side copy
+        self.tenants = tenants
+        if tenants is not None:
+            self.qs.frames.set_tenants(tenants)
+            if spec.kind == "multiplex" and not spec.tenants:
+                import dataclasses
+
+                spec = dataclasses.replace(
+                    spec, tenants=tenants.to_dict())
         if tracer is not None:
             self.qs.tracer = tracer
         self.qs.start(host, port)
         self.pool = WorkerPool(self.qs, spec, workers, **pool_kwargs)
+        if tenants is not None:
+            self.pool.set_tenants(tenants)
         self.pool.start(ready_timeout_s=ready_timeout_s)
         self._sig_prev: Dict[int, Any] = {}
 
@@ -940,6 +1088,9 @@ class PooledQueryServer:
 
     def swap(self, name: str, version=None, **kw) -> dict:
         return self.pool.swap(name, version, **kw)
+
+    def rebind(self, mapping, **kw) -> dict:
+        return self.pool.rebind(mapping, **kw)
 
     def install_signal_handlers(self) -> None:
         """SIGTERM/SIGINT -> graceful drain (serve CLI): the contract a

@@ -12,6 +12,8 @@ Parent -> child messages::
     ("req",  rid, payload)            one frame to service
     ("swap", phase, name, version)    two-phase model hot swap
                                       (phase: prepare | commit | abort)
+    ("bind", phase, model)            two-phase slot→model rebinding
+                                      (replica scaling, pool.rebind)
     ("stop",)                         graceful stop (drain then exit 0)
 
 Child -> parent messages::
@@ -25,6 +27,7 @@ Child -> parent messages::
     ("res", rid, payload)             one serviced frame
     ("err", rid, pickled_exc)         one frame failed (request-scoped)
     ("swap_ack", phase, ok, err)      swap phase outcome
+    ("bind_ack", phase, ok, err)      bind phase outcome
     ("fatal", pickled_exc)            unrecoverable worker error; the
                                       child exits nonzero right after
     ("bye",)                          graceful-stop acknowledgement
@@ -38,6 +41,9 @@ Service modes (`WorkerSpec.kind`):
 - ``pipeline`` — parse `pipeline` (a mid-pipeline description, e.g.
   ``tensor_filter framework=xla model=store://m``) into
   ``appsrc ! <pipeline> ! tensor_sink`` and stream frames through it.
+- ``multiplex`` — M `store://` models resident in one worker, each
+  frame routed by its tenant class (serving/tenancy.py); cold models'
+  compiled jits are LRU-evicted under a residency bound.
 
 Chaos hooks (`crash_pts`, `hang_pts`, `crash_after_s`,
 `swap_fail_version`) let tests inject deterministic worker failure
@@ -67,7 +73,7 @@ class WorkerSpec:
     """Picklable description of what one worker runs (spawn-safe: no
     callables, no open handles — the child rebuilds everything)."""
 
-    kind: str = "echo"                    # echo | pipeline
+    kind: str = "echo"                    # echo | pipeline | multiplex
     service_ms: float = 0.0               # echo: per-frame service time
     pipeline: str = ""                    # pipeline: mid-pipeline desc
     dims: str = "8:1"                     # accepted input dims (HELLO)
@@ -84,14 +90,30 @@ class WorkerSpec:
     hang_pts: Optional[int] = None        # sleep forever on this pts
     crash_after_s: Optional[float] = None  # os._exit(3) after t seconds
     swap_fail_version: Optional[int] = None  # swap prepare refuses this
+    # multiplex mode (serving/tenancy.py): the worker keeps several
+    # store:// models resident and routes each frame by its tenant
+    # class. `tenants` is a TenantTable.to_dict() snapshot (picklable);
+    # `preload` entries (name, version, ref) are registered into the
+    # CHILD's model store before the service opens — spawn children
+    # only inherit zoo seeds (@0), so extra versions for hot-swap must
+    # travel as recipes, not objects. resident_models/resident_bytes
+    # bound the LRU jit residency (0 = unbounded).
+    tenants: Optional[dict] = None
+    preload: tuple = ()                   # ((name, version, ref), ...)
+    resident_models: int = 0
+    resident_bytes: int = 0
 
     def __post_init__(self):
-        if self.kind not in ("echo", "pipeline"):
+        if self.kind not in ("echo", "pipeline", "multiplex"):
             raise ValueError(
-                f"WorkerSpec.kind must be echo|pipeline, got {self.kind!r}")
+                f"WorkerSpec.kind must be echo|pipeline|multiplex, "
+                f"got {self.kind!r}")
         if self.kind == "pipeline" and not self.pipeline:
             raise ValueError("WorkerSpec(kind='pipeline') needs a "
                              "pipeline description")
+        if self.kind == "multiplex" and not self.tenants:
+            raise ValueError("WorkerSpec(kind='multiplex') needs a "
+                             "tenants table (TenantTable.to_dict())")
 
 
 def _pickle_exc(exc: BaseException) -> bytes:
@@ -286,6 +308,169 @@ class _PipelineService:
             pass
 
 
+class _MultiplexService:
+    """M models, one worker: per-tenant model routing (serving/tenancy).
+
+    Every model the TenantTable binds gets its own store-attached
+    XLABackend, opened once at startup; each frame routes by the tenant
+    class riding its meta (``_tenant_class`` stamped at admission, or
+    the raw ``tenant`` claim when driven without an admission front).
+    A `ModelResidency` LRU bounds the compiled state: after each invoke
+    the served model is touched and cold models beyond the bound have
+    their bucketed jits released — the next frame for an evicted model
+    recompiles (counted, correct, never an error).
+
+    Store hot swaps work unchanged: the backends track the child
+    store's epoch and adopt at their next invoke boundary, so an
+    ``update(name, version)`` from a committed swap flips exactly the
+    swapped model — other tenants' backends (and compiled buckets) are
+    untouched.
+    """
+
+    def __init__(self, spec: WorkerSpec, tracer=None, wid: int = 0):
+        from nnstreamer_tpu.backends.xla import XLABackend
+        from nnstreamer_tpu.runtime.tracing import NULL_TRACER
+        from nnstreamer_tpu.serving.tenancy import (
+            ModelResidency, TenantTable)
+        from nnstreamer_tpu.tensor.info import TensorsSpec
+
+        self._spec = spec
+        self._tracer = tracer or NULL_TRACER
+        self._wid = wid
+        self._table = TenantTable.from_dict(spec.tenants)
+        self._in_spec = TensorsSpec.from_strings(spec.dims, spec.types)
+        self._residency = ModelResidency(
+            max_models=spec.resident_models,
+            max_bytes=spec.resident_bytes)
+        self._backends: dict = {}
+        self.invokes_by_model: dict = {}
+        models = self._table.models()
+        if not models:
+            raise ValueError("multiplex worker: tenant table binds no "
+                             "models")
+        for name in models:
+            b = XLABackend()
+            b.open({"model": f"store://{name}"})
+            b.set_input_info(self._in_spec)
+            self._backends[name] = b
+            self._residency.register(name, b)
+        self._default_model = (self._table.model_of(None)
+                               or models[0])
+
+    def _route(self, meta) -> str:
+        cls = None
+        if isinstance(meta, dict):
+            cls = meta.get("_tenant_class") or meta.get("tenant")
+        model = self._table.model_of(cls) if cls is not None else None
+        if model is None or model not in self._backends:
+            return self._default_model
+        return model
+
+    def ready_info(self) -> dict:
+        dims, types, _ = self._in_spec.to_strings()
+        return {"out_dims": dims, "out_types": types,
+                "versions": _resident_versions(),
+                "models": sorted(self._backends)}
+
+    def serve(self, rid: int, payload: bytes, reply) -> None:
+        import numpy as np
+
+        from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
+        from nnstreamer_tpu.runtime.tracing import stamp_hop
+
+        buf, _ = decode_buffer(payload)
+        if buf.pts == self._spec.crash_pts:
+            os._exit(3)
+        if buf.pts == self._spec.hang_pts:
+            time.sleep(3600)
+        model = self._route(buf.meta)
+        backend = self._backends[model]
+        if self._tracer.active:
+            stamp_hop(buf.meta, "worker_recv", wid=self._wid,
+                      model=model)
+        t0 = time.perf_counter()
+        out = backend.invoke(buf.tensors)
+        t1 = time.perf_counter()
+        self.invokes_by_model[model] = \
+            self.invokes_by_model.get(model, 0) + 1
+        self._residency.touch(model)
+        res = buf.with_tensors(
+            tuple(np.asarray(o) for o in out), pts=buf.pts)
+        if self._tracer.active:
+            self._tracer.record_process(f"mux:{model}", buf, t0, t1)
+            stamp_hop(res.meta, "worker_done", wid=self._wid,
+                      model=model)
+        reply(("res", rid, encode_buffer(res)))
+
+    def residency_stats(self) -> dict:
+        st = self._residency.stats()
+        st["invokes_by_model"] = dict(self.invokes_by_model)
+        return st
+
+    def close(self) -> None:
+        for b in self._backends.values():
+            try:
+                b.close()
+            except Exception:
+                pass
+
+
+def _register_preloads(preload) -> None:
+    """Install the spec's (name, version, ref) recipes into THIS
+    process's store: string refs register as lazy builders, so nothing
+    heavyweight resolves until a swap actually commits that version."""
+    from nnstreamer_tpu.serving.store import get_store
+
+    store = get_store()
+    for name, version, ref in preload:
+        try:
+            # pull the zoo seed (@0) first if there is one, so the
+            # preloaded version lands as a LATER version and the
+            # zero-downtime contract holds: registration never changes
+            # what's being served — only a committed swap does
+            try:
+                store.entry(name)
+            except Exception:
+                pass                  # brand-new name: recipe is v1
+            store.register(name, model=ref, version=version)
+        except Exception:
+            # idempotence over strictness: an already-registered
+            # version (restart, double preload) is not a setup failure
+            pass
+
+
+def _handle_bind(service, state: dict, phase: str,
+                 model) -> "tuple[bool, Optional[str]]":
+    """Two-phase slot→model rebinding, child side (pool.rebind).
+
+    Binding is primarily PARENT routing state (which slot is preferred
+    for which model); the child's role is to vote in the two-phase
+    broadcast so the flip is epoch-atomic, and — for a multiplex
+    worker — to verify it can actually serve the model and warm it.
+    Echo/pipeline workers accept any bind (routing is not theirs to
+    refuse)."""
+    if phase == "abort":
+        state.pop("bind_staged", None)
+        return True, None
+    if phase == "prepare":
+        if model is not None and isinstance(service, _MultiplexService):
+            if model not in service._backends:
+                return False, (f"worker has no backend for model "
+                               f"{model!r}")
+        state["bind_staged"] = model
+        return True, None
+    if phase == "commit":
+        staged = state.pop("bind_staged", "\0missing")
+        if staged == "\0missing" or staged != model:
+            return False, (f"bind commit without matching prepare "
+                           f"(staged={staged!r})")
+        state["bound_model"] = model
+        if model is not None and isinstance(service, _MultiplexService):
+            service._residency.touch(model)   # pre-warm LRU position
+        return True, None
+    return False, f"unknown bind phase {phase!r}"
+
+
 def _handle_swap(service, spec: WorkerSpec, state: dict, phase: str,
                  name: str, version) -> "tuple[bool, Optional[str]]":
     """Two-phase hot swap, child side. `prepare` stages (and for
@@ -300,7 +485,7 @@ def _handle_swap(service, spec: WorkerSpec, state: dict, phase: str,
         if spec.swap_fail_version is not None \
                 and version == spec.swap_fail_version:
             return False, f"injected prepare failure for @{version}"
-        if isinstance(service, _PipelineService):
+        if isinstance(service, (_PipelineService, _MultiplexService)):
             try:
                 from nnstreamer_tpu.serving.store import get_store
 
@@ -318,7 +503,7 @@ def _handle_swap(service, spec: WorkerSpec, state: dict, phase: str,
         if staged != (name, version):
             return False, (f"commit without matching prepare "
                            f"(staged={staged!r})")
-        if isinstance(service, _PipelineService):
+        if isinstance(service, (_PipelineService, _MultiplexService)):
             try:
                 from nnstreamer_tpu.serving.store import get_store
 
@@ -364,8 +549,12 @@ def worker_main(conn, spec: WorkerSpec, wid: int = 0) -> None:
 
     service = None
     try:
+        if spec.preload:
+            _register_preloads(spec.preload)
         if spec.kind == "pipeline":
             service = _PipelineService(spec, reply, tracer, wid)
+        elif spec.kind == "multiplex":
+            service = _MultiplexService(spec, tracer, wid)
         else:
             service = _EchoService(spec, tracer, wid)
     except BaseException as e:
@@ -396,6 +585,10 @@ def worker_main(conn, spec: WorkerSpec, wid: int = 0) -> None:
                 ok, err = _handle_swap(service, spec, swap_state,
                                        phase, name, version)
                 reply(("swap_ack", phase, ok, err))
+            elif tag == "bind":
+                _, phase, model = msg
+                ok, err = _handle_bind(service, swap_state, phase, model)
+                reply(("bind_ack", phase, ok, err))
             elif tag == "stop":
                 break
     finally:

@@ -9,7 +9,9 @@ discipline real traffic forces onto it:
 - loadgen.py   — open-loop Poisson / bursty (Markov-modulated on/off)
   load harness with a latency-SLO report: goodput at a p99 budget,
   shed rate, queue-depth timeline. `run_against_mesh` floods a
-  multi-host MeshRouter while a host is partitioned mid-flood.
+  multi-host MeshRouter while a host is partitioned mid-flood;
+  `run_multitenant` / `noisy_neighbor_drill` flood a weighted-fair
+  multi-tenant pool and report per-tenant isolation.
 - netchaos.py  — deterministic seeded network fault injection
   (delay/drop/duplicate/blackhole/slow-close) at message granularity,
   between any two query-wire peers.
@@ -23,8 +25,10 @@ traffic`, and `bench.py --family traffic`. See docs/traffic.md.
 from nnstreamer_tpu.traffic.admission import (
     DEADLINE_META, SHED_POLICIES, AdmissionDecision, AdmissionQueue)
 from nnstreamer_tpu.traffic.loadgen import (
-    EchoServer, bursty_arrivals, poisson_arrivals, run_against_echo,
-    run_against_mesh, run_against_pool, run_open_loop)
+    EchoServer, bursty_arrivals, merge_tenant_arrivals,
+    noisy_neighbor_drill, poisson_arrivals, run_against_echo,
+    run_against_mesh, run_against_pool, run_multitenant,
+    run_open_loop)
 from nnstreamer_tpu.traffic.netchaos import ChaosProxy
 
 __all__ = [
@@ -35,9 +39,12 @@ __all__ = [
     "SHED_POLICIES",
     "EchoServer",
     "bursty_arrivals",
+    "merge_tenant_arrivals",
+    "noisy_neighbor_drill",
     "poisson_arrivals",
     "run_against_echo",
     "run_against_mesh",
     "run_against_pool",
+    "run_multitenant",
     "run_open_loop",
 ]

@@ -96,6 +96,7 @@ def run_open_loop(host: str, port: int, *, dims: str,
                   hello_timeout_s: float = 10.0,
                   depth_probe: Optional[Callable[[], int]] = None,
                   depth_sample_ms: float = 25.0,
+                  group_of: Optional[Callable[[int], str]] = None,
                   trace: bool = False) -> dict:
     """Drive one live query server open-loop; return the SLO report.
 
@@ -260,6 +261,43 @@ def run_open_loop(host: str, port: int, *, dims: str,
         "shed_rate": round(rejected / n_sent, 4) if n_sent else 0.0,
         "busy_causes": causes,
     }
+    if group_of is not None:
+        # per-group outcome partition: pts IS the request index, so
+        # group_of(i) attributes every sent request to exactly one
+        # group — the same exhaustive completed/rejected/lost
+        # accounting as the summed report, just filtered
+        groups: Dict[str, dict] = {}
+        for i in range(n_sent):
+            g = str(group_of(i))
+            row = groups.setdefault(g, {
+                "offered": 0, "completed": 0, "rejected": 0,
+                "lost": 0, "busy_causes": {}, "_lat": []})
+            row["offered"] += 1
+            if i in done:
+                row["completed"] += 1
+                row["_lat"].append((done[i] - sent_at[i]) * 1e3)
+            elif i in busy:
+                row["rejected"] += 1
+                cause = busy[i].get("cause", "?")
+                row["busy_causes"][cause] = \
+                    row["busy_causes"].get(cause, 0) + 1
+            else:
+                row["lost"] += 1
+        for row in groups.values():
+            lats = sorted(row.pop("_lat"))
+            w = sum(1 for v in lats if v <= p99_budget_ms)
+            row["within_budget"] = w
+            row["goodput_rps"] = \
+                round(w / elapsed, 2) if elapsed else 0.0
+            row["shed_rate"] = (
+                round(row["rejected"] / row["offered"], 4)
+                if row["offered"] else 0.0)
+            if lats:
+                row["latency_ms"] = {
+                    "p50": round(percentile(lats, 50), 2),
+                    "p99": round(percentile(lats, 99), 2),
+                    "max": round(lats[-1], 2)}
+        report["groups"] = groups
     if lat_ms:
         report["latency_ms"] = {
             "p50": round(percentile(lat_ms, 50), 2),
@@ -463,6 +501,191 @@ def _conservation_ok(c: dict) -> bool:
     return (c["offered"] == c["admitted"] + sum(c["rejected"].values())
             and c["admitted"] == c["replied"] + sum(c["shed"].values())
             + c["depth"] + c["inflight"])
+
+
+def _tenant_conservation_ok(c: dict) -> bool:
+    """Per-class form of the invariants: each class's counters must
+    close exactly on their own, AND the classes must sum back to the
+    global counters — shed load can move between classes only through
+    the books."""
+    if not _conservation_ok(c):
+        return False
+    classes = c.get("classes")
+    if not classes:
+        return True
+    sums = {k: 0 for k in ("offered", "admitted", "replied",
+                           "rejected", "shed", "depth", "inflight")}
+    for st in classes.values():
+        rej = sum(st["rejected"].values())
+        shed = sum(st["shed"].values())
+        if st["offered"] != st["admitted"] + rej:
+            return False
+        if st["admitted"] != (st["replied"] + shed
+                              + st["depth"] + st["inflight"]):
+            return False
+        sums["offered"] += st["offered"]
+        sums["admitted"] += st["admitted"]
+        sums["replied"] += st["replied"]
+        sums["rejected"] += rej
+        sums["shed"] += shed
+        sums["depth"] += st["depth"]
+        sums["inflight"] += st["inflight"]
+    return (sums["offered"] == c["offered"]
+            and sums["admitted"] == c["admitted"]
+            and sums["replied"] == c["replied"]
+            and sums["rejected"] == sum(c["rejected"].values())
+            and sums["shed"] == sum(c["shed"].values())
+            and sums["depth"] == c["depth"]
+            and sums["inflight"] == c["inflight"])
+
+
+# -- multi-tenant harness ----------------------------------------------------
+
+def merge_tenant_arrivals(schedules: Dict[str, np.ndarray]
+                          ) -> "tuple[np.ndarray, List[str]]":
+    """Merge per-tenant arrival schedules into one global timeline.
+    Returns (arrivals, owner) where owner[i] is the tenant whose
+    schedule produced arrival i — the pts→tenant map that lets one
+    open-loop run stamp and account per tenant."""
+    pairs: List[tuple] = []
+    for name, times in schedules.items():
+        pairs.extend((float(t), name) for t in times)
+    pairs.sort()
+    arrivals = np.asarray([t for t, _ in pairs])
+    owner = [name for _, name in pairs]
+    return arrivals, owner
+
+
+def run_multitenant(*, tenants: Dict[str, dict],
+                    n_per_tenant: Dict[str, int],
+                    rate_hz: Dict[str, float],
+                    workers: int = 2, service_ms: float = 10.0,
+                    max_pending: int = 32,
+                    shed_policy: str = "reject-oldest",
+                    p99_budget_ms: float = 250.0, seed: int = 0,
+                    drain_timeout_s: float = 15.0,
+                    **pool_kwargs) -> dict:
+    """One multi-tenant harness run: a worker POOL fronted by the WFQ
+    admission queue (a TenantTable built from `tenants`), flooded by
+    the merged per-tenant Poisson schedules in `rate_hz`/`n_per_tenant`.
+    Every frame is stamped with its tenant meta; the report's
+    ``groups`` partition the outcome per tenant, and ``conserved``
+    checks the invariants per class AND summed.
+
+    `tenants` maps name -> TenantClass kwargs (weight, deadline_ms,
+    max_pending, model) — the same dict shape TenantTable.from_dict
+    accepts as its "tenants" entry.
+    """
+    from nnstreamer_tpu.serving.pool import PooledQueryServer
+    from nnstreamer_tpu.serving.tenancy import TENANT_META, TenantTable
+
+    rng = np.random.default_rng(seed)
+    table = TenantTable.from_dict({"tenants": dict(tenants)})
+    schedules = {
+        name: poisson_arrivals(rate_hz[name], n_per_tenant[name], rng)
+        for name in tenants if n_per_tenant.get(name, 0) > 0}
+    if not schedules:
+        raise ValueError("no tenant has a nonzero request count")
+    arrivals, owner = merge_tenant_arrivals(schedules)
+
+    pqs = PooledQueryServer.echo(
+        workers=workers, service_ms=service_ms,
+        max_pending=max_pending, shed_policy=shed_policy,
+        tenants=table, **pool_kwargs)
+    try:
+        x = np.ones((8, 1), np.float32)
+
+        def make_frame(i):
+            return TensorBuffer.of(x, pts=i).with_meta(
+                **{TENANT_META: owner[i]})
+
+        report = run_open_loop(
+            "127.0.0.1", pqs.port, dims=pqs.pool.spec.dims,
+            types=pqs.pool.spec.types, arrivals=arrivals,
+            make_frame=make_frame, p99_budget_ms=p99_budget_ms,
+            drain_timeout_s=drain_timeout_s,
+            depth_probe=pqs.depth_probe,
+            group_of=lambda i: owner[i])
+        c = pqs.admission_counters()
+        report.update({
+            "service_ms": service_ms, "workers": workers,
+            "capacity_rps": round(pqs.capacity_rps, 1),
+            "seed": int(seed),
+            "tenants": {name: {"rate_hz": rate_hz.get(name),
+                               "n": n_per_tenant.get(name, 0)}
+                        for name in tenants},
+            "conserved": _tenant_conservation_ok(c),
+            "admission": c,
+        })
+        return report
+    finally:
+        pqs.close()
+
+
+def noisy_neighbor_drill(*, victim_weight: float = 1.0,
+                         flood_weight: float = 1.0,
+                         victim_x: float = 0.5, flood_x: float = 3.0,
+                         n_victim: int = 120,
+                         workers: int = 2, service_ms: float = 10.0,
+                         max_pending: int = 32,
+                         deadline_ms: Optional[float] = None,
+                         seed: int = 0, **kw) -> dict:
+    """The noisy-neighbor acceptance drill: tenant ``flood`` offers
+    `flood_x` × its fair share while ``victim`` stays at `victim_x` ×
+    its own. Two runs — the victim alone (baseline), then contested —
+    and the verdict is the contested/solo goodput ratio: weighted-fair
+    admission must keep the victim's goodput and p99 where they were,
+    with the overage shed FROM THE FLOODER (cause tenant_over_share).
+
+    Returns {solo, contested, victim_goodput_ratio, victim_p99_ms,
+    victim_p99_budget_ms, conserved, zero_lost}.
+    """
+    capacity = workers * 1e3 / service_ms
+    total_w = victim_weight + flood_weight
+    victim_share = capacity * victim_weight / total_w
+    flood_share = capacity * flood_weight / total_w
+    victim_rate = victim_x * victim_share
+    flood_rate = flood_x * flood_share
+    # matched send windows: the flooder floods for as long as the
+    # victim is offering, so contention covers the whole run
+    n_flood = max(1, int(round(
+        n_victim / victim_rate * flood_rate)))
+    if deadline_ms is None:
+        # a full fair-share queue's worth of waiting + one service time
+        deadline_ms = (max_pending + 2) * service_ms
+    tenants = {
+        "victim": {"weight": victim_weight, "deadline_ms": deadline_ms},
+        "flood": {"weight": flood_weight, "deadline_ms": deadline_ms},
+    }
+
+    solo = run_multitenant(
+        tenants=tenants,
+        n_per_tenant={"victim": n_victim, "flood": 0},
+        rate_hz={"victim": victim_rate, "flood": flood_rate},
+        workers=workers, service_ms=service_ms,
+        max_pending=max_pending, p99_budget_ms=deadline_ms,
+        seed=seed, **kw)
+    contested = run_multitenant(
+        tenants=tenants,
+        n_per_tenant={"victim": n_victim, "flood": n_flood},
+        rate_hz={"victim": victim_rate, "flood": flood_rate},
+        workers=workers, service_ms=service_ms,
+        max_pending=max_pending, p99_budget_ms=deadline_ms,
+        seed=seed, **kw)
+
+    v_solo = solo["groups"]["victim"]
+    v_cont = contested["groups"]["victim"]
+    ratio = (v_cont["goodput_rps"] / v_solo["goodput_rps"]
+             if v_solo["goodput_rps"] else 0.0)
+    return {
+        "solo": solo,
+        "contested": contested,
+        "victim_goodput_ratio": round(ratio, 3),
+        "victim_p99_ms": v_cont.get("latency_ms", {}).get("p99"),
+        "victim_p99_budget_ms": deadline_ms,
+        "conserved": bool(solo["conserved"] and contested["conserved"]),
+        "zero_lost": solo["lost"] == 0 and contested["lost"] == 0,
+    }
 
 
 def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,

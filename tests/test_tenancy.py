@@ -1,0 +1,711 @@
+"""Multi-tenant serving tests (ISSUE 13): weighted-fair admission,
+per-class conservation, model multiplexing with LRU jit residency,
+tenant-aware rebind, and the SLO-scaling controller.
+
+The accounting contract these pin down: the two admission conservation
+invariants hold EXACTLY per tenant class and summed across classes —
+including under a noisy-neighbor flood, where the overage is shed from
+the flooding class only (cause ``tenant_over_share``) and the victim's
+goodput/p99 stay where a solo run put them. Multiplexing is correctness
+-first: LRU eviction of a model's compiled entries is a counted
+recompile on its next request, never a wrong answer.
+"""
+
+import itertools
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.edge import protocol as P
+from nnstreamer_tpu.edge.query import QueryServer
+from nnstreamer_tpu.edge.wire import decode_buffer, encode_buffer
+from nnstreamer_tpu.serving.pool import PooledQueryServer, proc_alive
+from nnstreamer_tpu.serving.tenancy import (
+    CLASS_META, INVALID_CLASS, TENANT_META, ModelResidency,
+    ScalingController, TenantClass, TenantTable, validate_tenant_name)
+from nnstreamer_tpu.serving.worker import WorkerSpec
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.traffic.admission import (
+    DEADLINE_META, AdmissionQueue)
+from nnstreamer_tpu.traffic.loadgen import (
+    _tenant_conservation_ok, noisy_neighbor_drill)
+
+pytestmark = pytest.mark.tenant
+
+_sid = itertools.count(7700)
+
+
+@pytest.fixture(autouse=True)
+def _clean_servers():
+    yield
+    QueryServer.reset_all()
+
+
+def _buf(i, tenant=None):
+    b = TensorBuffer.of(np.ones((8, 1), np.float32), pts=i)
+    if tenant is not None:
+        b = b.with_meta(**{TENANT_META: tenant})
+    return b
+
+
+def _table(**weights) -> TenantTable:
+    return TenantTable([TenantClass(n, weight=w)
+                        for n, w in weights.items()])
+
+
+# -- tenant names / table -----------------------------------------------------
+
+class TestTenantNames:
+    def test_valid_charset(self):
+        for name in ("a", "A-b_9", "x" * 64, "team-a", "0"):
+            assert validate_tenant_name(name)
+
+    def test_invalid_refused(self):
+        for name in ("", "x" * 65, "a b", "a/b", "tenant!", "Ω", None,
+                     42, "a\n"):
+            assert not validate_tenant_name(name)
+
+    def test_tenant_class_validates_eagerly(self):
+        with pytest.raises(ValueError):
+            TenantClass("bad name")
+        with pytest.raises(ValueError):
+            TenantClass("a", weight=0.0)
+        with pytest.raises(ValueError):
+            TenantClass("a", weight=float("nan"))
+        with pytest.raises(ValueError):
+            TenantClass("a", deadline_ms=0.0)
+        with pytest.raises(ValueError):
+            TenantClass("a", max_pending=0)
+
+
+class TestTenantTable:
+    def test_from_dict_and_routing(self):
+        t = TenantTable.from_dict({
+            "default": "team-a",
+            "tenants": [
+                {"name": "team-a", "weight": 2.0, "model": "m1"},
+                {"name": "team-b", "model": "m2"},
+                {"name": "team-c", "model": "m1"},
+            ]})
+        assert t.class_of("team-b").name == "team-b"
+        # undeclared and missing tenants fall to the default class
+        assert t.class_of("stranger").name == "team-a"
+        assert t.class_of(None).name == "team-a"
+        assert t.model_of("team-b") == "m2"
+        assert t.model_of(None) == "m1"
+        # distinct bound models, declaration order
+        assert t.models() == ["m1", "m2"]
+        # to_dict round-trips
+        t2 = TenantTable.from_dict(t.to_dict())
+        assert sorted(t2.names()) == sorted(t.names())
+
+    def test_duplicate_tenant_rejected(self):
+        with pytest.raises(ValueError):
+            TenantTable([TenantClass("a"), TenantClass("a")])
+
+
+# -- weighted-fair admission --------------------------------------------------
+
+class TestWFQAdmission:
+    def _queue(self, table, **kw):
+        kw.setdefault("max_pending", 64)
+        q = AdmissionQueue(**kw)
+        q.set_tenants(table)
+        return q
+
+    def test_dequeue_follows_weights(self):
+        q = self._queue(_table(a=3.0, b=1.0))
+        for i in range(12):
+            assert q.offer(_buf(i, "a")).admitted
+        for i in range(12, 18):
+            assert q.offer(_buf(i, "b")).admitted
+        order = []
+        for _ in range(12):
+            item = q.get(timeout=1.0)
+            order.append(item.meta[CLASS_META])
+            q.note_replied(cls=item.meta[CLASS_META])
+        # SFQ: over any backlogged prefix the service ratio tracks the
+        # 3:1 weights (within one quantum)
+        for k in (4, 8, 12):
+            served_a = order[:k].count("a")
+            assert abs(served_a - 3 * k / 4) <= 1, order
+        assert _tenant_conservation_ok(q.counters())
+
+    def test_class_stamped_and_replied_lands_on_class(self):
+        q = self._queue(_table(a=1.0))
+        assert q.offer(_buf(0, "a")).admitted
+        item = q.get(timeout=1.0)
+        assert item.meta[CLASS_META] == "a"
+        c = q.counters()["classes"]["a"]
+        assert c["inflight"] == 1 and c["depth"] == 0
+        q.note_replied(cls="a")
+        c = q.counters()["classes"]["a"]
+        assert c["replied"] == 1 and c["inflight"] == 0
+        assert _tenant_conservation_ok(q.counters())
+
+    def test_bad_tenant_refused_and_charged_to_invalid_class(self):
+        q = self._queue(_table(a=1.0))
+        d = q.offer(_buf(0, "not a name!"))
+        assert not d.admitted and d.cause == "bad_tenant"
+        c = q.counters()
+        inv = c["classes"][INVALID_CLASS]
+        assert inv["rejected"] == {"bad_tenant": 1}
+        assert inv["offered"] == 1 and inv["admitted"] == 0
+        assert c["rejected"] == {"bad_tenant": 1}
+        assert _tenant_conservation_ok(c)
+
+    def test_undeclared_tenant_uses_default_class(self):
+        q = self._queue(_table(a=1.0))
+        assert q.offer(_buf(0, "stranger")).admitted
+        assert q.counters()["classes"]["default"]["admitted"] == 1
+
+    def test_over_share_sheds_own_class_only(self):
+        # fair share with a=1, b=1 (+ implicit default) over
+        # max_pending=6 is ceil(6/3)=2 per class
+        q = self._queue(_table(a=1.0, b=1.0), max_pending=6,
+                        shed_policy="reject-oldest")
+        assert q.offer(_buf(0, "a")).admitted
+        assert q.offer(_buf(1, "a")).admitted
+        d = q.offer(_buf(2, "a"))     # over a's share: displace a's oldest
+        assert d.admitted
+        assert [v.pts for v in d.victims] == [0]
+        assert d.victim_cause == "tenant_over_share"
+        c = q.counters()
+        assert c["classes"]["a"]["shed"] == {"tenant_over_share": 1}
+        assert c["classes"]["a"]["depth"] == 2
+        # b is untouched and still has its full share
+        assert q.offer(_buf(3, "b")).admitted
+        assert c["classes"]["b"]["shed"] == {}
+        assert _tenant_conservation_ok(q.counters())
+
+    def test_over_share_refused_under_reject_newest(self):
+        q = self._queue(_table(a=1.0, b=1.0), max_pending=6,
+                        shed_policy="reject-newest")
+        assert q.offer(_buf(0, "a")).admitted
+        assert q.offer(_buf(1, "a")).admitted
+        d = q.offer(_buf(2, "a"))
+        assert not d.admitted and d.cause == "tenant_over_share"
+        c = q.counters()
+        assert c["classes"]["a"]["rejected"] == {"tenant_over_share": 1}
+        assert _tenant_conservation_ok(c)
+
+    def test_global_full_never_displaces_another_class(self):
+        # explicit per-class bounds above the global bound: the global
+        # limit is what refuses, and it must NOT shed a's entries to
+        # make room for b
+        t = TenantTable([TenantClass("a", max_pending=5),
+                         TenantClass("b", max_pending=5)])
+        q = self._queue(t, max_pending=2, shed_policy="reject-oldest")
+        assert q.offer(_buf(0, "a")).admitted
+        assert q.offer(_buf(1, "a")).admitted
+        d = q.offer(_buf(2, "b"))
+        assert not d.admitted and d.cause == "queue_full"
+        assert not d.victims
+        c = q.counters()
+        assert c["classes"]["a"]["depth"] == 2
+        assert c["classes"]["a"]["shed"] == {}
+        assert c["classes"]["b"]["rejected"] == {"queue_full": 1}
+        assert _tenant_conservation_ok(c)
+
+    def test_class_deadline_default_applies(self):
+        t = TenantTable([TenantClass("a", deadline_ms=1.0)])
+        q = self._queue(t, shed_policy="deadline-drop")
+        assert q.offer(_buf(0, "a")).admitted
+        time.sleep(0.01)
+        d = q.offer(_buf(1, "a"))     # purge on next offer
+        assert d.admitted
+        assert [v.pts for v in d.victims] == [0]
+        assert d.victim_cause == "deadline"
+        c = q.counters()
+        assert c["classes"]["a"]["shed"] == {"deadline": 1}
+        assert _tenant_conservation_ok(c)
+
+    def test_sentinel_bypasses_admission(self):
+        q = self._queue(_table(a=1.0))
+        q.put_nowait(None)
+        assert q.offer(_buf(0, "a")).admitted
+        assert q.get(timeout=1.0) is None       # sentinel first
+        assert q.get(timeout=1.0).pts == 0
+        c = q.counters()
+        assert c["offered"] == 1 and c["admitted"] == 1
+
+
+# -- configure() mid-stream policy change (regression) ------------------------
+
+class TestConfigurePolicyChange:
+    def test_switch_to_deadline_drop_purges_expired_legacy(self):
+        q = AdmissionQueue(max_pending=8, shed_policy="reject-newest")
+        for i in range(3):
+            b = _buf(i).with_meta(**{DEADLINE_META: 1.0})
+            assert q.offer(b).admitted
+        assert q.offer(_buf(3)).admitted        # no budget: never purged
+        time.sleep(0.01)
+        victims = q.configure(shed_policy="deadline-drop")
+        assert sorted(v.pts for v in victims) == [0, 1, 2]
+        c = q.counters()
+        assert c["shed"] == {"deadline": 3}
+        assert c["depth"] == 1
+        assert c["offered"] == c["admitted"] + sum(c["rejected"].values())
+        assert c["admitted"] == c["replied"] + sum(c["shed"].values()) \
+            + c["depth"] + c["inflight"]
+
+    def test_same_policy_reconfigure_is_noop(self):
+        q = AdmissionQueue(max_pending=8, shed_policy="deadline-drop")
+        b = _buf(0).with_meta(**{DEADLINE_META: 1.0})
+        assert q.offer(b).admitted
+        time.sleep(0.01)
+        # same policy: no snapshot re-evaluation, no victims — expiry
+        # still lands on the next offer() as usual
+        assert q.configure(shed_policy="deadline-drop") == []
+        assert q.configure(max_pending=16) == []
+        assert q.counters()["depth"] == 1
+
+    def test_switch_purges_tenant_classes_too(self):
+        t = TenantTable([TenantClass("a", deadline_ms=1.0),
+                         TenantClass("b")])
+        q = AdmissionQueue(max_pending=16, shed_policy="reject-newest")
+        q.set_tenants(t)
+        assert q.offer(_buf(0, "a")).admitted
+        assert q.offer(_buf(1, "b")).admitted   # no deadline: survives
+        time.sleep(0.01)
+        victims = q.configure(shed_policy="deadline-drop")
+        assert [v.pts for v in victims] == [0]
+        assert victims[0].meta[CLASS_META] == "a"
+        c = q.counters()
+        assert c["classes"]["a"]["shed"] == {"deadline": 1}
+        assert c["classes"]["b"]["depth"] == 1
+        assert _tenant_conservation_ok(c)
+
+
+# -- model residency (LRU) ----------------------------------------------------
+
+class _FakeBackend:
+    """Stands in for XLABackend's residency hooks; release frees its
+    bytes too, modelling a backend whose eviction relieves pressure."""
+
+    def __init__(self, nbytes=100):
+        self.entries = 0
+        self.nbytes = nbytes
+        self._full_bytes = nbytes
+        self.released = 0
+
+    def compile(self, n=2):
+        self.entries = n
+        self.nbytes = self._full_bytes
+
+    def jit_cache_size(self):
+        return self.entries
+
+    def resident_bytes(self):
+        return self.nbytes
+
+    def release_compiled(self):
+        n, self.entries = self.entries, 0
+        self.nbytes = 0
+        self.released += 1
+        return n
+
+
+class TestModelResidency:
+    def test_lru_evicts_coldest_not_current(self):
+        r = ModelResidency(max_models=2)
+        backends = {}
+        for name in ("a", "b", "c"):
+            backends[name] = _FakeBackend()
+            r.register(name, backends[name])
+        backends["a"].compile()
+        r.touch("a")
+        backends["b"].compile()
+        r.touch("b")
+        backends["c"].compile()
+        evicted = r.touch("c")        # 3 live > 2: coldest (a) goes
+        assert evicted == ["a"]
+        assert backends["a"].entries == 0 and backends["a"].released == 1
+        assert backends["b"].entries > 0 and backends["c"].entries > 0
+        st = r.stats()
+        assert st["jit_evictions"] == 1 and st["entries_evicted"] == 2
+        # "recompile" a: now b is coldest
+        backends["a"].compile()
+        assert r.touch("a") == ["b"]
+        assert r.stats()["jit_evictions"] == 2
+
+    def test_current_model_never_evicted(self):
+        r = ModelResidency(max_models=1)
+        a, b = _FakeBackend(), _FakeBackend()
+        r.register("a", a)
+        r.register("b", b)
+        a.compile()
+        b.compile()
+        assert r.touch("b") == ["a"]
+        # even at bound 1, the model being served survives
+        assert b.entries > 0
+
+    def test_bytes_bound(self):
+        r = ModelResidency(max_bytes=250)
+        a, b, c = (_FakeBackend(nbytes=100) for _ in range(3))
+        for name, be in (("a", a), ("b", b), ("c", c)):
+            r.register(name, be)
+            be.compile()
+        assert r.touch("c") == ["a"]  # 300 bytes > 250: shed coldest
+
+    def test_unbounded_never_evicts(self):
+        r = ModelResidency()
+        bs = [_FakeBackend() for _ in range(5)]
+        for i, be in enumerate(bs):
+            r.register(f"m{i}", be)
+            be.compile()
+            assert r.touch(f"m{i}") == []
+        assert r.stats()["jit_evictions"] == 0
+
+
+# -- in-process multiplex service: routing + evict->recompile -----------------
+
+_MUX_TENANTS = {
+    "default": "team-a",
+    "tenants": [
+        {"name": "team-a", "weight": 2.0, "model": "probe_scale"},
+        {"name": "team-b", "model": "probe_negate"},
+        {"name": "team-c", "model": "probe_offset"},
+    ]}
+
+_X = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+#: tenant -> expected output for input _X (probe model arithmetic)
+_EXPECT = {
+    "team-a": _X * 2.0,
+    "team-b": -_X,
+    "team-c": _X + 10.0,
+}
+
+
+def _mux_service(**spec_kw):
+    from nnstreamer_tpu.serving.worker import _MultiplexService
+
+    spec = WorkerSpec(kind="multiplex", dims="8:1", types="float32",
+                      tenants=_MUX_TENANTS, **spec_kw)
+    return _MultiplexService(spec)
+
+
+def _serve_one(svc, i, tenant):
+    out = []
+    buf = _buf(i, tenant).with_tensors((_X,), pts=i)
+    svc.serve(i, encode_buffer(buf), lambda msg: out.append(msg))
+    tag, rid, payload = out[0]
+    assert tag == "res" and rid == i
+    res, _ = decode_buffer(payload)
+    return res
+
+
+class TestMultiplexService:
+    def test_routes_by_tenant_known_answers(self):
+        svc = _mux_service()
+        try:
+            for i, (tenant, want) in enumerate(_EXPECT.items()):
+                res = _serve_one(svc, i, tenant)
+                np.testing.assert_allclose(res.tensors[0], want)
+            # unknown/missing tenant falls to the default class model
+            res = _serve_one(svc, 10, None)
+            np.testing.assert_allclose(res.tensors[0], _EXPECT["team-a"])
+            assert svc.residency_stats()["jit_evictions"] == 0
+        finally:
+            svc.close()
+
+    def test_eviction_is_counted_recompile_never_wrong(self):
+        svc = _mux_service(resident_models=1)
+        try:
+            i = 0
+            for _round in range(2):   # second round re-serves evicted
+                for tenant, want in _EXPECT.items():
+                    res = _serve_one(svc, i, tenant)
+                    np.testing.assert_allclose(res.tensors[0], want)
+                    i += 1
+            st = svc.residency_stats()
+            # each model switch past the bound evicted the previous one
+            assert st["jit_evictions"] >= 3
+            assert st["invokes_by_model"] == {
+                "probe_scale": 2, "probe_negate": 2, "probe_offset": 2}
+        finally:
+            svc.close()
+
+
+# -- multiplex pool e2e: wire round trip, hot swap, rebind --------------------
+
+class _Client:
+    """Minimal query-wire client that keeps decoded RESULT buffers
+    (loadgen discards payloads; known-answer tests need them)."""
+
+    def __init__(self, port, dims="8:1", types="float32"):
+        self.results = {}
+        self.busy = {}
+        self._evt = threading.Event()
+        self._hello = threading.Event()
+        self._want = 0
+        self._lock = threading.Lock()
+        self.c = P.MsgClient("127.0.0.1", port, on_message=self._on)
+        self.c.send(P.T_HELLO,
+                    json.dumps({"dims": dims, "types": types}).encode())
+        assert self._hello.wait(10)
+
+    def _on(self, mtype, payload):
+        if mtype in (P.T_HELLO_ACK, P.T_HELLO_NAK):
+            self._hello.set()
+            return
+        with self._lock:
+            if mtype == P.T_RESULT:
+                buf, _ = decode_buffer(payload)
+                self.results[int(buf.pts)] = buf
+            elif mtype == P.T_BUSY:
+                info = json.loads(payload.decode())
+                if info.get("pts") is not None:
+                    self.busy[int(info["pts"])] = info
+            if len(self.results) + len(self.busy) >= self._want:
+                self._evt.set()
+
+    def ask(self, frames):
+        with self._lock:
+            self._want = len(self.results) + len(self.busy) + len(frames)
+            self._evt.clear()
+        for b in frames:
+            self.c.send(P.T_DATA, encode_buffer(b))
+        assert self._evt.wait(30), "pool did not answer in time"
+
+    def close(self):
+        self.c.close()
+
+
+def _mux_pool(workers=2, **kw):
+    table = TenantTable.from_dict(_MUX_TENANTS)
+    spec = WorkerSpec(kind="multiplex", dims="8:1", types="float32",
+                      tenants=table.to_dict(), **kw)
+    return PooledQueryServer(spec, workers=workers, sid=next(_sid),
+                             tenants=table)
+
+
+def _tenant_frame(i, tenant):
+    return _buf(i, tenant).with_tensors((_X,), pts=i)
+
+
+class TestMultiplexPool:
+    def test_one_pool_serves_three_models_routed_by_tenant(self):
+        pqs = _mux_pool()
+        try:
+            cli = _Client(pqs.port)
+            try:
+                frames, want = [], {}
+                i = 0
+                for _ in range(3):
+                    for tenant, exp in _EXPECT.items():
+                        frames.append(_tenant_frame(i, tenant))
+                        want[i] = exp
+                        i += 1
+                cli.ask(frames)
+                assert not cli.busy
+                for pts, exp in want.items():
+                    np.testing.assert_allclose(
+                        cli.results[pts].tensors[0], exp)
+                c = pqs.admission_counters()
+                assert c["classes"]["team-a"]["replied"] == 3
+                assert c["classes"]["team-b"]["replied"] == 3
+                assert c["classes"]["team-c"]["replied"] == 3
+                assert _tenant_conservation_ok(c)
+            finally:
+                cli.close()
+        finally:
+            pids = pqs.pool.all_pids_ever()
+            pqs.close()
+        assert pids and not any(proc_alive(p) for p in pids)
+
+    def test_hot_swap_one_model_leaves_others_unperturbed(self):
+        # preload recipe: each spawned child can lazily build
+        # probe_scale@1 (scale=3) from the zoo on swap commit
+        pqs = _mux_pool(
+            preload=(("probe_scale", 1, "zoo://probe_scale?scale=3.0"),))
+        try:
+            cli = _Client(pqs.port)
+            try:
+                cli.ask([_tenant_frame(0, "team-a")])
+                np.testing.assert_allclose(
+                    cli.results[0].tensors[0], _X * 2.0)
+                rep = pqs.swap("probe_scale", 1)
+                assert rep["ok"], rep
+                assert pqs.pool.epoch == 1      # all-or-none bump
+                assert all(w["prepare_ok"] and w["commit_ok"]
+                           for w in rep["workers"].values())
+                cli.ask([_tenant_frame(i, t) for i, t in
+                         ((1, "team-a"), (2, "team-b"), (3, "team-c"))])
+                # swapped tenant sees @1; the other tenants' models are
+                # untouched by the store epoch flip
+                np.testing.assert_allclose(
+                    cli.results[1].tensors[0], _X * 3.0)
+                np.testing.assert_allclose(
+                    cli.results[2].tensors[0], -_X)
+                np.testing.assert_allclose(
+                    cli.results[3].tensors[0], _X + 10.0)
+            finally:
+                cli.close()
+        finally:
+            pqs.close()
+
+    def test_swap_unknown_version_aborts_all(self):
+        pqs = _mux_pool()
+        try:
+            rep = pqs.swap("probe_scale", 7)    # no such version
+            assert not rep["ok"]
+            assert pqs.pool.epoch == 0          # epoch did not move
+            cli = _Client(pqs.port)
+            try:
+                cli.ask([_tenant_frame(0, "team-b")])
+                np.testing.assert_allclose(
+                    cli.results[0].tensors[0], -_X)
+            finally:
+                cli.close()
+        finally:
+            pqs.close()
+
+    def test_rebind_two_phase_epoch_and_bindings(self):
+        pqs = _mux_pool()
+        try:
+            rep = pqs.rebind({0: "probe_scale", 1: "probe_negate"})
+            assert rep["ok"], rep
+            assert pqs.pool.epoch == 1
+            assert pqs.pool.bindings() == {0: "probe_scale",
+                                           1: "probe_negate"}
+            # unknown model: every worker aborts, nothing changes
+            rep = pqs.rebind({0: "nope"})
+            assert not rep["ok"]
+            assert pqs.pool.epoch == 1
+            assert pqs.pool.bindings() == {0: "probe_scale",
+                                           1: "probe_negate"}
+            # bound workers are preferred for their model's tenants,
+            # and the pool still answers everyone correctly
+            cli = _Client(pqs.port)
+            try:
+                cli.ask([_tenant_frame(i, t) for i, t in
+                         ((0, "team-a"), (1, "team-b"), (2, "team-c"))])
+                np.testing.assert_allclose(
+                    cli.results[0].tensors[0], _X * 2.0)
+                np.testing.assert_allclose(
+                    cli.results[1].tensors[0], -_X)
+            finally:
+                cli.close()
+        finally:
+            pqs.close()
+
+
+# -- scaling controller -------------------------------------------------------
+
+class _StubPool:
+    def __init__(self, n=4):
+        self.n = n
+        self._b = {i: None for i in range(n)}
+        self.calls = []
+
+    @property
+    def size(self):
+        return self.n
+
+    def bindings(self):
+        return dict(self._b)
+
+    def rebind(self, mapping, **kw):
+        self.calls.append(dict(mapping))
+        self._b.update(mapping)
+        return {"ok": True}
+
+
+class _StubTracer:
+    def __init__(self, rates):
+        self.rates = rates
+
+    def tenant_summary(self):
+        return {t: {"count": 10, "rate_hz": r, "p50_ms": 1.0,
+                    "p99_ms": 2.0}
+                for t, r in self.rates.items()}
+
+
+class TestScalingController:
+    def _ctrl(self, rates, n=4):
+        table = TenantTable.from_dict({"tenants": [
+            {"name": "a", "model": "m1"},
+            {"name": "b", "model": "m2"}]})
+        pool = _StubPool(n)
+        ctrl = ScalingController(pool, table,
+                                 _StubTracer(rates), interval_s=999.0)
+        return ctrl, pool
+
+    def _counts(self, pool):
+        counts = {}
+        for m in pool.bindings().values():
+            counts[m] = counts.get(m, 0) + 1
+        return counts
+
+    def test_tick_allocates_slots_by_traffic(self):
+        # m1 carries 3x m2's rate; 4 slots, floor 1 each -> 3:1
+        ctrl, pool = self._ctrl({"a": 30.0, "b": 10.0})
+        assert ctrl.tick()
+        assert self._counts(pool) == {"m1": 3, "m2": 1}
+        st = ctrl.stats()
+        assert st["decisions"] == 1 and st["rebinds"] == 1
+
+    def test_steady_state_does_not_rebind(self):
+        ctrl, pool = self._ctrl({"a": 30.0, "b": 10.0})
+        assert ctrl.tick()
+        calls = len(pool.calls)
+        ctrl.tick()                    # same rates: plan == current
+        assert len(pool.calls) == calls
+        assert ctrl.stats()["rebinds"] == 1
+
+    def test_traffic_shift_rebinds(self):
+        ctrl, pool = self._ctrl({"a": 30.0, "b": 10.0})
+        ctrl.tick()
+        ctrl.tracer = _StubTracer({"a": 5.0, "b": 50.0})
+        assert ctrl.tick()
+        assert self._counts(pool) == {"m1": 1, "m2": 3}
+
+    def test_no_demand_no_decision(self):
+        ctrl, pool = self._ctrl({})
+        assert not ctrl.tick()
+        assert not pool.calls
+
+    def test_start_stop_thread(self):
+        ctrl, _ = self._ctrl({"a": 1.0})
+        ctrl.start()
+        t = ctrl._thread
+        try:
+            assert t is not None and t.daemon
+        finally:
+            ctrl.stop()
+        assert not t.is_alive()
+
+
+# -- noisy-neighbor acceptance drill ------------------------------------------
+
+class TestNoisyNeighbor:
+    def test_victim_isolated_from_flooding_tenant(self):
+        out = noisy_neighbor_drill(
+            victim_x=0.5, flood_x=3.0, n_victim=80,
+            workers=2, service_ms=8.0, max_pending=24, seed=3)
+        cont = out["contested"]
+        v = cont["groups"]["victim"]
+        f = cont["groups"]["flood"]
+        # nothing lost anywhere, invariants exact per class and summed
+        assert out["zero_lost"]
+        assert out["conserved"]
+        # victim keeps its service: everything completes, p99 within
+        # its deadline budget, goodput >= 0.9x its solo run
+        assert v["rejected"] == 0 and v["lost"] == 0
+        assert v["completed"] == v["offered"]
+        assert out["victim_p99_ms"] <= out["victim_p99_budget_ms"]
+        assert out["victim_goodput_ratio"] >= 0.9, out
+        # the overage is shed from the flooder, typed tenant_over_share
+        assert f["rejected"] > 0
+        assert set(f["busy_causes"]) == {"tenant_over_share"}
+        cc = cont["admission"]["classes"]
+        shed_f = cc["flood"]["shed"].get("tenant_over_share", 0)
+        rej_f = cc["flood"]["rejected"].get("tenant_over_share", 0)
+        assert shed_f + rej_f == f["rejected"]
+        # the victim class was never shed or refused
+        assert cc["victim"]["shed"] == {} and cc["victim"]["rejected"] == {}
