@@ -1935,6 +1935,156 @@ def multitenant_serve() -> dict:
     return out
 
 
+def multichip_serve() -> dict:
+    """Multi-chip placement family (serving/placement.py), on the
+    8-device emulated host mesh (_family_main forces JAX_PLATFORMS=cpu
+    + --xla_force_host_platform_device_count=8 for this family BEFORE
+    jax loads — real-chip numbers belong to a future multi-TPU rig).
+
+    Two placements measured: (a) data-parallel replicas at 1/2/4/8
+    devices — throughput ratio vs the 1-device baseline plus exact
+    conservation and bit-parity checks; (b) a profiled segmented
+    3-filter pipeline vs the same pipeline unsegmented — throughput
+    ratio, planned bubble fraction, and output parity (the MULTICHIP
+    dryrun tolerance, max_abs_err <= 1e-6). Host-emulated devices are
+    threads on one CPU, so the scaling ratios measure dispatch-path
+    overheads, not chip speedup; the correctness checks are exact
+    either way. BENCH_MULTICHIP_GATE=1 gates on parity+conservation
+    (never on the emulated ratios)."""
+    import numpy as np
+
+    from nnstreamer_tpu import PipelineRunner, TensorBuffer, parse_launch
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.serving.placement import (
+        ReplicaSet, plan_from_tracer, visible_devices)
+    from nnstreamer_tpu.serving.store import reset_store
+
+    ndev = len(visible_devices())
+    out: dict = {"visible_devices": ndev}
+    rng = np.random.default_rng(7)
+    dim, batch, frames = 192, 8, 160
+    w1 = rng.normal(size=(dim, dim)).astype(np.float32) / np.sqrt(dim)
+    w2 = rng.normal(size=(dim, dim)).astype(np.float32) / np.sqrt(dim)
+
+    def _mlp(params, x):
+        import jax.numpy as jnp
+
+        h = jnp.maximum(x @ params["w1"], 0.0)
+        return (h @ params["w2"],)
+
+    bundle = ModelBundle(fn=_mlp, params={"w1": w1, "w2": w2},
+                         name="mc_mlp")
+    x = rng.normal(size=(batch, dim)).astype(np.float32)
+
+    # (a) dp replicas: scaling efficiency + exact parity/conservation
+    dp: dict = {}
+    base_fps = None
+    base_out = None
+    parity_exact = True
+    conserved = True
+    for n in [d for d in (1, 2, 4, 8) if d <= ndev]:
+        rs = ReplicaSet.open("xla", {"model": bundle, "custom": ""}, n,
+                             queue_cap=frames + n, name=f"bench-dp{n}")
+        try:
+            for _ in range(n):          # warm every replica's jit
+                rs.invoke((x,))
+            t0 = time.perf_counter()
+            futs = [rs.submit((x,)) for _ in range(frames)]
+            outs = [f.result(60.0) for f in futs]
+            dt = time.perf_counter() - t0
+            st = rs.stats()
+        finally:
+            rs.close()
+        fps = frames / dt if dt > 0 else 0.0
+        if base_out is None:
+            base_out = np.asarray(outs[0][0])
+        parity_exact &= all(
+            np.array_equal(np.asarray(o[0]), base_out) for o in outs)
+        conserved &= (sum(r["invokes"] for r in st["replicas"])
+                      == frames + n)
+        if base_fps is None:
+            base_fps = fps
+        dp[f"devices_{n}"] = {
+            "fps": round(fps, 1),
+            "scaling_ratio": round(fps / base_fps, 3) if base_fps else 0.0,
+            "per_chip_invokes": [r["invokes"] for r in st["replicas"]],
+        }
+        out["dp"] = dict(dp, parity_exact=parity_exact,
+                         conserved=conserved)
+        _family_partial(dict(out))
+
+    # (b) profiled segmentation: plan from a traced run, then compare
+    store = reset_store()
+    store.register("mc_s1", lambda x: (x @ w1,))
+    store.register("mc_s2", lambda x: (np.float32(1.0) * x,))  # light
+    store.register("mc_s3", lambda x: (x @ w2,))
+
+    xv = x[0].copy()                    # (dim,) vector frames
+
+    def _seg_pipe():
+        return parse_launch(
+            f"appsrc name=src dims={dim} types=float32 ! "
+            "tensor_filter name=s1 model=store://mc_s1 ! "
+            "tensor_filter name=s2 model=store://mc_s2 ! "
+            "tensor_filter name=s3 model=store://mc_s3 ! "
+            "tensor_sink name=out")
+
+    def _run(pipe, trace, segments=True):
+        # the profile pass keeps every filter separate (segments=False)
+        # so the tracer sees per-element proctime, not one fused row
+        runner = PipelineRunner(pipe, trace=trace,
+                                device_segments=segments)
+        runner.start()
+        src, sink = pipe.get("src"), pipe.get("out")
+        t0 = time.perf_counter()
+        try:
+            for i in range(frames):
+                src.push(TensorBuffer.of(xv + np.float32(i), pts=i))
+            src.end()
+            runner.wait(120)
+        finally:
+            runner.stop()
+        dt = time.perf_counter() - t0
+        res = {int(b.pts): np.asarray(b.tensors[0])
+               for b in sink.results}
+        return res, frames / dt if dt > 0 else 0.0, runner
+
+    base_res, base_seg_fps, runner = _run(_seg_pipe(), trace=True,
+                                          segments=False)
+    names = [n for n in ("s1", "s2", "s3")]
+    plan = plan_from_tracer(runner.tracer, names, min(ndev, 4))
+    pipe = _seg_pipe()
+    from nnstreamer_tpu.serving.placement import apply_plan
+
+    apply_plan(pipe, plan)
+    seg_res, seg_fps, _ = _run(pipe, trace=False)
+    err = 0.0
+    for pts, ref in base_res.items():
+        got = seg_res.get(pts)
+        if got is None:
+            err = float("inf")
+            break
+        err = max(err, float(np.max(np.abs(got - ref))))
+    out["segmented"] = {
+        "stages": plan.report()["stages"],
+        "bubble_fraction": round(plan.bubble_fraction, 4),
+        "unsegmented_fps": round(base_seg_fps, 1),
+        "segmented_fps": round(seg_fps, 1),
+        "throughput_ratio": round(seg_fps / base_seg_fps, 3)
+        if base_seg_fps else 0.0,
+        "max_abs_err": err,
+        "frames": frames,
+    }
+    _family_partial(dict(out))
+
+    if os.environ.get("BENCH_MULTICHIP_GATE") == "1":
+        out["multichip_gate_ok"] = bool(
+            parity_exact and conserved and err <= 1e-6)
+        if not out["multichip_gate_ok"]:
+            out["unverified"] = True   # ship the numbers, flag the claim
+    return out
+
+
 #: pipeline configs, each its own subprocess family as well — host-path
 #: configs do per-frame D2H, and running them after anything else in
 #: one process measured 2x drift (label 157 -> 76 FPS across trials)
@@ -1964,6 +2114,7 @@ _FAMILIES = {
     "llm_serve": lambda: llm_serve(),
     "traffic": lambda: traffic_serve(),
     "multitenant": lambda: multitenant_serve(),
+    "multichip": lambda: multichip_serve(),
 }
 for _d in OFFLOAD_DELAYS:
     _FAMILIES[f"offload_{_d}"] = (
@@ -2089,7 +2240,21 @@ def _enable_compile_cache() -> None:
 
 
 def _family_main(name: str) -> int:
+    if name == "multichip":
+        # This family measures placement, not the chip: force the
+        # 8-device emulated host mesh (same technique as tests/
+        # conftest.py) BEFORE _enable_compile_cache imports jax.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     _enable_compile_cache()
+    if name == "multichip":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     try:
         result = _FAMILIES[name]()
         print(_FAMILY_SENTINEL + json.dumps({"result": result}),
@@ -2129,7 +2294,7 @@ def _ordered_families() -> list:
         return list(_FAMILIES)
     return (["cfg_label_device", "pallas", "transformer_prefill",
              "mxu_peak", "batch_sweep", "dyn_batch", "host_path",
-             "llm_serve", "traffic", "multitenant"]
+             "llm_serve", "traffic", "multitenant", "multichip"]
             + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
             + [f"offload_{d}" for d in OFFLOAD_DELAYS]
             + ["int8_native", "model_swap", "chaos_smoke"])

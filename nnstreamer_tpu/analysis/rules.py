@@ -16,6 +16,10 @@ NNL007 thread-audit       every thread is daemon or joined/cancelled on
 NNL008 socket-audit       every socket in the serving path has a
                           deadline (timeout kwarg / settimeout) or is
                           owned by a reader/accept thread
+NNL009 placement-audit    explicit device picks (jax.devices()[i])
+                          only inside serving/placement.py and
+                          parallel/ — placement decisions route
+                          through the subsystem
 
 Every rule is pure AST — nothing here imports the code under analysis.
 Heuristics err toward silence (a missed finding is a review problem; a
@@ -701,10 +705,50 @@ class SocketAudit(Rule):
         return owned
 
 
+class PlacementAudit(Rule):
+    rule_id = "NNL009"
+    title = "placement-audit"
+    rationale = (
+        "explicit device selection (`jax.devices()[i]`) scattered "
+        "through the tree is how placement bugs are born: two call "
+        "sites disagree about which chip owns a model and the result "
+        "is silent cross-device copies or a replica serving on the "
+        "wrong chip. All placement decisions route through "
+        "serving/placement.py (visible_devices/device_of/"
+        "accelerator_for) and parallel/ — everything else receives a "
+        "device, it never picks one")
+
+    #: the subsystem allowed to pick devices; everything else is flagged
+    ALLOWED = ("serving/placement.py", "parallel/")
+    DEVICE_CALLS = ("jax.devices", "jax.local_devices")
+
+    def check(self, module: Module, project: Project):
+        p = f"/{module.path}"
+        if any(f"/{a}" in p for a in self.ALLOWED):
+            return
+        for node in ast.walk(module.tree):
+            # jax.devices(...)[i] with a single (non-slice) index — a
+            # hard-coded placement decision. Slices (`[:dp]`) pass:
+            # taking "the first N devices" as a mesh axis is topology
+            # enumeration, not placing one object on one chip.
+            if not isinstance(node, ast.Subscript) \
+                    or isinstance(node.slice, ast.Slice):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call) \
+                    and dotted(v.func) in self.DEVICE_CALLS:
+                yield node, (
+                    f"explicit device pick `{dotted(v.func)}(...)[i]` "
+                    f"outside the placement subsystem: take a device "
+                    f"(or an accelerator= string) from the caller, or "
+                    f"route through serving/placement.device_of()")
+
+
 #: registry, in catalog order
 ALL_RULES: List[Rule] = [
     ElementContract(), ForcedSync(), LockDiscipline(), JitPurity(),
     SpawnSafety(), PicklableErrors(), ThreadAudit(), SocketAudit(),
+    PlacementAudit(),
 ]
 
 

@@ -155,6 +155,12 @@ class XLABackend(FilterBackend):
         # into every _dyn_jits/_batch_ok key, so a stale bucket compiled
         # against old weights can never be served by key collision
         self._gen = 0
+        # per-device cache-namespace suffix: ("dev", id) when the
+        # accelerator prop pinned an explicit device ordinal (replica /
+        # segment placement, serving/placement.py), else () — folded
+        # into _ns() so replicas of one model can never trade compiles
+        # across chips by key collision
+        self._dev_ns: tuple = ()
         # store:// serving state (serving/store.py): versions are cache-
         # namespaced by version number instead of _gen, adoption happens
         # at invoke boundaries (single worker thread per element ⇒ an
@@ -204,6 +210,10 @@ class XLABackend(FilterBackend):
         self._loader_opts = opts
         accel = props.get("accelerator") or ""
         self._device = self._pick_device(accel)
+        if accel.partition(":")[2]:
+            # explicitly-indexed placement (dev i of N): namespace every
+            # cache key by the device so no compile travels between chips
+            self._dev_ns = ("dev", int(getattr(self._device, "id", 0)))
         # input-buffer donation for bucketed jits ([runtime]
         # donate_inputs): skipped on CPU, where XLA ignores the aliasing
         # hint (host buffers) and would warn per compile
@@ -517,7 +527,7 @@ class XLABackend(FilterBackend):
             if mb._store_entry is not None:
                 ver = mb._pick_version()
                 ps.append(mb._vstates[ver].device_params)
-                sig.append(("v", ver))
+                sig.append(mb._ns(ver))
             else:
                 ps.append(mb._current_params())
                 sig.append(mb._ns())
@@ -602,11 +612,14 @@ class XLABackend(FilterBackend):
         with this, so no model change can serve a stale compile by key
         collision — ("v", version) for store models (retired by version
         sweep), ("g", generation) otherwise (cleared + bumped on
-        reload/shared adoption)."""
+        reload/shared adoption). Explicitly-placed backends (replica /
+        segment stages) append ("dev", id) so the same model compiled
+        for two chips can never collide — _adopt's sweeps read k[0][:2]
+        and keep working."""
         if self._store_entry is not None:
             return ("v", version if version is not None
-                    else self._adopted_version)
-        return ("g", self._gen)
+                    else self._adopted_version) + self._dev_ns
+        return ("g", self._gen) + self._dev_ns
 
     def _pick_version(self) -> int:
         """Adopt a flipped epoch, then route this invoke: the pinned
@@ -641,7 +654,7 @@ class XLABackend(FilterBackend):
         if staged is not None:
             for basekey, jitted in staged["jits"].items():
                 self._insert_jit(
-                    (("v", cur),) + basekey + self._seg_suffix(), jitted)
+                    (self._ns(cur),) + basekey + self._seg_suffix(), jitted)
         live = {cur}
         if self._canary is not None:
             live.add(self._canary[0])
@@ -694,7 +707,7 @@ class XLABackend(FilterBackend):
             specs = self._bucket_array_specs(basekey)
             if specs is None:
                 continue             # flexible seq/bat: recompile lazily
-            if (("v", version),) + basekey + self._seg_suffix() \
+            if (self._ns(version),) + basekey + self._seg_suffix() \
                     in self._dyn_jits:
                 continue             # already live (e.g. was the canary)
             jitted = jax.jit(self._full_fn(bundle=bundle))
@@ -738,7 +751,7 @@ class XLABackend(FilterBackend):
             (vs.device_params, getattr(self, "_post_aux", None)))
         compiled = 0
         for basekey in manifest_buckets(self._store_entry.name, ver):
-            key = (("v", ver),) + basekey + self._seg_suffix()
+            key = (self._ns(ver),) + basekey + self._seg_suffix()
             if key in self._dyn_jits:
                 continue
             specs = self._bucket_array_specs(basekey)
@@ -840,7 +853,7 @@ class XLABackend(FilterBackend):
             (vs.device_params, getattr(self, "_post_aux", None)))
         hits0 = self.cache_hits
         jitted = self._bucket_jit(
-            (("v", ver),) + basekey + self._seg_suffix(),
+            (self._ns(ver),) + basekey + self._seg_suffix(),
             make=lambda: jax.jit(self._full_fn(bundle=vs.bundle)))
         staged, _ = self._stage(arrs)
         t0 = time.perf_counter()
@@ -1104,7 +1117,7 @@ class XLABackend(FilterBackend):
         pairs = tuple(((nb,) + tuple(a.shape[1:]), str(a.dtype))
                       for a in arrs)
         basekey = ("dynb", nb) + pairs
-        verdict_key = (("v", ver),) + basekey + self._seg_suffix()
+        verdict_key = (self._ns(ver),) + basekey + self._seg_suffix()
         ok = self._batch_ok.get(verdict_key)
         if ok is None:
             try:

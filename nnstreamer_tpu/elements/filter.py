@@ -73,6 +73,15 @@ class TensorFilter(Element):
         "model": PropDef(lambda s: s, None, "model reference (backend-specific)"),
         "custom": PropDef(str, "", "opaque backend option string"),
         "accelerator": PropDef(str, "", "device selector, e.g. tpu:0"),
+        # multi-chip data-parallel serving (serving/placement.py): N
+        # per-device replicas of the model behind per-chip bounded
+        # queues with least-outstanding routing. Replica i lives on
+        # device i; bit-parity with devices=0 (each replica IS the
+        # single-device path, placed elsewhere). Declined softly (with
+        # a log + stat, single-device behavior preserved) for segment
+        # heads, explicit accelerator= pins, and shared model keys.
+        "devices": PropDef(
+            int, 0, "data-parallel replicas, one per device (0=off)"),
         "input": PropDef(str, "", "override input dims (dim string list)"),
         "inputtype": PropDef(str, "", "override input types"),
         "output": PropDef(str, "", "override output dims"),
@@ -138,6 +147,12 @@ class TensorFilter(Element):
                                         "TensorFilter", List[bool]]] = []
         self._segment_in_backend = False
         self._forced_syncs = 0                # host syncs this element forced
+        # data-parallel replica set (devices=N, serving/placement.py):
+        # when live, invokes route through it instead of self.backend
+        # (which stays the negotiation/spec source of truth and never
+        # runs a frame)
+        self.replicas = None
+        self._replica_decline = ""
 
     # -- combination parsing ----------------------------------------------
     @staticmethod
@@ -298,6 +313,13 @@ class TensorFilter(Element):
                     "models) or insert `tensor_resize size=H:W` to make the "
                     "stream static")
             self._flexible = True
+            if int(self.props["devices"] or 0) > 0:
+                self._replica_decline = (
+                    "FLEXIBLE stream (per-buffer shapes route through one "
+                    "backend's bucket cache)")
+                log.warning("tensor_filter %s: devices=%d declined: %s",
+                            self.name, self.props["devices"],
+                            self._replica_decline)
             # per-region output shapes are only known per buffer
             model_out = self.backend.get_model_info()[1]
             out_tensors = model_out.tensors if model_out is not None else ()
@@ -327,7 +349,8 @@ class TensorFilter(Element):
                 + ". Fix the upstream pipeline (converter/transform dims) or "
                   "override with input=/inputtype= properties"
             )
-        if model_out is None:
+        need_set_input = model_out is None
+        if need_set_input:
             try:
                 model_out = self.backend.set_input_info(model_sees)
             except BackendError as e:
@@ -359,6 +382,10 @@ class TensorFilter(Element):
             if self._fused_in_backend:
                 self._fused_in_backend = self.backend.fuse(
                     self._pre, self._post)
+        # devices=N: replicate the fully-negotiated backend config on N
+        # explicitly-placed sibling backends (AFTER decoder re-fuse, so
+        # every replica serves the final fused program)
+        self._maybe_setup_replicas(fw, need_set_input, model_sees)
         out = model_out.with_rate(spec.rate)
         if self._out_combination is not None:
             infos = []
@@ -375,6 +402,58 @@ class TensorFilter(Element):
         if self._dyn_batched:
             out = replace(out, dyn_batch=self._dyn_batched)
         return [out]
+
+    def _maybe_setup_replicas(self, fw: str, need_set_input: bool,
+                              model_sees) -> None:
+        """Stand up the devices=N replica set (serving/placement.py).
+
+        Config parity is by replay: each replica backend gets the same
+        open props (plus its device pin), the same fused pre/post, and
+        the same set_input_info call the head backend got — so every
+        chip serves the head's exact program. Unsupported combinations
+        decline SOFTLY (log + `replica_decline` stat, single-device
+        behavior preserved): replication must never change what a
+        pipeline computes, only where."""
+        n = int(self.props["devices"] or 0)
+        if n <= 0:
+            return
+        decline = ""
+        if self._members:
+            decline = ("segment head (members absorbed); replicate the "
+                       "unfused filters or use segment placement instead")
+        elif self.props["accelerator"]:
+            decline = (f"accelerator={self.props['accelerator']!r} pins "
+                       f"one device explicitly")
+        elif self.props["shared_tensor_filter_key"]:
+            decline = "shared-tensor-filter-key holds one device-resident model"
+        elif "@" in str(self.props["model"] or "") and \
+                ":" in str(self.props["model"]).rpartition("@")[2]:
+            decline = "store canary split routes per-backend (seeded RNG)"
+        if decline:
+            self._replica_decline = decline
+            log.warning("tensor_filter %s: devices=%d declined: %s",
+                        self.name, n, decline)
+            return
+        from nnstreamer_tpu.serving.placement import ReplicaSet
+
+        pre, post = self._pre, self._post
+        fused = self._fused_in_backend
+
+        def configure(b):
+            if pre is not None or post is not None:
+                if bool(b.fuse(pre, post)) != fused:
+                    raise BackendError(
+                        "replica backend disagreed with the head about "
+                        "pre/post fusion — placement would change results")
+            if need_set_input:
+                b.set_input_info(model_sees)
+
+        try:
+            self.replicas = ReplicaSet.open(
+                fw, dict(self.props), n, configure=configure,
+                name=self.name)
+        except BackendError as e:
+            self.fail_negotiation(f"devices={n}: {e}")
 
     def _negotiate_members(self, model_out: TensorsSpec, rate) -> TensorsSpec:
         """Chain member negotiation through the segment, then offer the
@@ -450,7 +529,13 @@ class TensorFilter(Element):
             # manifest here — start() runs before any buffer flows, so
             # a restarted process compiles its working set off the hot
             # path (warm against the on-disk XLA cache)
-            self.backend.warm_start()
+            if self.replicas is None:
+                self.backend.warm_start()
+        if self.replicas is not None:
+            # replica mode: the N placed backends serve every frame;
+            # the head backend stays cold (spec source only), so warm
+            # the replicas instead
+            self.replicas.warm_start(self._tracer, self.name)
         for _, m, _ in self._member_stages:
             if m.backend is not None:
                 m.backend.tracer = self._tracer
@@ -463,6 +548,8 @@ class TensorFilter(Element):
                     m.backend.warm_start()
 
     def stop(self) -> None:
+        if self.replicas is not None:
+            self.replicas.close()
         if self.backend is not None:
             self.backend.close()
         for _, m in self._members:
@@ -503,6 +590,20 @@ class TensorFilter(Element):
                 out["backend_swaps"] = out.get("backend_swaps", 0) + mswaps
         if self._forced_syncs:
             out["forced_syncs"] = self._forced_syncs
+        if self.replicas is not None:
+            rst = self.replicas.stats()
+            out["replica_devices"] = rst["devices"]
+            out["replica_live"] = rst["live"]
+            out["replica_invokes"] = sum(
+                r["invokes"] for r in rst["replicas"])
+            out["replica_errors"] = sum(
+                r["errors"] for r in rst["replicas"])
+            out["replica_reoffers"] = rst["reoffers"]
+            out["replica_fences"] = rst["fences"]
+            # per-chip rows ride along for the metrics plane
+            out["replicas"] = rst["replicas"]
+        if self._replica_decline:
+            out["replica_decline"] = self._replica_decline
         return out
 
     def _invoke_guarded(self, invoke, *args):
@@ -528,12 +629,16 @@ class TensorFilter(Element):
         inside the head's jit (one dispatch); otherwise members run
         host-side after the head. Guarded as ONE unit by the breaker —
         the head's policy/breaker governs the whole segment."""
+        if self.replicas is not None:
+            return self.replicas.invoke(inputs)
         outputs = self.backend.invoke(inputs)
         if self._member_stages and not self._segment_in_backend:
             outputs = self._apply_segment_host(outputs)
         return outputs
 
     def _invoke_segment_batched(self, inputs, n, keepdims):
+        if self.replicas is not None:
+            return self.replicas.invoke_batched(inputs, n, keepdims)
         outputs = self.backend.invoke_batched(inputs, n, keepdims)
         if self._member_stages and not self._segment_in_backend:
             outputs = self._apply_segment_host(outputs, n, keepdims)

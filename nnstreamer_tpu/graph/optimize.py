@@ -124,7 +124,7 @@ def _segment_member_ok(pipe: Pipeline, e) -> bool:
     )
 
 
-def fuse_segments(pipe: Pipeline) -> int:
+def fuse_segments(pipe: Pipeline, plan=None) -> int:
     """Collapse filter→transform→filter runs into the upstream filter.
 
     For each eligible head filter, repeatedly: walk the downstream
@@ -135,6 +135,13 @@ def fuse_segments(pipe: Pipeline) -> int:
     (`XLABackend.compose_segment`); a declining backend gets the member
     invokes applied host-side by the head, so results are identical.
 
+    A placement plan (`serving/placement.SegmentPlan`, passed here or
+    installed on the pipeline by `apply_plan` as `pipe.segment_plan`)
+    bounds the splice: absorption stops at a planned cut, so each stage
+    composes into ONE per-device unit and the cuts survive as real
+    element boundaries where the cross-device handoff (the next stage
+    backend's device_put staging) happens.
+
     Run BEFORE `fuse_transforms`: the head's pre chain, the post chain
     trailing the *last* member, and a trailing device decoder are all
     absorbed by the ordinary transform pass afterwards.
@@ -143,6 +150,8 @@ def fuse_segments(pipe: Pipeline) -> int:
     """
     from nnstreamer_tpu.elements.filter import TensorFilter
 
+    plan = plan if plan is not None else getattr(pipe, "segment_plan", None)
+    stage_of = plan.stage_of() if plan is not None else {}
     removed = 0
     for f in [e for e in list(pipe.elements.values())
               if isinstance(e, TensorFilter)]:
@@ -170,6 +179,14 @@ def fuse_segments(pipe: Pipeline) -> int:
             member = pipe.links_from(cur)[0].dst
             if not _segment_member_ok(pipe, member):
                 break   # transforms (if any) stay for fuse_transforms
+            if stage_of and stage_of.get(member.name, stage_of.get(
+                    f.name)) != stage_of.get(f.name):
+                log.info(
+                    "segment: plan cut between %s (stage %s) and %s "
+                    "(stage %s) — not absorbed", f.name,
+                    stage_of.get(f.name), member.name,
+                    stage_of.get(member.name))
+                break   # planned cut: the member heads its own stage
             for t in mids:
                 _remove_linear_element(pipe, t)
             _remove_linear_element(pipe, member)

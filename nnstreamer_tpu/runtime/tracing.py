@@ -257,6 +257,9 @@ class NullTracer:
     def backend_span(self, name, kind, t0, t1, **args):
         pass
 
+    def device_span(self, device, kind, t0, t1, **args):
+        pass
+
     def record_swap(self, name, t, **args):
         pass
 
@@ -421,6 +424,15 @@ class Tracer:
         """Backend-side span (compile/invoke) attributed to the owning
         tensor_filter's track; args carry bucket/cache-hit details."""
         self._append("X", "backend", name, kind, t0, t1 - t0, args or None)
+
+    def device_span(self, device: int, kind: str, t0: float, t1: float,
+                    **args) -> None:
+        """Per-device span (replica invoke / segment stage): one track
+        per chip (``dev0``..``devN``), so the trace viewer shows which
+        device ran what and where the pipeline bubbles are. args carry
+        the owning element / frame count."""
+        self._append("X", "device", f"dev{int(device)}", kind, t0,
+                     t1 - t0, args or None)
 
     def record_swap(self, name: str, t: float, **args) -> None:
         """A store-driven model hot swap adopted by `name`'s backend

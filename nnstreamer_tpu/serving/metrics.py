@@ -54,12 +54,21 @@ def _series(name: str, typ: str, help_: str,
 def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                      pool: Optional[dict] = None,
                      mesh: Optional[dict] = None,
+                     replicas: Optional[Dict[str, dict]] = None,
+                     segments: Optional[Dict[str, dict]] = None,
                      extra: Optional[Dict[str, float]] = None,
                      namespace: str = "nns") -> List[Series]:
     """Flatten runtime state into typed series.
 
     tracer     — a runtime.tracing.Tracer (ignored when None/inactive)
     admission  — AdmissionQueue.counters() snapshot
+    replicas   — {filter: ReplicaSet.stats()} (serving/placement.py):
+                 per-chip invoke/error counters + queue-depth/up gauges
+                 labelled by device; Σ nns_replica_invokes_total over
+                 devices == that filter's invoke count — the replica
+                 conservation check, verifiable from one scrape
+    segments   — {plan: SegmentPlan.report()}: per-stage profiled time
+                 (labelled stage/device) + the plan's bubble fraction
     pool       — WorkerPool.stats() snapshot
     mesh       — MeshRouter.stats() snapshot: per-host labelled series
                  (the `host` label) + mesh-wide gauges; the router's
@@ -188,6 +197,57 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                 [({"wid": str(w["wid"]), "state": w["state"]},
                   1.0 if w["state"] == "ready" else 0.0)
                  for w in workers]))
+
+    if replicas:
+        flat = [(f, r) for f, st in sorted(replicas.items())
+                for r in st.get("replicas", [])]
+        if flat:
+            out.append(_series(
+                f"{ns}_replica_invokes_total", "counter",
+                "per-chip replica invokes; summed over devices this "
+                "equals the owning filter's invoke count — the replica "
+                "conservation check",
+                [({"filter": f, "device": str(r["device"])},
+                  float(r["invokes"])) for f, r in flat]))
+            out.append(_series(
+                f"{ns}_replica_errors_total", "counter",
+                "per-chip replica invoke failures",
+                [({"filter": f, "device": str(r["device"])},
+                  float(r["errors"])) for f, r in flat]))
+            out.append(_series(
+                f"{ns}_replica_queue_depth", "gauge",
+                "frames queued on the chip's bounded queue right now",
+                [({"filter": f, "device": str(r["device"])},
+                  float(r["queue_depth"])) for f, r in flat]))
+            out.append(_series(
+                f"{ns}_replica_up", "gauge",
+                "1 when the replica serves, 0 when fenced (state label "
+                "says which)",
+                [({"filter": f, "device": str(r["device"]),
+                   "state": r["state"]}, 1.0 if r["up"] else 0.0)
+                 for f, r in flat]))
+        out.append(_series(
+            f"{ns}_replica_reoffers_total", "counter",
+            "frames re-routed to a surviving replica after a fence",
+            [({"filter": f}, float(st.get("reoffers", 0)))
+             for f, st in sorted(replicas.items())]))
+
+    if segments:
+        stage_rows = [(pl, row) for pl, rep in sorted(segments.items())
+                      for row in rep.get("stages", [])]
+        if stage_rows:
+            out.append(_series(
+                f"{ns}_segment_stage_seconds", "gauge",
+                "profiled per-stage proctime of the placement plan",
+                [({"plan": pl, "stage": str(row["stage"]),
+                   "device": str(row["device"])}, float(row["time_s"]))
+                 for pl, row in stage_rows]))
+        out.append(_series(
+            f"{ns}_segment_bubble_fraction", "gauge",
+            "steady-state device idle share of the segmented pipeline "
+            "(0 = perfectly balanced stages)",
+            [({"plan": pl}, float(rep.get("bubble_fraction", 0.0)))
+             for pl, rep in sorted(segments.items())]))
 
     if mesh:
         m = mesh.get("mesh", {})
@@ -520,6 +580,10 @@ _TOP_KEY_FAMILIES = (
     "nns_tenant_replied_total", "nns_tenant_rejected_total",
     "nns_tenant_shed_total", "nns_tenant_p99_ms",
     "nns_worker_replied_total",
+    # per-chip rows (serving/placement.py): invoke rate = per-device
+    # goodput, queue depth = where the backpressure is, up = fences
+    "nns_replica_invokes_total", "nns_replica_queue_depth",
+    "nns_replica_up",
     "nns_pool_restarts_total", "nns_trace_events_total",
 )
 

@@ -1,0 +1,638 @@
+"""Device placement: data-parallel replicas + profiled model segmentation.
+
+The subsystem that wires `parallel/` into the serving path (ROADMAP
+"the next frontier is horizontal"). Two placement modes:
+
+**Data-parallel replicas** (`ReplicaSet`): `tensor_filter devices=N`
+stands up N per-chip model replicas — each one a full backend instance
+pinned to its own device, fed by a per-chip bounded queue running the
+`parallel/dispatch.BatchCore` batching discipline (linger window,
+overlapped D2H readback, count-before-resolve conservation). Routing is
+least-outstanding with a round-robin tiebreak; a fenced replica's
+queued work is re-routed to survivors, so Σ replica invokes == filter
+replied holds exactly through a chip loss. Hot swap is store-integrated:
+every replica backend attaches to the model's `_Entry` as a swap
+handle, so one `ModelStore.update()` is the two-phase broadcast —
+prepare pre-warms the new version on every replica (any failure aborts
+before anything flips, same contract as `pool.rebind`), commit is the
+entry's single `_state` assignment, and all replicas adopt the same
+epoch at their next invoke with zero post-flip recompiles.
+
+**Profiled model segmentation** (`segment_plan` / `apply_plan`):
+consumes the tracer's per-element proctime profile to choose cut points
+(balanced contiguous partition — profiled cuts beat naive equal splits,
+arXiv 2503.01025), places each PR-8 `compose_segment` unit on its own
+device (the plan pins each stage's filters to one device via the
+`accelerator` prop; `graph/optimize.fuse_segments` then refuses to
+absorb across a planned cut), and reports per-stage/bubble time.
+Handoffs between stages are explicit `device_put`s: the next stage's
+backend stages incoming arrays onto its own device (counted by its
+`staging_transfers`).
+
+**Chip leases** (`ChipLeaseTable`): the worker-pool supervisor's view
+of "worker `wid` owns chips i..j". A crashed worker's chips are fenced
+at reap time and re-leased to the slot's replacement process at
+restart, so capacity accounting (tenancy's ScalingController weighs a
+K-chip slot as K capacity slots) never counts a dead chip.
+
+This module (plus `parallel/`) is the ONLY place allowed to pick
+explicit devices — nnlint NNL009 flags `jax.devices()[i]` anywhere
+else, so placement decisions cannot leak into random call sites.
+
+Everything here runs under CPU emulation
+(`XLA_FLAGS=--xla_force_host_platform_device_count=8`), which is how
+tier-1 exercises real multi-device placement without a chip.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from nnstreamer_tpu.core.errors import BackendError, StreamError
+from nnstreamer_tpu.core.log import get_logger
+
+log = get_logger("serving.placement")
+
+
+# -- device enumeration (the subsystem's single blessed call site) -----------
+
+def visible_devices() -> list:
+    """Every addressable accelerator device, in jax enumeration order.
+    All placement decisions route through here (NNL009)."""
+    import jax
+
+    return list(jax.devices())
+
+
+def device_of(index: int):
+    """The device with ordinal `index`; typed error past the end."""
+    devs = visible_devices()
+    if not 0 <= index < len(devs):
+        raise BackendError(
+            f"device index {index} out of range: {len(devs)} device(s) "
+            f"visible ({devs[0].platform if devs else 'none'})")
+    return devs[index]
+
+
+def accelerator_for(index: int) -> str:
+    """`accelerator=` property string pinning a backend to one device
+    (e.g. ``cpu:3`` / ``tpu:1``) — how the plan reaches `_pick_device`."""
+    return f"{device_of(index).platform}:{index}"
+
+
+# -- data-parallel replicas ---------------------------------------------------
+
+class _Replica:
+    """One per-chip model replica: a backend pinned to its device plus
+    the bounded BatchCore queue that feeds it."""
+
+    def __init__(self, index: int, backend, core, platform: str):
+        self.index = index            # device ordinal
+        self.backend = backend
+        self.core = core
+        self.platform = platform
+        self.fenced = False
+
+    @property
+    def outstanding(self) -> int:
+        return self.core.outstanding
+
+
+class ReplicaSet:
+    """N per-chip replicas behind one submit()/invoke() front door.
+
+    Construction: `ReplicaSet.open(framework, props, count)` opens one
+    backend per device with `accelerator=<platform>:<i>` (replica i on
+    device i); `configure` replays any head-side backend setup (fuse,
+    set_input_info) on each replica so every chip serves the exact
+    single-device program — bit-parity by construction.
+
+    Routing: least outstanding work first, round-robin among ties; a
+    replica whose bounded queue is full is skipped, and when every
+    replica is full submit() raises a typed StreamError (backpressure,
+    never unbounded buffering). A payload stranded by a fence is
+    re-routed to a surviving replica (`reoffers` counts them), so the
+    conservation ledger Σ replica invokes == frames replied holds
+    exactly through a chip loss.
+    """
+
+    def __init__(self, backends: Sequence[Any], device_indices: Sequence[int],
+                 *, queue_cap: int = 64, bucket: int = 4,
+                 max_delay_ms: float = 0.0, name: str = "replicas",
+                 tracer=None, store_name: str = ""):
+        if not backends:
+            raise BackendError("ReplicaSet needs at least one backend")
+        from nnstreamer_tpu.parallel.dispatch import BatchCore
+
+        self.name = name
+        self.tracer = tracer
+        self.store_name = store_name
+        self._lock = threading.Lock()
+        self._rr = 0
+        self.routed = 0
+        self.reoffers = 0
+        self.rejected = 0
+        self.fences = 0
+        self.max_redeliver = 1
+        devs = visible_devices()
+        self._replicas: List[_Replica] = []
+        for b, di in zip(backends, device_indices):
+            core = BatchCore(
+                self._make_run(len(self._replicas), di),
+                buckets=[max(1, int(bucket))],
+                max_delay_s=max_delay_ms / 1e3,
+                capacity=int(queue_cap), raw=True,
+                name=f"{name}-dev{di}")
+            self._replicas.append(
+                _Replica(di, b, core,
+                         devs[di].platform if di < len(devs) else "cpu"))
+
+    @classmethod
+    def open(cls, framework: str, props: Dict[str, Any], count: int, *,
+             configure: Optional[Callable[[Any], None]] = None,
+             queue_cap: int = 64, bucket: int = 4,
+             max_delay_ms: float = 0.0, name: str = "replicas",
+             tracer=None) -> "ReplicaSet":
+        """Stand up `count` per-device backends of `framework`, replica
+        i pinned to device i. Backends opened so far are closed again
+        if any later one fails — all replicas or none."""
+        from nnstreamer_tpu.backends.base import get_backend
+
+        devs = visible_devices()
+        if count > len(devs):
+            raise BackendError(
+                f"devices={count} requested but only {len(devs)} "
+                f"device(s) visible; run under "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                f"for CPU emulation")
+        model = props.get("model")
+        store_name = ""
+        if isinstance(model, str) and model.startswith("store://"):
+            store_name = model[len("store://"):].split("@", 1)[0]
+        backends = []
+        try:
+            for i in range(count):
+                b = get_backend(framework)
+                p = dict(props)
+                p["accelerator"] = accelerator_for(i)
+                b.open(p)
+                if configure is not None:
+                    configure(b)
+                backends.append(b)
+        except Exception:
+            for b in backends:
+                try:
+                    b.close()
+                except Exception:
+                    pass
+            raise
+        return cls(backends, list(range(count)), queue_cap=queue_cap,
+                   bucket=bucket, max_delay_ms=max_delay_ms, name=name,
+                   tracer=tracer, store_name=store_name)
+
+    # -- execution ---------------------------------------------------------
+    def _make_run(self, ridx: int, dev_index: int):
+        def run(items: List[tuple], n: int) -> List[tuple]:
+            r = self._replicas[ridx]
+            out: List[tuple] = []
+            for payload in items:
+                kind = payload[0]
+                t0 = time.perf_counter()
+                if kind == "invoke":
+                    res = r.backend.invoke(payload[1])
+                elif kind == "batched":
+                    res = r.backend.invoke_batched(
+                        payload[1], payload[2], payload[3])
+                else:
+                    raise StreamError(
+                        f"unknown replica payload kind {kind!r}")
+                t1 = time.perf_counter()
+                tr = self.tracer
+                if tr is not None and getattr(tr, "active", False):
+                    tr.device_span(dev_index, "invoke", t0, t1,
+                                   element=self.name,
+                                   frames=payload[2]
+                                   if kind == "batched" else 1)
+                out.append(tuple(res) if isinstance(res, (tuple, list))
+                           else (res,))
+            return out
+
+        return run
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self, exclude: Tuple[int, ...] = ()) -> Optional[_Replica]:
+        """Least-outstanding live replica; round-robin breaks ties so
+        an idle set still spreads work across every chip."""
+        with self._lock:
+            live = [r for r in self._replicas
+                    if not r.fenced and r.index not in exclude]
+            if not live:
+                return None
+            start = self._rr % len(live)
+            self._rr += 1
+            order = live[start:] + live[:start]
+            return min(order, key=lambda r: r.outstanding)
+
+    def _route(self, payload, outer: Future, attempts: int,
+               exclude: Tuple[int, ...] = ()) -> None:
+        tried: List[int] = list(exclude)
+        while True:
+            r = self._pick(tuple(tried))
+            if r is None:
+                with self._lock:
+                    self.rejected += 1
+                outer.set_exception(StreamError(
+                    f"{self.name}: no live replica accepted the frame "
+                    f"(fenced/full: {sorted(tried)})"))
+                return
+            try:
+                inner = r.core.submit(payload)
+            except StreamError:
+                tried.append(r.index)   # full or fenced mid-pick
+                continue
+            with self._lock:
+                self.routed += 1
+
+            def _done(fut, r=r, payload=payload, attempts=attempts):
+                exc = fut.exception()
+                if exc is None:
+                    if not outer.done():
+                        outer.set_result(fut.result())
+                    return
+                # a fence strands queued payloads — re-route them to a
+                # survivor (the frame never ran, retrying is safe);
+                # genuine model errors propagate untouched
+                if r.fenced and attempts < self.max_redeliver:
+                    with self._lock:
+                        self.reoffers += 1
+                    self._route(payload, outer, attempts + 1,
+                                exclude=(r.index,))
+                    return
+                if not outer.done():
+                    outer.set_exception(exc)
+
+            inner.add_done_callback(_done)
+            return
+
+    def submit(self, inputs: tuple) -> Future:
+        """Route one invocation (tuple of input tensors); the future
+        resolves to the output tensor tuple (host arrays)."""
+        outer: Future = Future()
+        self._route(("invoke", tuple(inputs)), outer, 0)
+        return outer
+
+    def submit_batched(self, inputs: tuple, n: int, keepdims) -> Future:
+        outer: Future = Future()
+        self._route(("batched", tuple(inputs), int(n), keepdims), outer, 0)
+        return outer
+
+    def invoke(self, inputs: tuple, timeout: Optional[float] = 60.0):
+        return self.submit(inputs).result(timeout)
+
+    def invoke_batched(self, inputs: tuple, n: int, keepdims,
+                       timeout: Optional[float] = 60.0):
+        return self.submit_batched(inputs, n, keepdims).result(timeout)
+
+    # -- chaos / supervision -----------------------------------------------
+    def fence(self, index: int, cause: str = "fenced") -> bool:
+        """Take replica `index` out of service: stop routing to it,
+        fail its queued work immediately (re-routed by the outer
+        futures), let anything already on device finish."""
+        with self._lock:
+            r = next((x for x in self._replicas if x.index == index), None)
+            if r is None or r.fenced:
+                return False
+            r.fenced = True
+            self.fences += 1
+        r.core.abort(f"replica dev{index} {cause}")
+        log.warning("%s: replica dev%d fenced (%s)", self.name, index,
+                    cause)
+        return True
+
+    def live_replicas(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas if not r.fenced)
+
+    # -- store hot swap ----------------------------------------------------
+    def swap(self, version=None, wait_s: Optional[float] = None) -> dict:
+        """Two-phase, epoch-atomic hot swap across every replica, by
+        delegating to the store's handle protocol: prepare pre-warms
+        the target version on each attached replica backend (any
+        failure raises BEFORE the flip — nothing moved, same
+        all-or-none contract as `pool.rebind`); commit is the entry's
+        single `_state` assignment, after which every replica adopts
+        the same epoch at its next invoke boundary. Pre-warm staged the
+        exact jits, so the flip costs zero recompiles."""
+        if not self.store_name:
+            raise BackendError(
+                f"{self.name}: not store-backed (model was not a "
+                f"store:// ref); register the model in the ModelStore "
+                f"to hot swap replicas")
+        from nnstreamer_tpu.serving.store import get_store
+
+        return get_store().update(self.store_name, version,
+                                  prewarm=True, wait_s=wait_s)
+
+    def adopted_epochs(self) -> List[int]:
+        return [getattr(r.backend, "adopted_epoch", -1)
+                for r in self._replicas]
+
+    def compile_counts(self) -> List[int]:
+        return [int(getattr(r.backend, "compile_count", 0) or 0)
+                for r in self._replicas]
+
+    # -- lifecycle / stats -------------------------------------------------
+    def warm_start(self, tracer=None, trace_name: str = "") -> None:
+        for r in self._replicas:
+            if tracer is not None:
+                r.backend.tracer = tracer
+                r.backend.trace_name = (
+                    f"{trace_name or self.name}/dev{r.index}")
+            r.backend.warm_start()
+        if tracer is not None:
+            self.tracer = tracer
+
+    def close(self) -> None:
+        for r in self._replicas:
+            r.core.shutdown()
+        for r in self._replicas:
+            try:
+                r.backend.close()
+            except Exception:
+                pass
+
+    def stats(self) -> dict:
+        rows = []
+        with self._lock:
+            reps = list(self._replicas)
+            totals = {"routed": self.routed, "reoffers": self.reoffers,
+                      "rejected": self.rejected, "fences": self.fences}
+        for r in reps:
+            cs = r.core.stats()
+            rows.append({
+                "device": r.index,
+                "platform": r.platform,
+                "invokes": cs["frames"],
+                "batches": cs["batches"],
+                "errors": cs["errors"],
+                "queue_depth": cs["outstanding"],
+                "up": not r.fenced,
+                "state": "fenced" if r.fenced else "ready",
+                "compile_count": int(
+                    getattr(r.backend, "compile_count", 0) or 0),
+                "adopted_epoch": getattr(r.backend, "adopted_epoch", -1),
+            })
+        out = {"replicas": rows, "devices": len(rows),
+               "live": sum(1 for x in rows if x["up"])}
+        out.update(totals)
+        return out
+
+
+# -- chip leases (worker-pool supervision) -----------------------------------
+
+class ChipLeaseTable:
+    """Which process owns which chips — the supervisor's fencing ledger.
+
+    States per chip: ``free`` (unowned), ``leased`` (owned by a live
+    worker), ``fenced`` (its owner died; the chip is out of service
+    until the replacement process re-leases it). `lease()` prefers the
+    owner's own fenced chips, so a restarted slot gets its chips back
+    — the "worker owns chips i..j" invariant survives the crash."""
+
+    def __init__(self, chips: Sequence[int]):
+        self._lock = threading.Lock()
+        self._chips: Dict[int, dict] = {
+            int(c): {"owner": None, "state": "free"}
+            for c in chips}
+        self.fences_total = 0
+        self.releases_total = 0
+
+    def lease(self, owner, want: Optional[int] = None) -> Tuple[int, ...]:
+        """Lease `want` chips to `owner` (None = all of its fenced
+        chips, i.e. a re-lease after restart). Own fenced chips come
+        back first; free chips top up the rest. Typed error when the
+        table cannot satisfy the request — silently under-leasing would
+        corrupt the scaler's capacity math."""
+        with self._lock:
+            got: List[int] = []
+            for c, row in sorted(self._chips.items()):
+                if row["state"] == "fenced" and row["owner"] == owner:
+                    got.append(c)
+            if want is None:
+                want = len(got)
+            for c, row in sorted(self._chips.items()):
+                if len(got) >= want:
+                    break
+                if row["state"] == "free":
+                    got.append(c)
+            if len(got) < want:
+                raise BackendError(
+                    f"chip lease for {owner!r}: wanted {want}, only "
+                    f"{len(got)} available "
+                    f"({self._counts_locked()})")
+            got = got[:want]
+            for c in got:
+                self._chips[c] = {"owner": owner, "state": "leased"}
+            return tuple(sorted(got))
+
+    def fence(self, owner) -> Tuple[int, ...]:
+        """The owner died: its leased chips go out of service, still
+        associated with the owner so the restart re-leases them."""
+        with self._lock:
+            fenced = []
+            for c, row in self._chips.items():
+                if row["owner"] == owner and row["state"] == "leased":
+                    row["state"] = "fenced"
+                    fenced.append(c)
+            self.fences_total += len(fenced)
+            return tuple(sorted(fenced))
+
+    def release(self, owner) -> Tuple[int, ...]:
+        """Give the owner's chips (leased or fenced) back to the free
+        pool — a slot disabled by the restart circuit surrenders its
+        capacity instead of pinning dead chips forever."""
+        with self._lock:
+            freed = []
+            for c, row in self._chips.items():
+                if row["owner"] == owner:
+                    self._chips[c] = {"owner": None, "state": "free"}
+                    freed.append(c)
+            self.releases_total += len(freed)
+            return tuple(sorted(freed))
+
+    def chips_of(self, owner) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(c for c, row in self._chips.items()
+                                if row["owner"] == owner))
+
+    def _counts_locked(self) -> dict:
+        counts = {"free": 0, "leased": 0, "fenced": 0}
+        for row in self._chips.values():
+            counts[row["state"]] += 1
+        return counts
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "chips": {c: dict(row)
+                          for c, row in sorted(self._chips.items())},
+                "counts": self._counts_locked(),
+                "fences_total": self.fences_total,
+                "releases_total": self.releases_total,
+            }
+
+
+# -- profiled model segmentation ---------------------------------------------
+
+@dataclass
+class SegmentPlan:
+    """Where to cut a filter chain and which device runs each piece."""
+
+    stages: List[List[str]]        # element names, dataflow order
+    devices: List[int]             # device ordinal per stage
+    stage_times_s: List[float]     # profiled per-stage proctime sum
+    bubble_fraction: float         # steady-state device idle share
+    total_s: float                 # profiled single-device total
+    source: str = "profile"
+
+    def stage_of(self) -> Dict[str, int]:
+        return {name: i for i, group in enumerate(self.stages)
+                for name in group}
+
+    def report(self) -> dict:
+        """Per-stage/bubble summary (feeds the metrics plane)."""
+        return {
+            "stages": [
+                {"stage": i, "device": self.devices[i],
+                 "elements": list(self.stages[i]),
+                 "time_s": self.stage_times_s[i]}
+                for i in range(len(self.stages))],
+            "bubble_fraction": self.bubble_fraction,
+            "bottleneck_s": max(self.stage_times_s, default=0.0),
+            "total_s": self.total_s,
+            "source": self.source,
+        }
+
+    def measured_report(self, tracer) -> dict:
+        """Like report(), but with stage times re-read from the live
+        tracer profile of each stage's surviving head element — the
+        planned-vs-measured comparison that tells you whether the cut
+        points still fit the traffic."""
+        hists = tracer.hists() if getattr(tracer, "active", False) else {}
+        times = []
+        for group in self.stages:
+            h = hists.get(group[0]) if group else None
+            times.append(h["sum"] / h["count"]
+                         if h and h["count"] else 0.0)
+        mx = max(times, default=0.0)
+        rep = self.report()
+        for i, row in enumerate(rep["stages"]):
+            row["measured_s"] = times[i]
+        rep["measured_bubble_fraction"] = (
+            1.0 - (sum(times) / (len(times) * mx)) if mx > 0 else 0.0)
+        return rep
+
+
+def _bubble(stage_times: List[float]) -> float:
+    """Steady-state idle share of a synchronous pipeline: every cycle
+    takes the bottleneck stage's time, so each other stage idles for
+    (max - its own time) of it."""
+    mx = max(stage_times, default=0.0)
+    if mx <= 0 or len(stage_times) <= 1:
+        return 0.0
+    return 1.0 - sum(stage_times) / (len(stage_times) * mx)
+
+
+def segment_plan(costs: Sequence[Tuple[str, float]],
+                 ndev: int, *, source: str = "profile") -> SegmentPlan:
+    """Optimal contiguous partition of a profiled chain over up to
+    `ndev` devices, minimizing the bottleneck stage (classic linear
+    partition DP, O(n²k)) — the profiled-cut-point pass of arXiv
+    2503.01025. `costs` is [(element_name, seconds)] in dataflow order;
+    zero-cost elements (never profiled) ride along with their
+    neighbours. Stage s is placed on device s."""
+    names = [n for n, _ in costs]
+    ts = [max(0.0, float(t)) for _, t in costs]
+    n = len(ts)
+    if n == 0:
+        raise BackendError("segment_plan: empty cost profile")
+    k = max(1, min(int(ndev), n))
+    # prefix[i] = sum of ts[:i]
+    prefix = [0.0]
+    for t in ts:
+        prefix.append(prefix[-1] + t)
+    INF = float("inf")
+    # best[j][i] = minimal bottleneck splitting first i elements into j
+    best = [[INF] * (n + 1) for _ in range(k + 1)]
+    cut = [[0] * (n + 1) for _ in range(k + 1)]
+    best[0][0] = 0.0
+    for j in range(1, k + 1):
+        for i in range(j, n + 1):
+            for m in range(j - 1, i):
+                cand = max(best[j - 1][m], prefix[i] - prefix[m])
+                if cand < best[j][i]:
+                    best[j][i] = cand
+                    cut[j][i] = m
+    # fewer stages can tie the bottleneck (e.g. one dominant element):
+    # prefer the smallest stage count that achieves it — extra cuts buy
+    # nothing but handoffs
+    kbest = min(range(1, k + 1), key=lambda j: (best[j][n], j))
+    bounds: List[int] = []
+    i, j = n, kbest
+    while j > 0:
+        bounds.append(i)
+        i = cut[j][i]
+        j -= 1
+    bounds.reverse()
+    stages, times = [], []
+    lo = 0
+    for hi in bounds:
+        stages.append(names[lo:hi])
+        times.append(prefix[hi] - prefix[lo])
+        lo = hi
+    return SegmentPlan(stages=stages,
+                       devices=list(range(len(stages))),
+                       stage_times_s=times,
+                       bubble_fraction=_bubble(times),
+                       total_s=prefix[n], source=source)
+
+
+def plan_from_tracer(tracer, names: Sequence[str],
+                     ndev: int) -> SegmentPlan:
+    """Build a plan from the tracer's per-element proctime histograms
+    (`Tracer.hists()`): each element's cost is its observed mean
+    process() time. Elements with no profile yet cost zero (they ride
+    along with profiled neighbours)."""
+    hists = tracer.hists() if getattr(tracer, "active", False) else {}
+    costs = []
+    for nm in names:
+        h = hists.get(nm)
+        costs.append((nm, h["sum"] / h["count"]
+                      if h and h["count"] else 0.0))
+    return segment_plan(costs, ndev, source="tracer")
+
+
+def apply_plan(pipe, plan: SegmentPlan) -> int:
+    """Pin each planned stage's filters to its device (sets the
+    `accelerator` prop — must run BEFORE negotiation) and record the
+    plan on the pipeline so `fuse_segments` splices plan-aware: members
+    fuse within a stage, never across a cut. Returns the number of
+    elements pinned."""
+    pinned = 0
+    for group, dev in zip(plan.stages, plan.devices):
+        accel = accelerator_for(dev)
+        for name in group:
+            e = pipe.elements.get(name)
+            if e is None:
+                log.warning("apply_plan: element %r not in pipeline "
+                            "(already fused?)", name)
+                continue
+            if "accelerator" in e.PROPS or "accelerator" in e.props:
+                e.props["accelerator"] = accel
+                pinned += 1
+    pipe.segment_plan = plan
+    return pinned

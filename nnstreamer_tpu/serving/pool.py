@@ -59,7 +59,7 @@ import signal
 import threading
 import time
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional, Sequence
 
 from nnstreamer_tpu.core.errors import StreamError
 from nnstreamer_tpu.core.log import get_logger
@@ -160,6 +160,7 @@ class _Slot:
         self.replied = 0
         self.version: Optional[tuple] = None
         self.bound_model: Optional[str] = None   # rebind() routing hint
+        self.chips: tuple = ()        # leased device ordinals (placement)
 
     def hb_age_s(self, now: float) -> float:
         return now - max(self.last_hb, self.started_t)
@@ -182,11 +183,28 @@ class WorkerPool:
                  restart_window_s: float = 30.0,
                  drain_timeout_s: float = 10.0,
                  spawn_grace_s: float = 20.0,
+                 chips: Optional[Sequence[int]] = None,
                  name: str = "worker_pool"):
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
         if per_worker_queue < 1:
             raise ValueError("per_worker_queue must be >= 1")
+        # chip ownership (serving/placement.ChipLeaseTable): device
+        # ordinals partitioned across the slots — worker i owns chips
+        # i*K..(i+1)*K-1. The supervisor fences a dead worker's chips
+        # and re-leases them to the replacement; a K-chip slot counts
+        # as K capacity slots (capacity_slots / slot_weights).
+        self.chip_table = None
+        self._chips_per_slot = 0
+        if chips:
+            if len(chips) % workers != 0:
+                raise ValueError(
+                    f"chips ({len(chips)}) must divide evenly across "
+                    f"workers ({workers})")
+            from nnstreamer_tpu.serving.placement import ChipLeaseTable
+
+            self.chip_table = ChipLeaseTable(chips)
+            self._chips_per_slot = len(chips) // workers
         self.qs = qs
         # a traced pool runs traced workers: the child spins up its own
         # Tracer and ships deltas back over the pipe ("tr" lane)
@@ -280,9 +298,19 @@ class WorkerPool:
 
     def _spawn(self, slot: _Slot) -> None:
         """Start a worker in `slot` (under `_lock`)."""
+        spec = self.spec
+        if self.chip_table is not None:
+            # (re-)lease the slot's chips: a restarted slot gets its own
+            # fenced chips back first, so "worker wid owns chips i..j"
+            # survives the crash
+            slot.chips = self.chip_table.lease(
+                slot.wid, self._chips_per_slot)
+            import dataclasses
+
+            spec = dataclasses.replace(spec, chips=slot.chips)
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
         proc = self._ctx.Process(
-            target=worker_main, args=(child_conn, self.spec, slot.wid),
+            target=worker_main, args=(child_conn, spec, slot.wid),
             name=f"{self.name}-w{slot.wid}", daemon=True)
         proc.start()
         child_conn.close()            # child's end lives in the child
@@ -599,6 +627,12 @@ class WorkerPool:
                             "after join — leaked", self.name, slot.wid)
         self._event(slot.wid, "exit", cause=cause, exitcode=exitcode,
                     pid=slot.pid)
+        if self.chip_table is not None and slot.chips:
+            # the dead worker's chips go out of service until the
+            # replacement process re-leases them at _spawn
+            fenced = self.chip_table.fence(slot.wid)
+            if fenced:
+                self._event(slot.wid, "chips_fenced", chips=list(fenced))
         with self._lock:
             orphaned = list(slot.inflight.values())
             slot.inflight.clear()
@@ -652,6 +686,13 @@ class WorkerPool:
             self._event(slot.wid, "degraded",
                         restarts_in_window=len(slot.restart_times),
                         window_s=self.restart_window_s)
+            if self.chip_table is not None and slot.chips:
+                # a disabled slot surrenders its chips instead of
+                # pinning them fenced forever; capacity_slots drops
+                freed = self.chip_table.release(slot.wid)
+                slot.chips = ()
+                self._event(slot.wid, "chips_released",
+                            chips=list(freed))
             return
         slot.restart_times.append(now)
         slot.restarts += 1
@@ -752,6 +793,27 @@ class WorkerPool:
     def size(self) -> int:
         """Configured slot count (the scaler's allocation budget)."""
         return self.n_workers
+
+    @property
+    def capacity_slots(self) -> int:
+        """Chip-weighted capacity: a slot bound to K chips serves K
+        replicas' worth of traffic, so the scaler allocates against
+        Σ weights, not the process count. Plain pools (no chip table)
+        weigh every slot 1 — identical to `size`. DISABLED slots have
+        surrendered their chips and count 0."""
+        return sum(self.slot_weights().values()) or 1
+
+    def slot_weights(self) -> Dict[int, int]:
+        """{wid: capacity weight} for every non-disabled slot — chip
+        count when leases exist, else 1."""
+        with self._lock:
+            out: Dict[int, int] = {}
+            for s in self._slots:
+                if s.state == DISABLED:
+                    continue
+                out[s.wid] = len(s.chips) if self.chip_table is not None \
+                    else 1
+            return out
 
     def rebind(self, mapping: Dict[int, Optional[str]],
                timeout_s: float = 30.0) -> dict:
@@ -891,6 +953,7 @@ class WorkerPool:
                 "kills": s.kills,
                 "replied": s.replied,
                 "bound_model": s.bound_model,
+                "chips": list(s.chips),
             } for s in self._slots]
             return {
                 "pool": {
@@ -908,6 +971,8 @@ class WorkerPool:
                     "rebinds": self.rebinds,
                 },
                 "workers": workers,
+                **({"chips": self.chip_table.snapshot()}
+                   if self.chip_table is not None else {}),
             }
 
     def extra_stats(self) -> Dict[str, Any]:

@@ -396,11 +396,27 @@ class ScalingController:
                 row.get("rate_hz", 0.0))
         return demand
 
+    def _slot_weights(self) -> Dict[int, int]:
+        """{wid: capacity weight} — chip count for chip-leased pools
+        (serving/placement.py: a K-chip worker serves K replicas'
+        traffic), 1 everywhere else. Pool doubles without the surface
+        weigh every slot 1."""
+        sw = getattr(self.pool, "slot_weights", None)
+        if callable(sw):
+            try:
+                return {int(k): max(1, int(v)) for k, v in sw().items()}
+            except Exception:
+                pass
+        return {}
+
     def _allocate(self, models: List[str],
                   demand: Dict[str, float]) -> Dict[str, int]:
         """Proportional share with a per-model floor, largest-remainder
-        for the leftovers. Deterministic: ties break by model order."""
-        slots = max(int(self.pool.size), 1)
+        for the leftovers. Deterministic: ties break by model order.
+        The budget is CAPACITY slots (chip-weighted), not processes —
+        a 2-worker × 4-chip pool allocates 8 units."""
+        slots = max(int(getattr(self.pool, "capacity_slots", 0)
+                        or self.pool.size), 1)
         total = sum(max(demand.get(m, 0.0), 0.0) for m in models)
         floors = {m: self.min_slots for m in models}
         base = sum(floors.values())
@@ -422,10 +438,11 @@ class ScalingController:
 
     def _current_binding(self) -> Dict[str, int]:
         counts: Dict[str, int] = {}
+        w = self._slot_weights()
         try:
-            for model in self.pool.bindings().values():
+            for sid, model in self.pool.bindings().items():
                 if model is not None:
-                    counts[model] = counts.get(model, 0) + 1
+                    counts[model] = counts.get(model, 0) + w.get(sid, 1)
         except Exception:
             pass
         return counts
@@ -438,19 +455,30 @@ class ScalingController:
             current = dict(self.pool.bindings())
         except Exception:
             return False
+        w = self._slot_weights()
         want = dict(plan)
         mapping: Dict[int, Optional[str]] = {}
         unassigned: List[int] = []
         for sid in sorted(current):
             cur = current[sid]
-            if cur is not None and want.get(cur, 0) > 0:
+            wt = w.get(sid, 1)
+            # keep the slot only when the plan still owes its model the
+            # slot's FULL weight — a K-chip slot consumes K plan units
+            if cur is not None and want.get(cur, 0) >= wt:
                 mapping[sid] = cur
-                want[cur] -= 1
+                want[cur] -= wt
             else:
                 unassigned.append(sid)
-        remaining = [m for m in plan for _ in range(want.get(m, 0))]
         for sid in unassigned:
-            mapping[sid] = remaining.pop(0) if remaining else None
+            wt = w.get(sid, 1)
+            owed = sorted(((m, n) for m, n in want.items() if n > 0),
+                          key=lambda kv: (-kv[1], kv[0]))
+            if owed:
+                m = owed[0][0]
+                mapping[sid] = m
+                want[m] -= wt
+            else:
+                mapping[sid] = None
         try:
             rep = self.pool.rebind(mapping)
         except Exception:

@@ -102,6 +102,13 @@ class WorkerSpec:
     preload: tuple = ()                   # ((name, version, ref), ...)
     resident_models: int = 0
     resident_bytes: int = 0
+    # chip ownership (serving/placement.ChipLeaseTable): device ordinals
+    # this worker is leased. Informational to the child (it pins its
+    # own placement from these); authoritative to the SUPERVISOR, which
+    # fences the chips when the worker dies and re-leases them to the
+    # replacement — a K-chip worker counts as K slots of capacity in
+    # the scaler (tenancy.ScalingController).
+    chips: tuple = ()
 
     def __post_init__(self):
         if self.kind not in ("echo", "pipeline", "multiplex"):

@@ -27,6 +27,13 @@ import pytest  # noqa: E402
 
 @pytest.fixture(scope="session")
 def eight_cpu_devices():
+    """The multichip fixture (pytest.ini marker `multichip`): tests
+    needing real multi-device placement take this and get the 8-device
+    emulated mesh, or a skip when the env override above lost (e.g. jax
+    was imported before conftest in an exotic runner). Subprocess tests
+    (pool workers, bench families) must instead ship BOTH env vars to
+    the child BEFORE it imports jax — see bench.py's multichip family
+    for the pattern."""
     import jax
 
     devs = jax.devices()

@@ -458,6 +458,46 @@ def test_nnl008_scoped_to_serving_path():
     assert_silent("NNL008", {REPO_PATHS["runtime"]: BAD_SOCKET})
 
 
+# -- NNL009 placement-audit --------------------------------------------------
+
+BAD_PLACEMENT = '''
+import jax
+
+def pin():
+    d = jax.devices()[0]                 # explicit ordinal pick
+    e = jax.local_devices()[2]
+    return d, e
+'''
+
+GOOD_PLACEMENT = '''
+import jax
+
+def enumerate_all():
+    n = len(jax.devices())               # counting is fine
+    head = jax.devices()[:n]             # slices keep the set, not a pick
+    return head
+'''
+
+
+def test_nnl009_fires_on_explicit_device_pick():
+    findings = assert_fires(
+        "NNL009", {REPO_PATHS["backend"]: BAD_PLACEMENT}, n_min=2)
+    assert all("placement" in f.message for f in findings)
+
+
+def test_nnl009_silent_on_enumeration_and_slices():
+    assert_silent("NNL009", {REPO_PATHS["backend"]: GOOD_PLACEMENT})
+
+
+def test_nnl009_blessed_in_placement_and_parallel():
+    # serving/placement.py and parallel/ ARE the placement subsystem —
+    # the rule exists to keep device picks from leaking anywhere else
+    assert_silent("NNL009", {
+        "nnstreamer_tpu/serving/placement.py": BAD_PLACEMENT,
+        "nnstreamer_tpu/parallel/mesh.py": BAD_PLACEMENT,
+    })
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_inline_suppression_waives_a_finding():
