@@ -308,6 +308,16 @@ def _serve_main(argv) -> int:
                     help="multiplex mode: max models holding live "
                          "compiled entries per worker (LRU eviction; "
                          "0 = unbounded)")
+    ap.add_argument("--slo", default=None, metavar="FILE",
+                    help="SLO spec JSON (docs/autotune.md): runs the "
+                         "closed-loop autotuner against this pool's "
+                         "admission queue, defending the declared p99 "
+                         "budget by re-deriving max_pending from the "
+                         "measured reply rate")
+    ap.add_argument("--autotune-dry-run", action="store_true",
+                    help="with --slo: record every decision (audit "
+                         "ring, metrics, tracer) without actuating "
+                         "any knob")
     ap.add_argument("--stats-every", type=float, default=0.0,
                     help="print pool stats JSON every N seconds")
     ap.add_argument("--metrics-port", type=int, default=None,
@@ -360,6 +370,27 @@ def _serve_main(argv) -> int:
         max_inflight=args.max_inflight, shed_policy=args.shed_policy,
         tenants=table, tracer=tracer)
     pqs.install_signal_handlers()
+    tuner = None
+    if args.slo:
+        from nnstreamer_tpu.serving.autotune import AutoTuner, SLOSpec
+
+        def _shrink_victims(victims):
+            # entries shed by a live max_pending shrink: each is owed
+            # a BUSY, same contract as every other admission victim
+            for v in victims:
+                try:
+                    pqs.qs.send_busy(v.meta.get("client_id"), v.pts,
+                                     "bound_shrink")
+                except Exception:
+                    pass
+
+        tuner = AutoTuner(
+            SLOSpec.from_json(args.slo), admission=pqs.qs.frames,
+            tracer=tracer, dry_run=args.autotune_dry_run,
+            on_victims=_shrink_victims).start()
+        print(f"slo autotuner active "
+              f"(dry_run={bool(args.autotune_dry_run)})",
+              file=sys.stderr)
     msrv = None
     if args.metrics_port is not None:
         from nnstreamer_tpu.serving.metrics import (
@@ -367,9 +398,9 @@ def _serve_main(argv) -> int:
 
         def collect():
             s = pqs.stats()
-            return metrics_snapshot(tracer=tracer,
-                                    admission=s.pop("admission"),
-                                    pool=s)
+            return metrics_snapshot(
+                tracer=tracer, admission=s.pop("admission"), pool=s,
+                autotune=tuner.stats() if tuner is not None else None)
 
         msrv = MetricsServer(collect, host=args.metrics_host,
                              port=args.metrics_port,
@@ -402,6 +433,8 @@ def _serve_main(argv) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if tuner is not None:
+            tuner.stop()
         if agent is not None:
             agent.stop()
         pqs.close()
@@ -636,6 +669,15 @@ def _traffic_main(argv) -> int:
     ap.add_argument("--flood", type=float, default=3.0, metavar="K",
                     help="flooding tenant's offered load as a "
                          "multiple of its fair share (--tenants)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="SLO-autotuner drill: open-loop ramp "
+                         "0.5→2.5x capacity against a deliberately "
+                         "mis-set bounded server, closed-loop tuned "
+                         "vs the same static config on the same trace "
+                         "(docs/autotune.md)")
+    ap.add_argument("--autotune-dry-run", action="store_true",
+                    help="with --autotune: the controller records "
+                         "every decision without actuating any knob")
     ap.add_argument("--json", action="store_true",
                     help="print the raw report JSON only")
     ap.add_argument("--trace", action="store_true",
@@ -656,6 +698,28 @@ def _traffic_main(argv) -> int:
 
     if args.trace_out:
         args.trace = True
+    if args.autotune:
+        from nnstreamer_tpu.traffic import run_autotune_ramp
+
+        kw = dict(n_per_step=max(20, args.requests // 5),
+                  service_ms=args.service_ms,
+                  p99_budget_ms=args.budget_ms, seed=args.seed)
+        static = run_autotune_ramp(tuned=False, **kw)
+        tuned = run_autotune_ramp(
+            tuned=True, dry_run=args.autotune_dry_run, **kw)
+        report = {"static": static, "tuned": tuned,
+                  "goodput_gain_rps": round(
+                      tuned["goodput_rps"] - static["goodput_rps"], 2)}
+        if args.json:
+            print(json.dumps(report, default=float))
+        else:
+            for r in (static, tuned):
+                r.pop("queue_depth_timeline", None)
+            print(json.dumps(report, indent=2, default=float))
+        ok = (static["lost"] == 0 and tuned["lost"] == 0
+              and tuned["conservation_final"]
+              and all(tuned.get("conservation_after_apply") or [True]))
+        return 0 if ok else 1
     if args.tenants > 0:
         from nnstreamer_tpu.traffic import run_multitenant
 

@@ -150,6 +150,15 @@ class XLABackend(FilterBackend):
         # their HBM for outputs instead of allocating more)
         self.donated_invokes = 0
         self._donate = False         # resolved in open() (platform gate)
+        # observed micro-batch occupancy, {n: invokes} — a first-class
+        # sensor (tensor_filter.extra_stats → autotuner bucket
+        # refinement) instead of making callers infer occupancy from
+        # bucket cache keys
+        self.batch_size_hist: Dict[int, int] = {}
+        # last successfully bucketed per-frame signature
+        # ((frame_shape, dtype), ...) — what stage_bucket() rebuilds a
+        # different pow2 bucket from
+        self._last_dynb: Optional[tuple] = None
         # cache namespace generation for non-store models: bumped on any
         # model change (reload / shared-entry adoption) and prefixed
         # into every _dyn_jits/_batch_ok key, so a stale bucket compiled
@@ -1048,6 +1057,9 @@ class XLABackend(FilterBackend):
             self._batch_ok[verdict_key] = ok
         if not ok:
             return super().invoke_batched(tensors, n, keepdims)
+        self.batch_size_hist[n] = self.batch_size_hist.get(n, 0) + 1
+        self._last_dynb = tuple(
+            (tuple(a.shape[1:]), str(a.dtype)) for a in arrs)
         arrs = self._pad_bucket(arrs, n, nb)
         params = self._packed_params()
         hits0 = self.cache_hits
@@ -1133,6 +1145,9 @@ class XLABackend(FilterBackend):
             self._batch_ok[verdict_key] = ok
         if not ok:
             return super().invoke_batched(tensors, n, keepdims)
+        self.batch_size_hist[n] = self.batch_size_hist.get(n, 0) + 1
+        self._last_dynb = tuple(
+            (tuple(a.shape[1:]), str(a.dtype)) for a in arrs)
         arrs = self._pad_bucket(arrs, n, nb)
         self._note_bucket(ver, basekey)
         packed = self._with_seg(
@@ -1190,6 +1205,60 @@ class XLABackend(FilterBackend):
         if len(self._dyn_jits) >= self._dyn_cache_max:
             self._dyn_jits.popitem(last=False)
         self._dyn_jits[key] = jitted
+
+    def stage_bucket(self, nb: int) -> bool:
+        """Compile the pow2 occupancy bucket ``nb`` for the most
+        recently served dynamic-batch signature, OFF the hot path, and
+        install it via `_insert_jit` — the autotuner stages a refined
+        bucket here *before* flipping ``tensor_batch``'s ``max_batch``,
+        so the first flush at the new size takes a cache hit instead of
+        an in-band recompile stall. Safe to call from the controller
+        thread: it never touches worker-owned seg state (`_seg_begin`),
+        and a concurrent `_insert_jit` against the worker's LRU is at
+        worst one transient extra cache entry. Returns True when the
+        bucket is live (freshly compiled or already cached)."""
+        pairs = self._last_dynb
+        if pairs is None or nb < 1:
+            return False
+        import jax
+        import numpy as np_
+
+        from nnstreamer_tpu.runtime.sync import device_sync
+
+        nb = _next_pow2(int(nb))
+        batched = tuple(((nb,) + tuple(s), d) for s, d in pairs)
+        ver = None
+        if self._store_entry is not None:
+            ver = self._adopted_version
+            vs = self._vstates.get(ver)
+            if vs is None:
+                return False
+            basekey = ("dynb", nb) + batched
+            key = (self._ns(ver),) + basekey + self._seg_suffix()
+            fn = self._full_fn(bundle=vs.bundle)
+            packed = self._with_seg(
+                (vs.device_params, getattr(self, "_post_aux", None)))
+        else:
+            key = (self._ns(), "dynb", nb) \
+                + tuple(s for s, _ in batched) + self._seg_suffix()
+            fn = self._full_fn()
+            packed = self._packed_params()
+        if key in self._dyn_jits:
+            return True
+        try:
+            jitted = jax.jit(fn)
+            args = tuple(
+                jax.device_put(np_.zeros(s, dtype=np_.dtype(d)),
+                               self._device) for s, d in batched)
+            device_sync(_to_tuple(jitted(packed, *args)),
+                        self.tracer, self.trace_name)
+        except Exception as e:
+            log.warning("stage_bucket(%d) skipped: %s", nb, e)
+            return False
+        self._insert_jit(key, jitted)
+        if ver is not None:
+            self._note_bucket(ver, basekey)
+        return True
 
     # -- residency pressure hooks (serving/tenancy.ModelResidency) ---------
     def jit_cache_size(self) -> int:

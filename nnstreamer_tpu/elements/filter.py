@@ -566,6 +566,11 @@ class TensorFilter(Element):
             v = getattr(self.backend, k, None)
             if v is not None:
                 out["backend_" + k] = v
+        # observed micro-batch occupancy histogram ({n: invokes}) —
+        # the autotuner's bucket-refinement sensor
+        hist = getattr(self.backend, "batch_size_hist", None)
+        if hist:
+            out["backend_batch_size_hist"] = dict(hist)
         # store:// serving: per-version invoke/error/p95 counters +
         # epoch adoptions, under backend_ keys so report()'s backend
         # section renders the canary comparison without extra tooling

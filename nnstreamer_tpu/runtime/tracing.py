@@ -281,6 +281,9 @@ class NullTracer:
     def record_request(self, name, trace_id, hops, t, **args):
         pass
 
+    def record_autotune(self, name, knob, t, **args):
+        pass
+
     def tenant_summary(self):
         return {}
 
@@ -347,6 +350,14 @@ class Tracer:
         self._requests: List[Tuple[str, str, float, list, dict]] = []
         self._max_requests = 4096
         self._requests_dropped = 0
+        # autotuner decisions (serving/autotune.py): bounded keep-whole
+        # list with the same FIFO drop scheme as _requests, plus
+        # per-knob/outcome counts that survive the drop — the decision
+        # accounting stays exact even after the list wraps
+        self._autotune: List[Tuple[str, str, float, dict]] = []
+        self._max_autotune = 1024
+        self._autotune_dropped = 0
+        self._autotune_counts: Dict[str, Dict[str, int]] = {}
         # -- worker-side shipping state (enable_shipping/ship_delta) --
         self._shipping = False
         self._ship_samples: Dict[str, List[float]] = {}
@@ -514,6 +525,31 @@ class Tracer:
 
     def worker_events(self) -> List[Tuple[str, int, str, float, dict]]:
         return list(self._worker_events)
+
+    def record_autotune(self, name: str, knob: str, t: float,
+                        **args) -> None:
+        """One autotuner decision (serving/autotune.py); args carry
+        old/new/outcome plus the sensor evidence that justified it.
+        Single writer (the controller thread); dict writes under the
+        GIL, same discipline as record_shed."""
+        self._autotune.append((name, knob, t, dict(args)))
+        if len(self._autotune) > self._max_autotune:
+            drop = max(1, self._max_autotune // 4)
+            del self._autotune[:drop]
+            self._autotune_dropped += drop
+        c = self._autotune_counts.get(knob)
+        if c is None:
+            c = self._autotune_counts[knob] = {}
+        outcome = str(args.get("outcome", "unknown"))
+        c[outcome] = c.get(outcome, 0) + 1
+        self._append("i", "autotune", name, f"tune_{knob}", t, 0.0,
+                     args or None)
+
+    def autotune_events(self) -> List[Tuple[str, str, float, dict]]:
+        return list(self._autotune)
+
+    def autotune_counts(self) -> Dict[str, Dict[str, int]]:
+        return {k: dict(v) for k, v in self._autotune_counts.items()}
 
     def worker_counts(self) -> Dict[str, Dict[str, int]]:
         """Per-pool event-kind totals (the summary() view; the full
@@ -881,6 +917,7 @@ class Tracer:
             "inflight": self.inflight_gauges(),
             "sheds": self.shed_counts(),
             "workers": self.worker_counts(),
+            "autotune": self.autotune_counts(),
             "requests": len(self._requests) + self._requests_dropped,
             "children": {str(wid): m
                          for wid, m in self.children().items()},

@@ -56,6 +56,7 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                      mesh: Optional[dict] = None,
                      replicas: Optional[Dict[str, dict]] = None,
                      segments: Optional[Dict[str, dict]] = None,
+                     autotune: Optional[dict] = None,
                      extra: Optional[Dict[str, float]] = None,
                      namespace: str = "nns") -> List[Series]:
     """Flatten runtime state into typed series.
@@ -75,6 +76,10 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                  own admission counters ride the `admission` arg, so
                  Σ nns_host_replied_total == nns_admission_replied_total
                  is checkable from one scrape
+    autotune   — AutoTuner.stats() snapshot (serving/autotune.py):
+                 cumulative decision counters labelled knob/outcome
+                 plus current-knob and SLO gauges, so every applied
+                 decision is visible as an nns_autotune_* series
     extra      — arbitrary numeric gauges {name: value} the caller owns
                  (backend cache sizes, build info, …)
     """
@@ -376,6 +381,46 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                 [({"tenant": t}, float(r["rate_hz"]))
                  for t, r in sorted(tenants.items())]))
 
+    if autotune:
+        decisions = autotune.get("decisions", {})
+        out.append(_series(
+            f"{ns}_autotune_decisions_total", "counter",
+            "autotuner decisions by knob and outcome (applied / "
+            "dry_run / proposed / hysteresis / cooldown / error)",
+            [({"knob": k, "outcome": o}, float(n))
+             for k, d in sorted(decisions.items())
+             for o, n in sorted(d.items())] or
+            [({"knob": "none", "outcome": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_autotune_applied_total", "counter",
+            "autotuner decisions actually actuated",
+            [({}, float(autotune.get("applied_total", 0)))]))
+        out.append(_series(
+            f"{ns}_autotune_audit_dropped_total", "counter",
+            "audit-ring entries aged out by wrap (totals above stay "
+            "exact)",
+            [({}, float(autotune.get("audit_dropped", 0)))]))
+        out.append(_series(
+            f"{ns}_autotune_knob", "gauge",
+            "current value of each controlled knob",
+            [({"knob": k}, float(v))
+             for k, v in sorted(autotune.get("knobs", {}).items())] or
+            [({"knob": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_autotune_dry_run", "gauge",
+            "1 when the controller only records decisions, 0 when it "
+            "actuates",
+            [({}, 1.0 if autotune.get("dry_run") else 0.0)]))
+        slo = autotune.get("slo", {})
+        out.append(_series(
+            f"{ns}_autotune_slo_p99_budget_ms", "gauge",
+            "declared p99 latency budget the controller defends",
+            [({}, float(slo.get("p99_budget_ms", 0.0)))]))
+        out.append(_series(
+            f"{ns}_autotune_slo_goodput_floor_rps", "gauge",
+            "declared goodput floor (0 = none)",
+            [({}, float(slo.get("goodput_floor_rps", 0.0)))]))
+
     if extra:
         for name, value in sorted(extra.items()):
             try:
@@ -584,6 +629,9 @@ _TOP_KEY_FAMILIES = (
     # goodput, queue depth = where the backpressure is, up = fences
     "nns_replica_invokes_total", "nns_replica_queue_depth",
     "nns_replica_up",
+    # autotuner rows: decision rate by knob/outcome + where every
+    # controlled knob sits right now
+    "nns_autotune_decisions_total", "nns_autotune_knob",
     "nns_pool_restarts_total", "nns_trace_events_total",
 )
 

@@ -27,8 +27,8 @@ from nnstreamer_tpu.traffic.admission import (
 from nnstreamer_tpu.traffic.loadgen import (
     EchoServer, bursty_arrivals, merge_tenant_arrivals,
     noisy_neighbor_drill, poisson_arrivals, run_against_echo,
-    run_against_mesh, run_against_pool, run_multitenant,
-    run_open_loop)
+    run_against_mesh, run_against_pool, run_autotune_ramp,
+    run_multitenant, run_open_loop)
 from nnstreamer_tpu.traffic.netchaos import ChaosProxy
 
 __all__ = [
@@ -45,6 +45,7 @@ __all__ = [
     "run_against_echo",
     "run_against_mesh",
     "run_against_pool",
+    "run_autotune_ramp",
     "run_multitenant",
     "run_open_loop",
 ]

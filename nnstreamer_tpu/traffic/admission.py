@@ -171,7 +171,17 @@ class AdmissionQueue:
         behavior), and switching **to** ``deadline-drop`` immediately
         purges entries whose budget already expired — those victims are
         returned and the caller owes each a BUSY (cause ``deadline``),
-        exactly as if the purge had happened on an offer()."""
+        exactly as if the purge had happened on an offer().
+
+        Shrinking ``max_pending`` below the current depth under
+        ``reject-oldest`` immediately sheds the excess oldest entries
+        (cause ``bound_shrink``) — they are returned as victims and the
+        caller owes each a BUSY. Under the other policies queued
+        entries drain naturally (``reject-newest`` only ever refuses
+        arrivals), so the depth falls to the new bound without
+        eviction. Either way nothing is stranded or double-counted:
+        the conservation invariants hold exactly across a live bound
+        change (see tests/test_traffic.py)."""
         victims: List[Any] = []
         with self._lock:
             if max_pending is not None:
@@ -179,6 +189,7 @@ class AdmissionQueue:
                     raise ValueError(
                         f"max_pending must be >= 1, got {max_pending}")
                 self.max_pending = max_pending
+                victims.extend(self._shrink_to_bound_locked())
             if max_inflight is not None:
                 if max_inflight < 0:
                     raise ValueError(
@@ -427,6 +438,50 @@ class AdmissionQueue:
                 victims.extend(mine)
         return victims
 
+    def _shrink_to_bound_locked(self) -> List[Any]:
+        """Mid-stream ``max_pending`` shrink (lock held; called from
+        configure()). Only ``reject-oldest`` displaces queued work, so
+        only that policy sheds here — the oldest excess entries go
+        first, mirroring what the policy does on a full-queue offer.
+        Teardown sentinels (`None` rides the legacy queue via
+        put_nowait) are never evicted. Every victim is counted exactly
+        once — globally and, in tenant mode, on the class that owned
+        it — so ``admitted == replied + shed + depth + inflight``
+        stays exact through the change."""
+        if getattr(self, "shed_policy", None) != "reject-oldest":
+            return []
+        shed = getattr(self, "_shed", None)
+        if shed is None:      # __init__-time configure: queue is empty
+            return []
+        victims: List[Any] = []
+        # tenant mode: trim each class to its recomputed fair-share
+        # bound (the global bound re-shares live through _class_bound)
+        if self._table is not None:
+            for st in self._classes.values():
+                bound = self._class_bound(st)
+                while len(st.q) > bound:
+                    item, _, _ = st.q.popleft()
+                    victims.append(item)
+                    st.shed["bound_shrink"] = \
+                        st.shed.get("bound_shrink", 0) + 1
+                    shed["bound_shrink"] = \
+                        shed.get("bound_shrink", 0) + 1
+        # legacy queue: trim the global excess, oldest first,
+        # skipping sentinels
+        excess = self._total_depth() - self.max_pending
+        if excess > 0 and self._q:
+            kept: deque = deque()
+            for entry in self._q:
+                if excess > 0 and entry[0] is not None:
+                    victims.append(entry[0])
+                    shed["bound_shrink"] = \
+                        shed.get("bound_shrink", 0) + 1
+                    excess -= 1
+                else:
+                    kept.append(entry)
+            self._q = kept
+        return victims
+
     def _retry_after_locked(self) -> float:
         """Suggested client backoff: expected time for the current queue
         to drain at the EWMA service rate, clamped to [1ms, 10s].
@@ -584,6 +639,10 @@ class AdmissionQueue:
                 "max_pending": self.max_pending,
                 "max_inflight": self.max_inflight,
                 "shed_policy": self.shed_policy,
+                # measured per-reply interval (the autotuner's
+                # Little's-law service-rate sensor); None until the
+                # second reply lands
+                "ewma_reply_s": self._ewma_reply_s,
             }
             if self._table is not None:
                 out["classes"] = {

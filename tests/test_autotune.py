@@ -1,0 +1,556 @@
+"""SLO autotuner tests (serving/autotune.py): eager spec validation,
+the guardrail ladder (clamp → hysteresis → cooldown → bounded step),
+dry-run, audit-ring accounting across wrap, staged bucket refinement,
+advisory hints, the tracer/metrics surfaces, and the live closed-loop
+ramp against a real echo server.
+
+All controller tests drive tick() with an injected fake clock and fake
+knob targets — the guardrail semantics are deterministic, no sleeps."""
+
+import json
+
+import pytest
+
+from nnstreamer_tpu.edge import QueryServer
+from nnstreamer_tpu.runtime.tracing import NULL_TRACER, Tracer
+from nnstreamer_tpu.serving.autotune import (
+    DEFAULT_KNOB_RANGES, LITTLE_MARGIN, AutoTuner, KnobRange, SLOSpec)
+from nnstreamer_tpu.serving.metrics import (
+    metrics_snapshot, parse_prometheus, render_prometheus)
+from nnstreamer_tpu.traffic import run_autotune_ramp
+
+pytestmark = pytest.mark.autotune
+
+
+@pytest.fixture(autouse=True)
+def _clean_servers():
+    yield
+    QueryServer.reset_all()
+
+
+# -- fakes -------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeAdmission:
+    """Just enough of AdmissionQueue for the controller: counters()
+    reflecting the injected sensor readings, configure() recording and
+    actually moving max_pending (so the loop sees its own effect)."""
+
+    def __init__(self, max_pending=64, ewma=None, depth=0,
+                 depth_peak=0, shed_policy="reject-newest"):
+        self.max_pending = max_pending
+        self.ewma = ewma
+        self.depth = depth
+        self.depth_peak = depth_peak
+        self.shed_policy = shed_policy
+        self.configured = []
+        self.victims_next = []
+
+    def counters(self):
+        return {"max_pending": self.max_pending,
+                "ewma_reply_s": self.ewma,
+                "depth": self.depth,
+                "depth_peak": self.depth_peak,
+                "shed_policy": self.shed_policy}
+
+    def configure(self, max_pending=None, **kw):
+        self.configured.append(max_pending)
+        self.max_pending = max_pending
+        v, self.victims_next = self.victims_next, []
+        return v
+
+
+class FakeProps(dict):
+    """props dict that journals writes, so tests can assert staging
+    happened strictly before the knob flip."""
+
+    def __init__(self, *a, events=None, **kw):
+        super().__init__(*a, **kw)
+        self.events = events if events is not None else []
+
+    def __setitem__(self, k, v):
+        self.events.append(("set", k, v))
+        super().__setitem__(k, v)
+
+
+class FakeBatch:
+    def __init__(self, max_latency_ms=4.0, max_batch=16, stats=None,
+                 events=None):
+        self.name = "batch0"
+        self.props = FakeProps(
+            {"max_latency_ms": max_latency_ms, "max_batch": max_batch},
+            events=events)
+        self._stats = stats or {}
+
+    def extra_stats(self):
+        return dict(self._stats)
+
+
+class FakeBackend:
+    def __init__(self, hist, events=None):
+        self.batch_size_hist = dict(hist)
+        self.events = events if events is not None else []
+
+    def stage_bucket(self, nb):
+        self.events.append(("stage", nb))
+        return True
+
+
+class FakeFilter:
+    def __init__(self, backend):
+        self.backend = backend
+
+
+class FakeTracer:
+    active = True
+
+    def __init__(self, p99_ms=None, tenant=None):
+        self.p99_ms = p99_ms
+        self.tenant = tenant or {}
+        self.records = []
+
+    def tenant_summary(self):
+        return dict(self.tenant)
+
+    def interlatency(self):
+        if self.p99_ms is None:
+            return {}
+        return {"el": {"p99_ms": self.p99_ms}}
+
+    def record_autotune(self, name, knob, t, **args):
+        self.records.append((name, knob, dict(args)))
+
+
+# -- SLOSpec / KnobRange validation ------------------------------------------
+
+class TestSLOSpecValidation:
+    def test_roundtrip_and_accessors(self):
+        spec = SLOSpec.from_dict({
+            "p99_budget_ms": 90,
+            "goodput_floor_rps": 50,
+            "tenants": {"acme": {"p99_budget_ms": 50}, "free": 200},
+            "knobs": {"max_pending": {"min": 4, "max": 256}}})
+        assert spec.p99_budget_ms == 90.0
+        assert spec.tenant_budget_ms("acme") == 50.0
+        assert spec.tenant_budget_ms("free") == 200.0
+        assert spec.tenant_budget_ms("unknown") == 90.0   # falls back
+        assert spec.knob_range("max_pending") == \
+            KnobRange("max_pending", 4.0, 256.0)
+        # undeclared knobs fall back to the conservative defaults
+        assert spec.knob_range("max_batch") is \
+            DEFAULT_KNOB_RANGES["max_batch"]
+        assert SLOSpec.from_dict(spec.to_dict()) == spec
+
+    def test_from_json(self, tmp_path):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps({"p99_budget_ms": 25}))
+        assert SLOSpec.from_json(str(p)).p99_budget_ms == 25.0
+
+    @pytest.mark.parametrize("d", [
+        [],                                        # not an object
+        {},                                        # missing budget
+        {"p99_budget_ms": 0},                      # budget must be > 0
+        {"p99_budget_ms": -5},
+        {"p99_budget_ms": float("nan")},
+        {"p99_budget_ms": float("inf")},
+        {"p99_budget_ms": True},                   # bool is not a number
+        {"p99_budget_ms": "90"},
+        {"p99_budget_ms": 90, "goodput_floor_rps": -1},
+        {"p99_budget_ms": 90, "tenants": ["acme"]},
+        {"p99_budget_ms": 90, "tenants": {"bad name!": 50}},
+        {"p99_budget_ms": 90, "tenants": {"acme": 0}},
+        {"p99_budget_ms": 90, "tenants": {"acme": {}}},  # needs budget
+        {"p99_budget_ms": 90, "knobs": {"warp_factor":  # unknown knob
+                                        {"min": 1, "max": 9}}},
+        {"p99_budget_ms": 90, "knobs": {"max_pending": {"min": 8}}},
+        {"p99_budget_ms": 90, "knobs": {"max_pending":
+                                        {"min": 64, "max": 8}}},
+    ])
+    def test_malformed_specs_fail_eagerly(self, d):
+        with pytest.raises(ValueError):
+            SLOSpec.from_dict(d)
+
+    def test_knob_range_clamp(self):
+        r = KnobRange("max_pending", 4, 64)
+        assert r.clamp(1) == 4 and r.clamp(999) == 64
+        assert r.clamp(32) == 32
+        with pytest.raises(ValueError, match="min 64.* max 8"):
+            KnobRange("max_pending", 64, 8)
+        with pytest.raises(ValueError):
+            KnobRange("max_pending", float("nan"), 8)
+
+
+# -- the guardrail ladder (fake admission, injected clock) -------------------
+
+class TestGuardrails:
+    def _tuner(self, adm, clock, **kw):
+        kw.setdefault("slo", SLOSpec(p99_budget_ms=60))
+        return AutoTuner(kw.pop("slo"), admission=adm, now=clock, **kw)
+
+    def test_littles_law_convergence_then_hysteresis_hold(self):
+        """ewma 5 ms, budget 60 ms → Little's-law target = 6; from 64
+        the bounded step walks 64→32→16→8→6 and then the hysteresis
+        band holds — the controller settles, it does not hunt."""
+        adm = FakeAdmission(max_pending=64, ewma=0.005)
+        clock = FakeClock()
+        tuner = self._tuner(adm, clock)
+        assert LITTLE_MARGIN * 0.060 / 0.005 == 6.0
+        for _ in range(4):
+            tuner.tick()
+            clock.advance(10.0)        # past the cooldown each time
+        assert adm.configured == [32, 16, 8, 6]
+        for _ in range(5):             # converged: nothing more moves
+            tuner.tick()
+            clock.advance(10.0)
+        assert adm.configured == [32, 16, 8, 6]
+        st = tuner.stats()
+        assert st["decisions"]["max_pending"]["applied"] == 4
+        assert st["decisions"]["max_pending"]["hysteresis"] >= 5
+        assert [r["new"] for r in tuner.audit()] == [32.0, 16.0, 8.0, 6.0]
+
+    def test_hysteresis_bounds_flapping_sensor(self):
+        """A sensor flapping a few percent around the operating point
+        must produce zero knob motion."""
+        adm = FakeAdmission(max_pending=6, ewma=0.0048)
+        clock = FakeClock()
+        tuner = self._tuner(adm, clock)
+        for i in range(20):
+            adm.ewma = 0.0048 if i % 2 == 0 else 0.0052
+            tuner.tick()
+            clock.advance(10.0)
+        assert adm.configured == []
+        st = tuner.stats()
+        assert st["applied_total"] == 0
+        assert st["decisions"]["max_pending"]["hysteresis"] == 20
+        assert st["audit_total"] == 0   # held decisions never hit the ring
+
+    def test_cooldown_blocks_back_to_back_moves(self):
+        adm = FakeAdmission(max_pending=64, ewma=0.005)
+        clock = FakeClock()
+        tuner = self._tuner(adm, clock, cooldown_s=5.0)
+        tuner.tick()
+        assert adm.configured == [32]
+        clock.advance(1.0)             # still inside the cooldown
+        tuner.tick()
+        assert adm.configured == [32]
+        assert tuner.stats()["decisions"]["max_pending"]["cooldown"] == 1
+        clock.advance(10.0)
+        tuner.tick()
+        assert adm.configured == [32, 16]
+
+    def test_dry_run_applies_nothing(self):
+        """The dry_run proof the issue demands: the decision stream is
+        produced and audited, but no configure() ever lands."""
+        adm = FakeAdmission(max_pending=64, ewma=0.005)
+        clock = FakeClock()
+        tuner = self._tuner(adm, clock, dry_run=True)
+        for _ in range(4):
+            tuner.tick()
+            clock.advance(10.0)
+        assert adm.configured == []            # nothing actuated, ever
+        assert adm.max_pending == 64
+        st = tuner.stats()
+        assert st["dry_run"] is True
+        assert st["applied_total"] == 0 and st["dry_run_total"] == 4
+        assert all(r["outcome"] == "dry_run" for r in tuner.audit())
+
+    def test_step_is_bounded_and_clamped_to_declared_range(self):
+        """A wildly wrong sensor cannot slam the knob: one tick moves
+        at most step_frac of the current value, and never outside the
+        declared range."""
+        adm = FakeAdmission(max_pending=64, ewma=10.0)   # target ≈ 0.005
+        clock = FakeClock()
+        spec = SLOSpec.from_dict({
+            "p99_budget_ms": 60,
+            "knobs": {"max_pending": {"min": 16, "max": 128}}})
+        tuner = self._tuner(adm, clock, slo=spec)
+        tuner.tick()
+        assert adm.configured == [32]          # one bounded step, not 16
+        clock.advance(10.0)
+        tuner.tick()
+        assert adm.configured == [32, 16]      # clamped at declared min
+        clock.advance(10.0)
+        tuner.tick()
+        assert adm.configured == [32, 16]      # held at the floor
+
+    def test_audit_ring_wraps_with_exact_accounting(self):
+        """audit_size=4, cooldown off, sensor flipped hard every tick →
+        every tick applies; the ring keeps the newest 4 while the
+        totals stay exact: audit_total - audit_len == audit_dropped and
+        the outcome counters account for every recorded decision."""
+        adm = FakeAdmission(max_pending=64, ewma=0.02)
+        clock = FakeClock()
+        tuner = self._tuner(adm, clock, cooldown_s=0.0, audit_size=4)
+        for i in range(10):
+            adm.ewma = 0.02 if i % 2 == 0 else 0.002
+            tuner.tick()
+            clock.advance(1.0)
+        assert len(adm.configured) == 10
+        st = tuner.stats()
+        assert st["audit_total"] == 10
+        assert st["audit_len"] == 4
+        assert st["audit_dropped"] == 6
+        assert st["audit_total"] - st["audit_len"] == st["audit_dropped"]
+        assert st["decisions"]["max_pending"]["applied"] == 10
+        # the ring holds exactly the newest 4 applied values
+        assert [r["new"] for r in tuner.audit()] == \
+            [float(v) for v in adm.configured[-4:]]
+
+    def test_shrink_victims_routed_to_callback(self):
+        adm = FakeAdmission(max_pending=64, ewma=0.005)
+        adm.victims_next = ["v1", "v2"]
+        clock = FakeClock()
+        got_victims, got_applied = [], []
+        tuner = self._tuner(adm, clock, on_victims=got_victims.extend,
+                            on_apply=got_applied.append)
+        tuner.tick()
+        assert got_victims == ["v1", "v2"]
+        assert [r["knob"] for r in got_applied] == ["max_pending"]
+        assert got_applied[0]["evidence"]["ewma_reply_s"] == 0.005
+
+    def test_actuation_failure_is_an_error_outcome(self):
+        class Broken(FakeAdmission):
+            def configure(self, **kw):
+                raise RuntimeError("boom")
+
+        adm = Broken(max_pending=64, ewma=0.005)
+        clock = FakeClock()
+        tuner = self._tuner(adm, clock)
+        tuner.tick()                   # must not raise out of the loop
+        st = tuner.stats()
+        assert st["decisions"]["max_pending"]["error"] == 1
+        assert tuner.audit()[-1]["outcome"] == "error"
+
+
+# -- batch-deadline stage ----------------------------------------------------
+
+class TestBatchDeadline:
+    def test_shrinks_deadline_when_budget_threatened(self):
+        el = FakeBatch(max_latency_ms=8.0, max_batch=16)
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=100),
+                          batch_elements=(el,),
+                          tracer=FakeTracer(p99_ms=90.0),
+                          now=FakeClock())
+        recs = tuner.tick()
+        assert el.props["max_latency_ms"] == 4.0
+        (rec,) = recs
+        assert rec["knob"] == "batch_deadline_ms"
+        assert rec["target"] == "batch0"       # which element moved
+        assert rec["evidence"]["p99_ms"] == 90.0
+
+    def test_grows_deadline_on_headroom_and_half_empty_batches(self):
+        el = FakeBatch(max_latency_ms=4.0, max_batch=16,
+                       stats={"batches_out": 10, "occupancy_avg": 2.0})
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=100),
+                          batch_elements=(el,),
+                          tracer=FakeTracer(p99_ms=30.0),
+                          now=FakeClock())
+        tuner.tick()
+        assert el.props["max_latency_ms"] == 6.0   # one bounded step up
+
+    def test_holds_inside_the_band(self):
+        el = FakeBatch(max_latency_ms=4.0, max_batch=16,
+                       stats={"batches_out": 10, "occupancy_avg": 2.0})
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=100),
+                          batch_elements=(el,),
+                          tracer=FakeTracer(p99_ms=60.0),
+                          now=FakeClock())
+        assert tuner.tick() == []
+        assert el.props["max_latency_ms"] == 4.0
+
+    def test_no_tracer_no_motion(self):
+        el = FakeBatch(max_latency_ms=4.0)
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=100),
+                          batch_elements=(el,), now=FakeClock())
+        assert tuner.tick() == []
+
+
+# -- bucket refinement stage -------------------------------------------------
+
+class TestBucketRefinement:
+    def test_refines_to_observed_pow2_staging_before_flip(self):
+        """p95 observed batch is 3 → bucket 4; from max_batch 16 the
+        bounded step walks 16→8→4, and each move stages the bucket on
+        the backend strictly before flipping the knob."""
+        events = []
+        el = FakeBatch(max_batch=16, events=events)
+        be = FakeBackend({3: 50}, events=events)
+        clock = FakeClock()
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=100),
+                          batch_elements=(el,),
+                          filters=(FakeFilter(be),), now=clock)
+        tuner.tick()
+        assert events == [("stage", 8), ("set", "max_batch", 8)]
+        clock.advance(10.0)
+        tuner.tick()
+        assert el.props["max_batch"] == 4
+        assert events[-2:] == [("stage", 4), ("set", "max_batch", 4)]
+        clock.advance(10.0)
+        assert tuner.tick() == []      # at the target bucket: settled
+
+    def test_refinement_is_shrink_only(self):
+        """Observed batches larger than max_batch never raise it — the
+        negotiated ceiling is not the controller's to lift."""
+        el = FakeBatch(max_batch=16)
+        be = FakeBackend({32: 50})
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=100),
+                          batch_elements=(el,),
+                          filters=(FakeFilter(be),), now=FakeClock())
+        assert tuner.tick() == []
+        assert el.props["max_batch"] == 16
+
+    def test_needs_enough_signal(self):
+        el = FakeBatch(max_batch=16)
+        be = FakeBackend({3: 7})       # fewer than 8 observed invokes
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=100),
+                          batch_elements=(el,),
+                          filters=(FakeFilter(be),), now=FakeClock())
+        assert tuner.tick() == []
+
+
+# -- advisory hints (proposed, never actuated) -------------------------------
+
+class TestHints:
+    def test_scale_up_proposed_under_goodput_floor(self):
+        adm = FakeAdmission(max_pending=8, ewma=0.1, depth=4,
+                            depth_peak=8)
+        clock = FakeClock()
+        # budget picked so the Little's-law target equals the current
+        # bound — the admission stage holds and only the hint fires
+        tuner = AutoTuner(
+            SLOSpec(p99_budget_ms=1600, goodput_floor_rps=50),
+            admission=adm, now=clock)
+        recs = [r for r in tuner.tick() if r["knob"] == "pool_slots"]
+        (rec,) = recs
+        assert rec["outcome"] == "proposed" and rec["new"] == "scale_up"
+        assert adm.configured == []    # a hint is never actuated
+        clock.advance(10.0)
+        # same situation → deduped, not re-recorded every tick
+        assert [r for r in tuner.tick() if r["knob"] == "pool_slots"] \
+            == []
+        st = tuner.stats()
+        assert st["proposed_total"] == 1
+        assert st["hints"] == {"pool_slots": "scale_up"}
+
+    def test_shed_policy_proposed_when_budget_missed_at_saturation(self):
+        adm = FakeAdmission(max_pending=8, ewma=None, depth=8,
+                            depth_peak=8, shed_policy="reject-newest")
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=100), admission=adm,
+                          tracer=FakeTracer(p99_ms=150.0),
+                          now=FakeClock())
+        (rec,) = tuner.tick()
+        assert rec["knob"] == "shed_policy"
+        assert rec["outcome"] == "proposed"
+        assert (rec["old"], rec["new"]) == \
+            ("reject-newest", "reject-oldest")
+        assert adm.configured == []
+
+
+# -- tracer + metrics surfaces -----------------------------------------------
+
+class TestObservability:
+    def test_decisions_land_on_the_tracer(self):
+        tr = Tracer()
+        adm = FakeAdmission(max_pending=64, ewma=0.005)
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=60), admission=adm,
+                          tracer=tr, now=FakeClock())
+        tuner.tick()
+        ((name, knob, _t, args),) = tr.autotune_events()
+        assert (name, knob) == ("autotune", "max_pending")
+        assert args["outcome"] == "applied"
+        assert (args["old"], args["new"]) == (64.0, 32.0)
+        assert tr.autotune_counts() == {"max_pending": {"applied": 1}}
+        assert tr.summary()["autotune"] == tr.autotune_counts()
+
+    def test_tracer_ring_wraps_with_exact_counts(self):
+        tr = Tracer()
+        for i in range(1030):
+            tr.record_autotune("autotune", "max_pending", float(i),
+                               old=1, new=2, outcome="applied")
+        assert len(tr.autotune_events()) == 1030 - 256
+        assert tr.autotune_counts() == \
+            {"max_pending": {"applied": 1030}}   # exact across the drop
+
+    def test_null_tracer_is_a_no_op(self):
+        NULL_TRACER.record_autotune("autotune", "max_pending", 0.0,
+                                    old=1, new=2, outcome="applied")
+
+    def test_metrics_snapshot_exports_autotune_series(self):
+        adm = FakeAdmission(max_pending=64, ewma=0.005)
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=60,
+                                  goodput_floor_rps=10),
+                          admission=adm, now=FakeClock())
+        tuner.tick()
+        series = metrics_snapshot(autotune=tuner.stats())
+        by_name = {s["name"]: s for s in series}
+        assert by_name["nns_autotune_applied_total"]["samples"] == \
+            [({}, 1.0)]
+        dec = by_name["nns_autotune_decisions_total"]["samples"]
+        assert ({"knob": "max_pending", "outcome": "applied"}, 1.0) in dec
+        knob = dict((lbl["knob"], v) for lbl, v in
+                    by_name["nns_autotune_knob"]["samples"])
+        assert knob["max_pending"] == 32.0
+        assert by_name["nns_autotune_slo_p99_budget_ms"]["samples"] == \
+            [({}, 60.0)]
+        assert by_name["nns_autotune_dry_run"]["samples"] == [({}, 0.0)]
+        text = render_prometheus(series)
+        assert "nns_autotune_decisions_total" in parse_prometheus(text)
+
+    def test_metrics_snapshot_renders_before_any_decision(self):
+        tuner = AutoTuner(SLOSpec(p99_budget_ms=60), now=FakeClock())
+        series = metrics_snapshot(autotune=tuner.stats())
+        by_name = {s["name"]: s for s in series}
+        # label-less fallback keeps the family present (and parseable)
+        assert by_name["nns_autotune_decisions_total"]["samples"] == \
+            [({"knob": "none", "outcome": "none"}, 0.0)]
+        render_prometheus(series)
+
+
+# -- the live closed loop ----------------------------------------------------
+
+class TestClosedLoopRamp:
+    def test_tuned_ramp_zero_lost_with_audited_decisions(self):
+        """Overload ramp against a real echo server with the tuner
+        bound to the live admission queue: every request resolves, the
+        books close exactly after every applied knob change, and every
+        applied decision is in the audit ring."""
+        r = run_autotune_ramp(ramp=(1.5, 2.5), n_per_step=60,
+                              service_ms=4.0, static_max_pending=64,
+                              tick_interval_s=0.05, cooldown_s=0.1,
+                              seed=3)
+        assert r["lost"] == 0 and not r["server_crashed"]
+        assert r["conservation_final"]
+        assert r["conservation_after_apply"], \
+            "tuner never applied a decision — loop not closed"
+        assert all(r["conservation_after_apply"])
+        st = r["autotune"]
+        assert st["applied_total"] >= 1
+        assert r["admission"]["max_pending"] < 64   # it shrank the queue
+        in_ring = [a for a in r["audit"] if a["outcome"] == "applied"]
+        assert len(in_ring) == st["applied_total"]
+        assert st["audit_dropped"] == 0
+
+    def test_dry_run_ramp_changes_no_knob(self):
+        """In-vivo dry-run proof: the same overload produces the same
+        decision stream, but the live queue's max_pending never moves
+        off the hand-set value."""
+        r = run_autotune_ramp(ramp=(1.5, 2.5), n_per_step=60,
+                              service_ms=4.0, static_max_pending=64,
+                              tick_interval_s=0.05, cooldown_s=0.1,
+                              dry_run=True, seed=3)
+        assert r["lost"] == 0 and not r["server_crashed"]
+        assert r["conservation_final"]
+        st = r["autotune"]
+        assert st["applied_total"] == 0
+        assert st["dry_run_total"] >= 1
+        assert r["admission"]["max_pending"] == 64

@@ -171,6 +171,128 @@ class TestAdmissionQueue:
         assert d.retry_after_ms == 10_000.0    # upper clamp
 
 
+# -- live max_pending shrink (ISSUE 15 satellite) ----------------------------
+
+class TestConfigureShrink:
+    """Shrinking max_pending below the live depth must never strand or
+    double-count an entry: under reject-oldest the excess oldest
+    entries are shed (cause bound_shrink) and returned as victims; the
+    conservation invariants hold exactly at every step."""
+
+    def test_shrink_sheds_oldest_exactly_once(self):
+        q = AdmissionQueue(max_pending=8, shed_policy="reject-oldest")
+        for i in range(8):
+            assert q.offer(i).admitted
+        victims = q.configure(max_pending=3)
+        assert victims == [0, 1, 2, 3, 4]      # oldest first
+        c = q.counters()
+        assert c["shed"] == {"bound_shrink": 5}
+        assert c["depth"] == 3 and _conserved(c)
+        # survivors drain in FIFO order, nothing stranded
+        assert [q.get(timeout=0.1) for _ in range(3)] == [5, 6, 7]
+
+    def test_shrink_under_other_policies_drains_naturally(self):
+        for policy in ("reject-newest", "deadline-drop"):
+            q = AdmissionQueue(max_pending=8, shed_policy=policy)
+            for i in range(8):
+                assert q.offer(i).admitted
+            assert q.configure(max_pending=3) == []
+            c = q.counters()
+            assert c["depth"] == 8 and c["shed"] == {} and _conserved(c)
+            # the bound still applies to new arrivals immediately
+            assert not q.offer(99).admitted
+
+    def test_shrink_never_evicts_sentinel(self):
+        q = AdmissionQueue(max_pending=8, shed_policy="reject-oldest")
+        for i in range(4):
+            q.offer(i)
+        q.put_nowait(None)                     # teardown sentinel
+        victims = q.configure(max_pending=2)
+        assert None not in victims
+        assert victims == [0, 1, 2]
+        drained = [q.get(timeout=0.1) for _ in range(2)]
+        assert drained == [3, None]            # sentinel survived
+
+    def test_shrink_grow_shrink_keeps_books_exact(self):
+        q = AdmissionQueue(max_pending=16, shed_policy="reject-oldest")
+        for i in range(16):
+            q.offer(i)
+        q.configure(max_pending=5)
+        assert _conserved(q.counters())
+        q.configure(max_pending=32)            # growth sheds nothing
+        c = q.counters()
+        assert c["depth"] == 5 and _conserved(c)
+        for i in range(100, 110):
+            q.offer(i)
+        q.configure(max_pending=2)
+        c = q.counters()
+        assert c["depth"] == 2 and _conserved(c)
+
+    def test_tenant_mode_shrink_trims_per_class_bounds(self):
+        from nnstreamer_tpu.serving.tenancy import (
+            TENANT_META, TenantTable)
+        from nnstreamer_tpu.traffic.loadgen import (
+            _tenant_conservation_ok)
+
+        q = AdmissionQueue(max_pending=8, shed_policy="reject-oldest")
+        q.set_tenants(TenantTable.from_dict(
+            {"default": "a", "tenants": [
+                {"name": "a", "weight": 1.0},
+                {"name": "b", "weight": 1.0}]}))
+        for i in range(4):
+            for t in ("a", "b"):
+                d = q.offer(SimpleNamespace(meta={TENANT_META: t},
+                                            pts=i))
+                assert d.admitted
+        victims = q.configure(max_pending=4)   # bounds 4+4 -> 2+2
+        assert len(victims) == 4
+        c = q.counters()
+        assert c["shed"] == {"bound_shrink": 4}
+        for cls in ("a", "b"):
+            assert c["classes"][cls]["shed"] == {"bound_shrink": 2}
+            assert c["classes"][cls]["depth"] == 2
+        assert _tenant_conservation_ok(c)
+
+    def test_conservation_exact_under_flood_with_live_shrinks(self):
+        """The regression the satellite asks for: a producer floods,
+        a consumer serves, and the bound is yanked up and down live —
+        the books must close exactly at every sampled instant."""
+        q = AdmissionQueue(max_pending=32, shed_policy="reject-oldest")
+        stop = threading.Event()
+
+        def flood():
+            i = 0
+            while not stop.is_set():
+                q.offer(i)
+                i += 1
+
+        def serve():
+            while not stop.is_set():
+                try:
+                    item = q.get(timeout=0.01)
+                except _queue.Empty:
+                    continue
+                if item is not None:
+                    q.note_replied()
+
+        threads = [threading.Thread(target=flood, daemon=True),
+                   threading.Thread(target=serve, daemon=True)]
+        for t in threads:
+            t.start()
+        try:
+            for mp in (4, 32, 3, 16, 2, 32) * 5:
+                q.configure(max_pending=mp)
+                assert _conserved(q.counters()), \
+                    f"books broke right after shrink to {mp}"
+                time.sleep(0.002)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=2)
+        assert _conserved(q.counters())
+        assert q.counters()["shed"].get("bound_shrink", 0) > 0
+
+
 # -- arrival processes -------------------------------------------------------
 
 class TestArrivals:
