@@ -1597,13 +1597,15 @@ LLM_P99_BUDGET_MS = float(os.environ.get("BENCH_LLM_P99_BUDGET_MS",
 
 
 def _llm_serve_arm(scheduling: str, arrivals, prompts,
-                   max_news) -> dict:
+                   max_news, llm_props=None) -> dict:
     """One open-loop serving run: requests are pushed at their PRE-DRAWN
     Poisson arrival times regardless of completions (closed-loop pushing
     would let a slow server throttle its own offered load and flatter
     its tail). Both arms replay the identical arrival trace. prewarm=
     compiles every bucket at start(), before the clock starts — the
-    arms compare scheduling policy, not compile luck."""
+    arms compare scheduling policy, not compile luck. `llm_props`
+    overrides/extends the tensor_llm properties (the attn point swaps
+    paged_kernel / prefill_chunk on an otherwise identical server)."""
     import threading
 
     import numpy as np
@@ -1613,12 +1615,13 @@ def _llm_serve_arm(scheduling: str, arrivals, prompts,
     from nnstreamer_tpu.tensor.buffer import TensorBuffer
     from nnstreamer_tpu.tensor.info import TensorFormat, TensorsSpec
 
+    props = dict(model="store://transformer", scheduling=scheduling,
+                 max_batch=8, block_size=16, num_blocks=96, max_len=128,
+                 prewarm=max(len(p) for p in prompts))
+    props.update(llm_props or {})
     src = AppSrc(name="src", spec=TensorsSpec(
         tensors=(), format=TensorFormat.FLEXIBLE))
-    llm = TensorLLM(name="llm", model="store://transformer",
-                    scheduling=scheduling, max_batch=8, block_size=16,
-                    num_blocks=96, max_len=128,
-                    prewarm=max(len(p) for p in prompts))
+    llm = TensorLLM(name="llm", **props)
     done_at: dict = {}
     tokens_recv = [0]
     lock = threading.Lock()
@@ -1724,7 +1727,53 @@ def llm_serve() -> dict:
         if stat["tokens_per_s"] else 0.0
     if not out["goodput_win"]:
         out["unverified"] = True   # ship the numbers, flag the claim
+    # paged-kernel point: pallas vs xla on one trace with a long prompt
+    # chunk-prefilling under the decode batch. On CPU (interpret-mode
+    # Pallas is orders slower than XLA) it is a conservation/parity
+    # gate behind BENCH_LLM_ATTN_GATE=1; on TPU it always runs and the
+    # ratio is the measurement.
+    if os.environ.get("BENCH_LLM_ATTN_GATE") == "1" or _on_tpu():
+        out["attn"] = _llm_attn_point(arrivals, prompts, max_news)
+        _family_partial(dict(out))
+        if not out["attn"]["zero_lost"]:
+            out["unverified"] = True
     return out
+
+
+def _llm_attn_point(arrivals, prompts, max_news) -> dict:
+    """pallas-vs-xla serving arms on one arrival trace: identical
+    requests plus one long prompt injected at t=0 so chunked prefill
+    (prefill_chunk=32) runs concurrently with live decodes. Gate:
+    both arms lose zero requests and emit the same token count (no
+    EOS ⇒ the count is deterministic); the decode tokens/s ratio is
+    the recorded measurement for on-chip runs."""
+    import numpy as np
+
+    rng = np.random.default_rng(99)
+    long_prompt = rng.integers(0, 256, size=96).astype(np.int32)
+    prompts2 = [long_prompt] + list(prompts)
+    arrivals2 = [0.0] + [float(a) + 0.05 for a in arrivals]
+    max_news2 = [16] + list(max_news)
+    res = {"prefill_chunk": 32, "long_prompt_len": 96}
+    for kern in ("xla", "pallas"):
+        arm = _llm_serve_arm(
+            "continuous", arrivals2, prompts2, max_news2,
+            llm_props={"paged_kernel": kern, "prefill_chunk": 32})
+        res[kern] = arm
+        _family_partial(dict(res))
+    xla, pal = res["xla"], res["pallas"]
+    res["zero_lost"] = (
+        xla["completed"] == xla["requests"] and
+        pal["completed"] == pal["requests"] and
+        xla["tokens_out"] == pal["tokens_out"])
+    res["decode_tokens_per_s_ratio"] = round(
+        pal["tokens_per_s"] / xla["tokens_per_s"], 3) \
+        if xla["tokens_per_s"] else 0.0
+    res["pallas_served"] = pal.get("executor", {}).get(
+        "kernel_invokes", {})
+    res["pallas_fallbacks"] = pal.get("executor", {}).get(
+        "kernel_fallback", 0)
+    return res
 
 
 #: traffic family: fraction-of-capacity sweep points. Below-knee points

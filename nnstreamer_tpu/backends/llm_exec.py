@@ -14,7 +14,19 @@ Buckets:
   compile-cache manifest — replayed by ``warm_start`` so a restarted
   server compiles its prompt working set off the hot path);
 - decode: active-row count padded to pow2 (``("llmd", B)``), padding
-  rows write to the scratch block.
+  rows write to the scratch block;
+- chunk: one fixed prompt-chunk bucket (``("llmp_chunk", C)``) — every
+  chunk of a chunked prefill, including the short final one, pads to
+  the same bucket so the whole family is one executable.
+
+Kernel selection (``paged_kernel`` prop / ``NNS_PAGED_KERNEL`` env,
+default ``xla``): the attention inner loop is either the XLA reference
+(`llm/paged_model.py` — the bit-parity path against
+`transformer.generate`) or the paged Pallas flash kernels
+(`backends/pallas_paged.py` — the r05 9.2–165x path). The kernel is
+part of the jit key, invocations are counted per kernel, and a Pallas
+path that cannot build here becomes a *counted* XLA fallback
+(`kernel_fallback`), never an error.
 
 Weights are passed as jit *arguments* (not closed over), so a same-
 shape hot swap is served by the already-compiled executable — the
@@ -24,8 +36,9 @@ widths, which compile fresh under their own keys.
 
 from __future__ import annotations
 
+import os
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -71,12 +84,31 @@ class PagedLLMExecutor:
 
     def __init__(self, model="store://transformer", *, n_heads: int = 4,
                  dtype=None, block_size: int = 16, num_blocks: int = 64,
-                 max_len: int = 128, tracer=NULL_TRACER,
-                 name: str = "llm"):
+                 max_len: int = 128, paged_kernel: Optional[str] = None,
+                 tracer=NULL_TRACER, name: str = "llm"):
         import jax.numpy as jnp
 
         self.name = name
         self.tracer = tracer
+        self.kernel_fallback = 0
+        self.kernel_invokes: Dict[str, int] = {"pallas": 0, "xla": 0}
+        kern = (paged_kernel or os.environ.get("NNS_PAGED_KERNEL")
+                or "xla").strip().lower()
+        if kern not in ("pallas", "xla"):
+            raise BackendError(
+                f"paged_kernel must be 'pallas' or 'xla', got {kern!r}")
+        if kern == "pallas":
+            from nnstreamer_tpu.backends import pallas_paged
+
+            if not pallas_paged.available():
+                log.warning(
+                    "llm %s: paged_kernel=pallas requested but the "
+                    "Pallas paged kernels are unavailable here — "
+                    "serving on the XLA reference (counted fallback)",
+                    name)
+                self.kernel_fallback += 1
+                kern = "xla"
+        self.paged_kernel = kern
         self.n_heads = int(n_heads)
         self.dtype = jnp.dtype(dtype) if dtype is not None \
             else jnp.float32
@@ -122,6 +154,7 @@ class PagedLLMExecutor:
         self.cache_hits = 0
         self.cache_misses = 0
         self.prefills = 0
+        self.chunk_prefills = 0
         self.decode_steps = 0
 
     # -- store integration -------------------------------------------------
@@ -175,36 +208,98 @@ class PagedLLMExecutor:
             self._entry.note_bucket(self._version, bucket_key)
 
     # -- jit cache ---------------------------------------------------------
+    def _kind_kernel(self, kind: str) -> str:
+        """Which attention kernel serves `kind`. The full-sequence
+        prefill is always the XLA `apply_seq_kv` path (it is the bit-
+        parity anchor against `transformer.generate`); chunk and decode
+        follow the selected kernel."""
+        return "xla" if kind == "prefill" else self.paged_kernel
+
+    def _prefill_kind(self) -> str:
+        """Whole-prompt prefills route through the chunk family (one
+        chunk covering the prompt) when the selected kernel is Pallas or
+        the bound params are W8A8-quantized — `apply_seq_kv` is float-
+        only and kernel-fixed; the chunk path is quant-aware and
+        kernel-selectable. Float + xla keeps the original path, so the
+        token-for-token `generate` parity contract is untouched there."""
+        if self.paged_kernel == "pallas":
+            return "chunk"
+        try:
+            if "wqkv_scale" in self.params["blocks"][0]:
+                return "chunk"
+        except (KeyError, IndexError, TypeError):
+            pass
+        return "prefill"
+
     def _get_jit(self, kind: str, bucket: int, version=None):
         import jax
 
         from nnstreamer_tpu.llm.paged_model import (
-            paged_decode_step, paged_prefill)
+            paged_decode_step, paged_prefill, paged_prefill_chunk)
 
-        key = (self._ns(version), kind, bucket)
+        kernel = self._kind_kernel(kind)
+        key = (self._ns(version), kind, bucket, kernel)
         jitted = self._jits.get(key)
         if jitted is not None:
             self.cache_hits += 1
             return jitted, False
         self.cache_misses += 1
-        fn = paged_prefill if kind == "prefill" else paged_decode_step
+        if kind == "prefill":
+            fn, donate = paged_prefill, (4, 5)
+        elif kind == "chunk":
+            if kernel == "pallas":
+                from nnstreamer_tpu.backends.pallas_paged import (
+                    paged_flash_prefill_chunk)
+                fn = paged_flash_prefill_chunk
+            else:
+                fn = paged_prefill_chunk
+            donate = (6, 7)
+        else:
+            if kernel == "pallas":
+                from nnstreamer_tpu.backends.pallas_paged import (
+                    paged_flash_decode_step)
+                fn = paged_flash_decode_step
+            else:
+                fn = paged_decode_step
+            donate = (4, 5)
         jitted = jax.jit(fn, static_argnames=("n_heads", "dtype"),
-                         donate_argnums=(4, 5))
+                         donate_argnums=donate)
         self._jits[key] = jitted
         return jitted, True
+
+    def _kernel_fallback_to_xla(self, kind: str, exc: Exception) -> None:
+        """A fresh Pallas compile failed at serve time: flip the whole
+        executor to the XLA reference (sticky — one flip, not one per
+        call), count it, and keep serving. Never an error."""
+        log.warning(
+            "llm %s: pallas %s kernel failed to build (%s: %s) — "
+            "falling back to the XLA reference", self.name, kind,
+            type(exc).__name__, exc)
+        self.kernel_fallback += 1
+        self.paged_kernel = "xla"
 
     def _span(self, kind: str, t0: float, t1: float, **args) -> None:
         if self.tracer.active:
             self.tracer.backend_span(self.name, kind, t0, t1, **args)
 
     # -- prefill -----------------------------------------------------------
-    def prefill(self, prompt: np.ndarray, block_table: List[int]):
-        """One prompt through the bucketed full-sequence prefill; its
-        KV lands in the pool blocks of `block_table`. Returns last-token
-        logits as a host (vocab,) f32 array."""
+    def prefill(self, prompt: np.ndarray, block_table: List[int],
+                *, sync: bool = True):
+        """One whole prompt; its KV lands in the pool blocks of
+        `block_table`. Dispatches between the full-sequence
+        `apply_seq_kv` path and the chunk family (`_prefill_kind` —
+        pallas / quantized stores go through the chunk path, as one
+        chunk covering the prompt). Returns last-token logits: a host
+        (vocab,) f32 array when `sync`, else the device array so the
+        engine can batch one `device_sync` over a whole step's
+        admissions."""
         from nnstreamer_tpu.backends.xla import _next_pow2
 
         plen = int(prompt.shape[0])
+        if self._prefill_kind() == "chunk":
+            return self.prefill_chunk(
+                prompt, 0, block_table,
+                bucket=_next_pow2(plen, 8), sync=sync)
         s_b = _next_pow2(plen, 8)
         bs = self.cache.block_size
         ids = np.zeros((1, s_b), np.int32)
@@ -220,24 +315,88 @@ class PagedLLMExecutor:
             self.cache.v, np.int32(plen - 1), n_heads=self.n_heads,
             dtype=self.dtype)
         out = np.asarray(device_sync(
-            logits, tracer=self.tracer, name=f"{self.name}:prefill"))
+            logits, tracer=self.tracer,
+            name=f"{self.name}:prefill")) if sync else logits
         t1 = time.perf_counter()
         if fresh:
             self.compile_count += 1
-            self._span("compile", t0, t1, what="llm_prefill", bucket=s_b)
+            self._span("compile", t0, t1, what="llm_prefill", bucket=s_b,
+                       kernel="xla")
             self._note_bucket(("llmp", s_b))
         else:
             self._span("invoke", t0, t1, what="llm_prefill", bucket=s_b,
-                       plen=plen)
+                       plen=plen, kernel="xla")
         self.prefills += 1
+        self.kernel_invokes["xla"] += 1
+        return out
+
+    def prefill_chunk(self, chunk: np.ndarray, pos0: int,
+                      block_table: List[int], *, bucket: int = 0,
+                      sync: bool = True):
+        """One prompt chunk starting at absolute position `pos0`,
+        scattered into `block_table`'s blocks and attending the whole
+        prefix written so far. `bucket` pins the pad width so every
+        chunk of a prompt (the short final one included) hits one
+        executable; 0 = pow2 of this chunk. Returns the chunk's
+        last-token logits (host when `sync`, device otherwise) — only
+        the final chunk's value is meaningful to sampling."""
+        from nnstreamer_tpu.backends.xla import _next_pow2
+
+        clen = int(chunk.shape[0])
+        c_b = max(int(bucket) or 0, _next_pow2(clen, 8))
+        bs = self.cache.block_size
+        ids = np.zeros((1, c_b), np.int32)
+        ids[0, :clen] = chunk
+        blk_idx = np.full((c_b,), SCRATCH_BLOCK, np.int32)
+        pos = int(pos0) + np.arange(clen)
+        blk_idx[:clen] = np.asarray(block_table, np.int32)[pos // bs]
+        blk_off = ((int(pos0) + np.arange(c_b)) % bs).astype(np.int32)
+        tab = np.full((self.max_blocks,), SCRATCH_BLOCK, np.int32)
+        tab[:len(block_table)] = block_table
+        args = (ids, blk_idx, blk_off, tab, np.int32(clen - 1))
+
+        def _run():
+            jitted, fresh = self._get_jit("chunk", c_b)
+            logits, self.cache.k, self.cache.v = jitted(
+                self.params, args[0], np.int32(pos0), args[1], args[2],
+                args[3], self.cache.k, self.cache.v, args[4],
+                n_heads=self.n_heads, dtype=self.dtype)
+            return logits, fresh
+
+        t0 = time.perf_counter()
+        try:
+            logits, fresh = _run()
+        except Exception as e:
+            if self.paged_kernel != "pallas":
+                raise
+            self._kernel_fallback_to_xla("chunk", e)
+            logits, fresh = _run()
+        kernel = self._kind_kernel("chunk")
+        out = np.asarray(device_sync(
+            logits, tracer=self.tracer,
+            name=f"{self.name}:prefill_chunk")) if sync else logits
+        t1 = time.perf_counter()
+        if fresh:
+            self.compile_count += 1
+            self._span("compile", t0, t1, what="llm_prefill_chunk",
+                       bucket=c_b, kernel=kernel)
+            self._note_bucket(("llmp_chunk", c_b))
+        else:
+            self._span("invoke", t0, t1, what="llm_prefill_chunk",
+                       bucket=c_b, clen=clen, kernel=kernel)
+        self.chunk_prefills += 1
+        self.kernel_invokes[kernel] += 1
         return out
 
     # -- decode ------------------------------------------------------------
     def decode(self, cur: List[int], tables: List[List[int]],
-               pos: List[int]) -> np.ndarray:
+               pos: List[int], *, sync: bool = True):
         """One decode step for `len(cur)` live rows (bucketed to pow2;
-        padding rows write to the scratch block). Returns host logits
-        (n, vocab) f32 for the live rows only."""
+        padding rows write to the scratch block). With `sync` (default)
+        returns host logits (n, vocab) f32 for the live rows only; with
+        sync=False returns the padded device array (b_b, vocab) so the
+        engine can fold this step's decode into its single whole-step
+        `device_sync` (caller slices [:n] after syncing)."""
         from nnstreamer_tpu.backends.xla import _next_pow2
 
         n = len(cur)
@@ -249,22 +408,37 @@ class PagedLLMExecutor:
             tab_a[i, :len(t)] = t
         pos_a = np.zeros((b_b,), np.int32)
         pos_a[:n] = pos
-        jitted, fresh = self._get_jit("decode", b_b)
+
+        def _run():
+            jitted, fresh = self._get_jit("decode", b_b)
+            logits, self.cache.k, self.cache.v = jitted(
+                self.params, cur_a, tab_a, pos_a, self.cache.k,
+                self.cache.v, n_heads=self.n_heads, dtype=self.dtype)
+            return logits, fresh
+
         t0 = time.perf_counter()
-        logits, self.cache.k, self.cache.v = jitted(
-            self.params, cur_a, tab_a, pos_a, self.cache.k,
-            self.cache.v, n_heads=self.n_heads, dtype=self.dtype)
+        try:
+            logits, fresh = _run()
+        except Exception as e:
+            if self.paged_kernel != "pallas":
+                raise
+            self._kernel_fallback_to_xla("decode", e)
+            logits, fresh = _run()
+        kernel = self._kind_kernel("decode")
         out = np.asarray(device_sync(
-            logits, tracer=self.tracer, name=f"{self.name}:decode"))[:n]
+            logits, tracer=self.tracer,
+            name=f"{self.name}:decode"))[:n] if sync else logits
         t1 = time.perf_counter()
         if fresh:
             self.compile_count += 1
-            self._span("compile", t0, t1, what="llm_decode", bucket=b_b)
+            self._span("compile", t0, t1, what="llm_decode", bucket=b_b,
+                       kernel=kernel)
             self._note_bucket(("llmd", b_b))
         else:
             self._span("invoke", t0, t1, what="llm_decode", bucket=b_b,
-                       rows=n)
+                       rows=n, kernel=kernel)
         self.decode_steps += 1
+        self.kernel_invokes[kernel] += 1
         return out
 
     # -- warm paths --------------------------------------------------------
@@ -277,7 +451,7 @@ class PagedLLMExecutor:
         populates the jit's dispatch cache, so the first *served*
         request is a cache hit, not a second compile. Returns whether a
         fresh executable was built."""
-        key = (self._ns(version), kind, bucket)
+        key = (self._ns(version), kind, bucket, self._kind_kernel(kind))
         if key in self._jits:
             return False
         jitted, _ = self._get_jit(kind, bucket, version)
@@ -291,6 +465,16 @@ class PagedLLMExecutor:
             logits, self.cache.k, self.cache.v = jitted(
                 params, ids, blk, off, self.cache.k, self.cache.v,
                 np.int32(0), n_heads=self.n_heads, dtype=self.dtype)
+        elif kind == "chunk":
+            ids = np.zeros((1, bucket), np.int32)
+            blk = np.full((bucket,), SCRATCH_BLOCK, np.int32)
+            off = (np.arange(bucket)
+                   % self.cache.block_size).astype(np.int32)
+            tab = np.full((self.max_blocks,), SCRATCH_BLOCK, np.int32)
+            logits, self.cache.k, self.cache.v = jitted(
+                params, ids, np.int32(0), blk, off, tab, self.cache.k,
+                self.cache.v, np.int32(0), n_heads=self.n_heads,
+                dtype=self.dtype)
         else:
             cur = np.zeros((bucket,), np.int32)
             tab = np.full((bucket, self.max_blocks), SCRATCH_BLOCK,
@@ -306,11 +490,13 @@ class PagedLLMExecutor:
                    what=f"llm_{kind}_warm", bucket=bucket)
         return True
 
-    def prewarm_buckets(self, *, max_batch: int,
-                        max_prompt: int) -> int:
+    def prewarm_buckets(self, *, max_batch: int, max_prompt: int,
+                        chunk: int = 0) -> int:
         """Eagerly compile every bucket a serving run can hit: decode
         pow2 buckets up to `max_batch`, prefill pow2 buckets up to
-        `max_prompt`. Start-time cost, zero hot-path compiles after."""
+        `max_prompt`, and — when the engine runs chunked prefill — the
+        one chunk bucket. Start-time cost, zero hot-path compiles
+        after."""
         from nnstreamer_tpu.backends.xla import _next_pow2
 
         compiled = 0
@@ -318,6 +504,17 @@ class PagedLLMExecutor:
         while b <= top_b:
             compiled += int(self._warm_compile("decode", b))
             b *= 2
+        if chunk > 0:
+            compiled += int(self._warm_compile(
+                "chunk", _next_pow2(chunk, 8)))
+        if self._prefill_kind() == "chunk":
+            # whole-prompt prefills route through the chunk family too
+            s, top_s = 8, _next_pow2(
+                min(max(1, max_prompt), self.max_len), 8)
+            while s <= top_s:
+                compiled += int(self._warm_compile("chunk", s))
+                s *= 2
+            return compiled
         s, top_s = 8, _next_pow2(
             min(max(1, max_prompt), self.max_len), 8)
         while s <= top_s:
@@ -339,6 +536,8 @@ class PagedLLMExecutor:
                     compiled += int(self._warm_compile("prefill", bk[1]))
                 elif bk[0] == "llmd":
                     compiled += int(self._warm_compile("decode", bk[1]))
+                elif bk[0] == "llmp_chunk":
+                    compiled += int(self._warm_compile("chunk", bk[1]))
             except Exception as e:    # warm start is never a gate
                 log.warning("llm warm_start bucket %s failed: %s", bk, e)
         return compiled
@@ -355,7 +554,7 @@ class PagedLLMExecutor:
                 f"incoming {self._entry.name}@{version} changes cache "
                 f"geometry; tensor_llm cannot hot-swap it over live "
                 f"paged state — swap aborted")
-        served = [(k[1], k[2]) for k in list(self._jits)]
+        served = sorted({(k[1], k[2]) for k in self._jits})
         compiled = 0
         for kind, bucket in served:
             if self._warm_compile(kind, bucket, version=version):
@@ -376,8 +575,12 @@ class PagedLLMExecutor:
             "cache_hits": self.cache_hits,
             "cache_misses": self.cache_misses,
             "prefills": self.prefills,
+            "chunk_prefills": self.chunk_prefills,
             "decode_steps": self.decode_steps,
             "swap_count": self.swap_count,
+            "paged_kernel": self.paged_kernel,
+            "kernel_invokes": dict(self.kernel_invokes),
+            "kernel_fallback": self.kernel_fallback,
         }
         if self._entry is not None:
             out["store"] = f"{self._entry.name}@{self._version}"

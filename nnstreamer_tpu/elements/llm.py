@@ -55,6 +55,15 @@ class TensorLLM(Element):
       prompts before the next decode step runs.
     - stream_chunk: emit every N tokens (1 = stream each token).
     - eos_id: stop token (-1 disables); max_new_tokens: token budget.
+    - paged_kernel: "pallas" (paged flash attention, backends/
+      pallas_paged.py) or "xla" (the bit-reference, llm/paged_model.py);
+      "" defers to $NNS_PAGED_KERNEL then defaults to xla. An
+      unavailable Pallas path serves on XLA and counts a
+      kernel_fallback — never an error.
+    - prefill_chunk: prompts longer than this prefill in N-token chunks
+      interleaved with decode steps (0 = whole-prompt prefill), so a
+      long prompt does not head-of-line block the batch's inter-token
+      latency.
     """
 
     ELEMENT_NAME = "tensor_llm"
@@ -85,6 +94,12 @@ class TensorLLM(Element):
             float, 0.5, "admission window between decode steps"),
         "stream_chunk": PropDef(
             int, 1, "tokens per output buffer (1 = per-token)"),
+        "paged_kernel": PropDef(
+            str, "", "attention kernel: pallas | xla | '' = "
+                     "$NNS_PAGED_KERNEL or xla"),
+        "prefill_chunk": PropDef(
+            int, 0, "chunked-prefill chunk size in tokens "
+                    "(0 = whole-prompt prefill)"),
         "warm_start": PropDef(
             int, 1, "replay manifest prefill buckets at start()"),
         "prewarm": PropDef(
@@ -112,6 +127,15 @@ class TensorLLM(Element):
             self.fail_negotiation(
                 f"scheduling must be 'continuous' or 'static', "
                 f"got {sched!r}")
+        kern = self.props["paged_kernel"]
+        if kern not in ("", "pallas", "xla"):
+            self.fail_negotiation(
+                f"paged_kernel must be 'pallas', 'xla' or '' "
+                f"(env/default), got {kern!r}")
+        if int(self.props["prefill_chunk"]) < 0:
+            self.fail_negotiation(
+                f"prefill_chunk must be >= 0, got "
+                f"{self.props['prefill_chunk']}")
         if spec.format == TensorFormat.STATIC:
             for t in spec.tensors:
                 if np.dtype(t.dtype) != np.int32:
@@ -140,6 +164,8 @@ class TensorLLM(Element):
             max_batch=int(self.props["max_batch"]),
             max_len=int(self.props["max_len"]),
             static_batching=self.props["scheduling"] == "static",
+            prefill_chunk=int(self.props["prefill_chunk"]),
+            paged_kernel=str(self.props["paged_kernel"]) or None,
             tracer=self._tracer,
             name=self.name)
         if int(self.props["warm_start"]):

@@ -11,11 +11,24 @@ completion, so one long request holds the whole batch hostage (exactly
 the head-of-line blocking continuous batching removes — bench family
 `llm_serve` measures the gap).
 
+Long prompts can optionally *chunk-prefill* (``prefill_chunk=N``): a
+prompt longer than N tokens admits into a ``prefilling`` state and
+advances one N-token chunk per step — through the executor's one
+``llmp_chunk`` bucket — while the decode batch keeps stepping, so an
+s8192 prompt stops being head-of-line for every live sequence's
+inter-token latency. The final chunk's logits yield the first token.
+
 Sampling is host-side on the step's (vocab,) f32 logits: temperature 0
 is `np.argmax`, which shares first-occurrence tie-breaking with the
 `jnp.argmax` inside `transformer.generate`'s fused decode — a parity
 requirement, not a convenience. Temperature > 0 uses a per-request
 seeded Generator so a request's tokens don't depend on its batchmates.
+
+Host syncs are batched: every prefill/chunk launched in a step returns
+*device* logits, and one `runtime.sync.device_sync` over the whole
+pending set resolves them together — one forced sync for all of a
+step's admissions plus one for the decode batch, instead of one per
+admitted request.
 """
 
 from __future__ import annotations
@@ -23,12 +36,13 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, List, Optional
 
 import numpy as np
 
 from nnstreamer_tpu.core.errors import BackendError
 from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.runtime.sync import device_sync
 from nnstreamer_tpu.runtime.tracing import NULL_TRACER, percentile
 
 log = get_logger("llm.engine")
@@ -48,7 +62,7 @@ class LLMRequest:
     pts: Optional[int] = None           # carried through to emissions
     # -- runtime state (engine-owned) --
     tokens: List[int] = field(default_factory=list)
-    state: str = "queued"               # queued | active | done
+    state: str = "queued"          # queued | prefilling | active | done
     finish_reason: Optional[str] = None  # eos | length
     block_table: List[int] = field(default_factory=list)
     pos: int = 0                        # next cache write position
@@ -92,7 +106,8 @@ class LLMEngine:
     def __init__(self, model="store://transformer", *, n_heads: int = 4,
                  dtype=None, block_size: int = 16, num_blocks: int = 64,
                  max_batch: int = 8, max_len: int = 128,
-                 static_batching: bool = False, tracer=NULL_TRACER,
+                 static_batching: bool = False, prefill_chunk: int = 0,
+                 paged_kernel: Optional[str] = None, tracer=NULL_TRACER,
                  name: str = "llm"):
         from nnstreamer_tpu.backends.llm_exec import PagedLLMExecutor
 
@@ -100,13 +115,18 @@ class LLMEngine:
         self.tracer = tracer
         self.max_batch = int(max_batch)
         self.static = bool(static_batching)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise BackendError(
+                f"prefill_chunk must be >= 0, got {self.prefill_chunk}")
         self.executor = PagedLLMExecutor(
             model, n_heads=n_heads, dtype=dtype, block_size=block_size,
-            num_blocks=num_blocks, max_len=max_len, tracer=tracer,
-            name=name)
+            num_blocks=num_blocks, max_len=max_len,
+            paged_kernel=paged_kernel, tracer=tracer, name=name)
         self.cache = self.executor.cache
         self.queue: deque = deque()
         self.active: List[LLMRequest] = []
+        self.prefilling: List[LLMRequest] = []
         self._seq = 0
         self.submitted = 0
         self.finished = 0
@@ -162,20 +182,27 @@ class LLMEngine:
         buckets (up to `max_prompt`, default max_len) ahead of traffic."""
         return self.executor.prewarm_buckets(
             max_batch=self.max_batch,
-            max_prompt=max_prompt or self.executor.max_len)
+            max_prompt=max_prompt or self.executor.max_len,
+            chunk=self.prefill_chunk)
 
     # -- the serving quantum ----------------------------------------------
     @property
     def has_work(self) -> bool:
-        return bool(self.queue or self.active)
+        return bool(self.queue or self.active or self.prefilling)
 
     def step(self) -> List[TokenEvent]:
-        """Admit, prefill, one decode step, retire. Returns this step's
-        token events (freshly admitted requests contribute their prefill
-        token AND their first decode token)."""
+        """Admit, prefill (whole or one chunk of a long prompt), one
+        decode step, retire. Returns this step's token events (freshly
+        admitted requests contribute their prefill token AND their first
+        decode token; chunk-prefilling requests emit nothing until their
+        final chunk lands)."""
         self.executor.maybe_adopt()
         events: List[TokenEvent] = []
-        self._admit(events)
+        #: (req, device logits) for every prefill completed this step
+        pending: List[tuple] = []
+        self._admit(pending)
+        self._prefill_chunks(pending)
+        self._finish_pending(pending, events)
         self._decode(events)
         self.steps += 1
         return events
@@ -193,15 +220,18 @@ class LLMEngine:
                     f"({len(self.active)} active, {len(self.queue)} queued)")
         return events
 
-    def _admit(self, events: List[TokenEvent]) -> None:
+    def _admit(self, pending: List[tuple]) -> None:
         # static A/B mode: the batch forms only from empty, no top-up
-        if self.static and self.active:
+        if self.static and (self.active or self.prefilling):
             return
         alloc = self.cache.allocator
-        while self.queue and len(self.active) < self.max_batch:
+        # pending holds this step's already-admitted prefills (they only
+        # join active in _finish_pending) — count them against the cap
+        while self.queue and (len(self.active) + len(self.prefilling)
+                              + len(pending) < self.max_batch):
             req = self.queue[0]
-            need = self.cache.blocks_for(
-                int(req.prompt.shape[0]) + req.max_new_tokens)
+            plen = int(req.prompt.shape[0])
+            need = self.cache.blocks_for(plen + req.max_new_tokens)
             blocks = alloc.alloc(need, owner=req.req_id)
             if blocks is None:
                 # head-of-line waits for retirements; admitting a
@@ -210,10 +240,53 @@ class LLMEngine:
                 return
             self.queue.popleft()
             req.block_table = blocks
+            if self.prefill_chunk > 0 and plen > self.prefill_chunk:
+                # long prompt: prefill one chunk per step alongside the
+                # decode batch instead of head-of-line blocking it
+                req.state = "prefilling"
+                req.pos = 0
+                self.prefilling.append(req)
+                continue
             req.state = "active"
-            logits = self.executor.prefill(req.prompt, blocks)
-            req.pos = int(req.prompt.shape[0])
-            tok = self._sample(req, logits)
+            logits = self.executor.prefill(req.prompt, blocks,
+                                           sync=False)
+            req.pos = plen
+            pending.append((req, logits))
+
+    def _prefill_chunks(self, pending: List[tuple]) -> None:
+        """Advance the oldest chunk-prefilling prompt by ONE chunk (the
+        per-step prefill compute budget that keeps decode stepping);
+        when its final chunk lands, its logits join this step's pending
+        batch and the request enters the decode batch."""
+        if not self.prefilling:
+            return
+        req = self.prefilling[0]
+        plen = int(req.prompt.shape[0])
+        chunk = req.prompt[req.pos:req.pos + self.prefill_chunk]
+        from nnstreamer_tpu.backends.xla import _next_pow2
+
+        logits = self.executor.prefill_chunk(
+            chunk, req.pos, req.block_table,
+            bucket=_next_pow2(self.prefill_chunk, 8), sync=False)
+        req.pos += int(chunk.shape[0])
+        if req.pos >= plen:
+            self.prefilling.pop(0)
+            req.state = "active"
+            pending.append((req, logits))
+
+    def _finish_pending(self, pending: List[tuple],
+                        events: List[TokenEvent]) -> None:
+        """ONE whole-batch device sync over every prefill the step
+        launched, then sample first tokens host-side. Freshly finished
+        requests join `active` here and merge into the same step's
+        decode batch."""
+        if not pending:
+            return
+        arrays = device_sync(
+            [lg for _, lg in pending], tracer=self.tracer,
+            name=f"{self.name}:prefill_batch")
+        for (req, _), lg in zip(pending, arrays):
+            tok = self._sample(req, np.asarray(lg))
             self._record_token(req, tok)
             self.active.append(req)
             done = self._maybe_finish(req, tok)
@@ -294,10 +367,12 @@ class LLMEngine:
             "finished": self.finished,
             "queued": len(self.queue),
             "active": len(self.active),
+            "prefilling": len(self.prefilling),
             "tokens_out": self.tokens_out,
             "steps": self.steps,
             "admission_blocked": self.admission_blocked,
             "scheduling": "static" if self.static else "continuous",
+            "prefill_chunk": self.prefill_chunk,
             "cache": self.cache.stats(),
             "executor": self.executor.stats(),
         }

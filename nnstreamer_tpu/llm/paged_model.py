@@ -25,7 +25,30 @@ import jax
 import jax.numpy as jnp
 
 from nnstreamer_tpu.models.transformer import (
-    _expand_kv, _mlp, apply_seq_kv, rmsnorm)
+    _expand_kv, apply_seq_kv, rmsnorm)
+
+
+def _proj(store, name, x, dtype):
+    """One projection matmul, quant-aware: a store version whose params
+    carry ``<name>_scale`` (models/quant.quantize_transformer) routes
+    through the W8A8 int8 path; float params take the dense matmul the
+    reference always took — for float weights this is bit-identical to
+    the inline ``x @ w`` it replaced, so the parity contract is
+    untouched."""
+    if f"{name}_scale" in store:
+        from nnstreamer_tpu.models.quant import w8a8_matmul
+
+        return w8a8_matmul(x, store[name],
+                           store[f"{name}_scale"]).astype(dtype)
+    return x @ store[name].astype(dtype)
+
+
+def _mlp_paged(blk, x, dtype):
+    """SwiGLU MLP through `_proj` — the quant-aware twin of
+    `transformer._mlp` (identical math for float params)."""
+    gate_up = _proj(blk, "wi", x, dtype)
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    return _proj(blk, "wd", jax.nn.silu(gate) * up, dtype)
 
 
 def _rope_rows(x, pos):
@@ -94,7 +117,7 @@ def paged_decode_step(params, cur, tables, pos, k_pool, v_pool,
         h = rmsnorm(x, blk["ln1"].astype(dtype))
         d = x.shape[-1]
         hd = d // n_heads
-        qkv = h @ blk["wqkv"].astype(dtype)
+        qkv = _proj(blk, "wqkv", h, dtype)
         kv_dim = (qkv.shape[-1] - d) // 2
         n_kv = kv_dim // hd
         q = qkv[..., :d].reshape(b, 1, n_heads, hd)
@@ -116,9 +139,78 @@ def paged_decode_step(params, cur, tables, pos, k_pool, v_pool,
         pattn = jax.nn.softmax(s, axis=-1)
         vcx = _expand_kv(vc, n_heads).astype(jnp.float32)
         attn = jnp.einsum("bhqk,bkhd->bqhd", pattn, vcx).astype(dtype)
-        x = x + attn.reshape(b, 1, -1) @ blk["wo"].astype(dtype)
+        x = x + _proj(blk, "wo", attn.reshape(b, 1, -1), dtype)
         h = rmsnorm(x, blk["ln2"].astype(dtype))
-        x = x + _mlp(blk, h, dtype)
+        x = x + _mlp_paged(blk, h, dtype)
     x = rmsnorm(x, params["ln_f"].astype(dtype))
-    logits = (x[:, 0] @ params["head"].astype(dtype)).astype(jnp.float32)
+    logits = _proj(params, "head", x[:, 0], dtype).astype(jnp.float32)
     return logits, k_pool, v_pool
+
+
+def paged_prefill_chunk(params, ids, pos0, blk_idx, blk_off, table,
+                        k_pool, v_pool, last_idx,
+                        *, n_heads=4, dtype=jnp.float32):
+    """One prompt chunk for a single sequence — the XLA reference for
+    chunked prefill.
+
+    ids (1, C_b) int32 — this chunk's tokens padded to the chunk
+    bucket; pos0 () int32 — absolute position of the chunk's first
+    token; blk_idx/blk_off (C_b,) int32 — pool write targets for each
+    chunk position (padding → scratch block); table (max_blocks,)
+    int32 — the sequence's full block table, through which attention
+    reads everything written so far *including this chunk's own
+    scatter*; last_idx — index of the final real token in this chunk.
+
+    Causality is positional: query at absolute position p attends to
+    pool slots holding absolute positions <= p. Earlier chunks live in
+    the pool already (written by previous chunk calls); later slots are
+    masked off by the position comparison, so chunked == unchunked up
+    to float reassociation.
+
+    Returns (last-token logits (vocab,) f32, k_pool, v_pool).
+    """
+    c = ids.shape[1]
+    n_layers, _, block_size, _, _ = k_pool.shape
+    max_blocks = table.shape[0]
+    kv_len = max_blocks * block_size
+    pos = pos0 + jnp.arange(c)                          # (C,) absolute
+    x = params["embed"][ids].astype(dtype)              # (1, C, D)
+    # pool slot s of block j holds absolute position j*block_size + s
+    # for this sequence (allocator hands blocks out in order); query p
+    # attends slots with kvpos <= p. Padding rows (pos past the real
+    # chunk) still compute but their writes hit scratch and their
+    # logits are never read.
+    kvpos = jnp.arange(kv_len)
+    mask = kvpos[None, None, None, :] <= pos[None, None, :, None]
+    for li, blk in enumerate(params["blocks"]):
+        h = rmsnorm(x, blk["ln1"].astype(dtype))
+        d = x.shape[-1]
+        hd = d // n_heads
+        qkv = _proj(blk, "wqkv", h, dtype)
+        kv_dim = (qkv.shape[-1] - d) // 2
+        n_kv = kv_dim // hd
+        q = qkv[..., :d].reshape(1, c, n_heads, hd)
+        k = qkv[..., d:d + kv_dim].reshape(1, c, n_kv, hd)
+        v = qkv[..., d + kv_dim:].reshape(1, c, n_kv, hd)
+        q = _rope_rows(q.transpose(1, 0, 2, 3), pos).transpose(1, 0, 2, 3)
+        k = _rope_rows(k.transpose(1, 0, 2, 3), pos).transpose(1, 0, 2, 3)
+        k_pool = k_pool.at[li, blk_idx, blk_off].set(
+            k[0].astype(k_pool.dtype))
+        v_pool = v_pool.at[li, blk_idx, blk_off].set(
+            v[0].astype(v_pool.dtype))
+        kc = k_pool[li][table].reshape(1, kv_len, n_kv, hd)
+        vc = v_pool[li][table].reshape(1, kv_len, n_kv, hd)
+        kcx = _expand_kv(kc, n_heads).astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       kcx) * hd ** -0.5               # (1,H,C,kv_len)
+        s = jnp.where(mask, s, -1e30)
+        pattn = jax.nn.softmax(s, axis=-1)
+        vcx = _expand_kv(vc, n_heads).astype(jnp.float32)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", pattn, vcx).astype(dtype)
+        x = x + _proj(blk, "wo", attn.reshape(1, c, -1), dtype)
+        h = rmsnorm(x, blk["ln2"].astype(dtype))
+        x = x + _mlp_paged(blk, h, dtype)
+    x = rmsnorm(x, params["ln_f"].astype(dtype))
+    logits = _proj(params, "head", x[0, last_idx][None, :],
+                   dtype).astype(jnp.float32)
+    return logits[0], k_pool, v_pool

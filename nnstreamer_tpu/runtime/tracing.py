@@ -287,6 +287,9 @@ class NullTracer:
     def tenant_summary(self):
         return {}
 
+    def kernel_spans(self):
+        return {}
+
     def instant(self, name, label, t=None, **args):
         pass
 
@@ -330,6 +333,10 @@ class Tracer:
         self._llm_requests: List[Tuple[str, str, float, dict]] = []
         # element name -> count of forced host syncs (runtime/sync.py)
         self._forced: Dict[str, int] = {}
+        # (element, kernel) -> backend spans tagged with a kernel=
+        # arg (llm_exec prefill/chunk/decode): kept whole like _forced
+        # so per-kernel attribution survives ring wrap
+        self._kernel_spans: Dict[Tuple[str, str], int] = {}
         # element name -> {"peak": max async in-flight depth sampled}
         self._inflight: Dict[str, Dict[str, int]] = {}
         # server name -> {cause: count} of admission sheds/rejections
@@ -433,8 +440,19 @@ class Tracer:
     def backend_span(self, name: str, kind: str, t0: float, t1: float,
                      **args) -> None:
         """Backend-side span (compile/invoke) attributed to the owning
-        tensor_filter's track; args carry bucket/cache-hit details."""
+        tensor_filter's track; args carry bucket/cache-hit details. A
+        ``kernel=`` arg (the LLM executor's pallas/xla attribution) is
+        additionally counted per (element, kernel) — wrap-proof, read
+        back via `kernel_spans()`."""
+        kern = (args or {}).get("kernel")
+        if kern is not None:
+            key = (name, str(kern))
+            self._kernel_spans[key] = self._kernel_spans.get(key, 0) + 1
         self._append("X", "backend", name, kind, t0, t1 - t0, args or None)
+
+    def kernel_spans(self) -> Dict[Tuple[str, str], int]:
+        """(element, kernel) -> count of kernel-tagged backend spans."""
+        return dict(self._kernel_spans)
 
     def device_span(self, device: int, kind: str, t0: float, t1: float,
                     **args) -> None:

@@ -95,9 +95,10 @@ def _manifest_path() -> Optional[str]:
 
 def _bucket_to_json(bk: tuple) -> Optional[dict]:
     kind = bk[0]
-    if kind in ("llmp", "llmd"):
+    if kind in ("llmp", "llmd", "llmp_chunk"):
         # LLM serving buckets (backends/llm_exec.py): prefill prompt
-        # bucket / decode batch bucket — one pow2 int, no tensor pairs
+        # bucket / decode batch bucket / chunked-prefill chunk bucket —
+        # one pow2 int, no tensor pairs
         return {"kind": kind, "n": int(bk[1])}
     if kind == "dynb":
         nb, pairs = bk[1], bk[2:]
@@ -114,7 +115,7 @@ def _bucket_to_json(bk: tuple) -> Optional[dict]:
 
 def _bucket_from_json(obj: dict) -> Optional[tuple]:
     try:
-        if obj["kind"] in ("llmp", "llmd"):
+        if obj["kind"] in ("llmp", "llmd", "llmp_chunk"):
             return (str(obj["kind"]), int(obj["n"]))
         pairs = tuple((tuple(t["shape"]), str(t["dtype"]))
                       for t in obj["tensors"])

@@ -57,6 +57,7 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                      replicas: Optional[Dict[str, dict]] = None,
                      segments: Optional[Dict[str, dict]] = None,
                      autotune: Optional[dict] = None,
+                     llm: Optional[Dict[str, dict]] = None,
                      extra: Optional[Dict[str, float]] = None,
                      namespace: str = "nns") -> List[Series]:
     """Flatten runtime state into typed series.
@@ -80,6 +81,12 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                  cumulative decision counters labelled knob/outcome
                  plus current-knob and SLO gauges, so every applied
                  decision is visible as an nns_autotune_* series
+    llm        — {element: TensorLLM.extra_stats()} (or bare
+                 LLMEngine.stats()): per-kernel attention invoke
+                 counters labelled {element, kernel}, the fallback
+                 counter, token/finished totals and the selected-kernel
+                 info gauge — one scrape proves which attention path
+                 served
     extra      — arbitrary numeric gauges {name: value} the caller owns
                  (backend cache sizes, build info, …)
     """
@@ -420,6 +427,54 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
             f"{ns}_autotune_slo_goodput_floor_rps", "gauge",
             "declared goodput floor (0 = none)",
             [({}, float(slo.get("goodput_floor_rps", 0.0)))]))
+
+    if llm:
+        # element → (engine-level stats, executor-level stats); accept
+        # either a TensorLLM.extra_stats() merge (executor nested) or a
+        # bare executor stats dict
+        rows = [(el, st, st.get("executor", st))
+                for el, st in sorted(llm.items())]
+        out.append(_series(
+            f"{ns}_llm_kernel_invokes_total", "counter",
+            "paged-attention executions by kernel (pallas = flash "
+            "paged kernels, xla = the bit-reference) — one scrape "
+            "proves which path served",
+            [({"element": el, "kernel": k}, float(v))
+             for el, _, ex in rows
+             for k, v in sorted(ex.get("kernel_invokes", {}).items())]
+            or [({"element": "none", "kernel": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_llm_kernel_fallback_total", "counter",
+            "requested Pallas paths served on XLA instead (kernel "
+            "unavailable or failed to build — counted, never an error)",
+            [({"element": el}, float(ex.get("kernel_fallback", 0)))
+             for el, _, ex in rows]))
+        out.append(_series(
+            f"{ns}_llm_paged_kernel_info", "gauge",
+            "1 for the attention kernel currently selected",
+            [({"element": el,
+               "kernel": str(ex.get("paged_kernel", "xla"))}, 1.0)
+             for el, _, ex in rows]))
+        out.append(_series(
+            f"{ns}_llm_tokens_total", "counter",
+            "tokens generated",
+            [({"element": el}, float(st.get("tokens_out", 0)))
+             for el, st, _ in rows]))
+        out.append(_series(
+            f"{ns}_llm_finished_total", "counter",
+            "requests finished",
+            [({"element": el}, float(st.get("finished", 0)))
+             for el, st, _ in rows]))
+        out.append(_series(
+            f"{ns}_llm_chunk_prefills_total", "counter",
+            "prompt chunks run through the chunked-prefill bucket",
+            [({"element": el}, float(ex.get("chunk_prefills", 0)))
+             for el, _, ex in rows]))
+        out.append(_series(
+            f"{ns}_llm_prefilling", "gauge",
+            "requests mid chunked-prefill right now",
+            [({"element": el}, float(st.get("prefilling", 0)))
+             for el, st, _ in rows]))
 
     if extra:
         for name, value in sorted(extra.items()):

@@ -92,7 +92,7 @@ def test_llm_bucket_manifest_roundtrip():
     from nnstreamer_tpu.serving.compile_cache import (
         _bucket_from_json, _bucket_to_json)
 
-    for bk in (("llmp", 16), ("llmd", 4)):
+    for bk in (("llmp", 16), ("llmd", 4), ("llmp_chunk", 32)):
         jb = _bucket_to_json(bk)
         assert jb is not None
         assert _bucket_from_json(jb) == bk
@@ -323,8 +323,49 @@ def test_tensor_llm_element_properties_registered():
     cls = registry.get(PluginKind.ELEMENT, "tensor_llm")
     assert cls is TensorLLM
     for prop in ("model", "scheduling", "block_size", "num_blocks",
-                 "max_batch", "max_new_tokens", "admit_window_ms"):
+                 "max_batch", "max_new_tokens", "admit_window_ms",
+                 "paged_kernel", "prefill_chunk"):
         assert prop in cls.PROPS
+
+
+def test_tensor_llm_pallas_chunked_matches_generate(params):
+    """Element-level twin of the smoke test with the Pallas kernel and
+    chunked prefill enabled: tokens are identical to generate() and the
+    executor reports pallas invokes with no fallback."""
+    budgets = {"p0": 4, "p1": 3}
+    pipe, src, llm, sink = _llm_pipeline(
+        params, paged_kernel="pallas", prefill_chunk=4)
+    runner = nns.PipelineRunner(pipe)
+    runner.start()
+    try:
+        rng = np.random.default_rng(23)
+        prompts = {}
+        for rid, budget in budgets.items():
+            p = rng.integers(0, 61, size=int(rng.integers(5, 12))) \
+                .astype(np.int32)
+            prompts[rid] = p
+            src.push(TensorBuffer(
+                tensors=(p,), pts=0,
+                meta={"llm": {"request_id": rid,
+                              "max_new_tokens": budget}}))
+        src.end()
+        runner.wait(120)
+    finally:
+        runner.stop()
+    got = {}
+    for b in sink.results:
+        m = b.meta["llm"]
+        got.setdefault(m["request_id"], []).extend(
+            int(t) for t in np.asarray(b.tensors[0]))
+    for rid, budget in budgets.items():
+        assert np.array_equal(np.array(got[rid]),
+                              _ref(params, prompts[rid], budget)), rid
+    stats = llm.extra_stats()
+    ex = stats["executor"]
+    assert ex["paged_kernel"] == "pallas"
+    assert ex["kernel_invokes"]["pallas"] > 0
+    assert ex["kernel_fallback"] == 0
+    reset_store()
 
 
 @pytest.mark.slow

@@ -1,0 +1,354 @@
+"""Paged Pallas attention kernels vs the XLA paged reference.
+
+Tier-1's half of the ISSUE-16 acceptance gate: the Pallas paged decode
+and prefill kernels (backends/pallas_paged.py) run here in interpret
+mode and must match `llm/paged_model.py`'s XLA reference to <= 1e-5 on
+logits across the block-table shapes serving actually produces —
+non-contiguous tables (holes), staggered per-row depths, pow2-padded
+batch rows writing to the scratch block, and multi-chunk prefill over
+previously written pool blocks. The chip-only compiled run is the
+`pallas`-marked test at the bottom (skipped off-TPU).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from nnstreamer_tpu.backends import pallas_paged  # noqa: E402
+from nnstreamer_tpu.backends.pallas_paged import (  # noqa: E402
+    paged_flash_decode_step, paged_flash_prefill_chunk)
+from nnstreamer_tpu.llm.engine import LLMEngine  # noqa: E402
+from nnstreamer_tpu.llm.paged_model import (  # noqa: E402
+    paged_decode_step, paged_prefill, paged_prefill_chunk)
+from nnstreamer_tpu.models.transformer import init_params  # noqa: E402
+
+TOL = 1e-5
+L, NB, BS, NKV, HD, MB = 2, 16, 8, 2, 16, 4     # pool geometry
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(vocab=61, d_model=64, n_layers=L, n_heads=4,
+                       n_kv_heads=NKV, seed=3)
+
+
+def _pools():
+    z = jnp.zeros((L, NB, BS, NKV, HD), jnp.float32)
+    return z, z
+
+
+def _targets(n, blocks, s_b, pos0=0):
+    """Per-position (block, offset) scatter targets; padding → scratch."""
+    bi = np.zeros(s_b, np.int32)
+    bo = ((pos0 + np.arange(s_b)) % BS).astype(np.int32)
+    for j in range(n):
+        bi[j] = blocks[(pos0 + j) // BS]
+    return jnp.asarray(bi), jnp.asarray(bo)
+
+
+def _table(blocks):
+    t = np.zeros(MB, np.int32)
+    t[:len(blocks)] = blocks
+    return jnp.asarray(t)
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 60, size=n).astype(np.int32)
+
+
+def _prefill_ref(params, prompt, blocks, kp, vp):
+    n = len(prompt)
+    s_b = max(8, 1 << (n - 1).bit_length())
+    ids = jnp.asarray(np.pad(prompt, (0, s_b - n))[None, :], jnp.int32)
+    bi, bo = _targets(n, blocks, s_b)
+    return paged_prefill(params, ids, bi, bo, kp, vp, n - 1)
+
+
+def test_available_in_interpret_mode():
+    assert pallas_paged.available()
+
+
+# -- decode parity -----------------------------------------------------------
+
+def test_decode_parity_holey_staggered_padded(params):
+    """The full serving batch shape at once: two live rows at different
+    depths, non-contiguous (hole-y) block tables, and pow2 padding rows
+    whose table is all scratch."""
+    kp, vp = _pools()
+    # seq0: 12 tokens over blocks [3, 9] (hole); seq1: 5 over [7]
+    _, kp, vp = _prefill_ref(params, _prompt(12, 1), [3, 9], kp, vp)
+    _, kp, vp = _prefill_ref(params, _prompt(5, 2), [7], kp, vp)
+    tabs = np.zeros((4, MB), np.int32)
+    tabs[0, :2] = [3, 9]
+    tabs[1, 0] = 7
+    tabs = jnp.asarray(tabs)
+    cur = jnp.asarray([17, 23, 0, 0], jnp.int32)
+    pos = jnp.asarray([12, 5, 0, 0], jnp.int32)
+    ref, kr, vr = paged_decode_step(params, cur, tabs, pos, kp, vp)
+    fl, kf, vf = paged_flash_decode_step(params, cur, tabs, pos, kp, vp)
+    assert float(jnp.max(jnp.abs(ref[:2] - fl[:2]))) <= TOL
+    # the write-through halves are identical (live blocks only; the
+    # scratch block absorbs different padding garbage by design)
+    assert float(jnp.max(jnp.abs(kr[:, 1:] - kf[:, 1:]))) <= TOL
+    assert float(jnp.max(jnp.abs(vr[:, 1:] - vf[:, 1:]))) <= TOL
+    # and a second, deeper step over the updated pools still agrees
+    # (seq0 crosses into its second block's tail)
+    cur2 = jnp.asarray([9, 11, 0, 0], jnp.int32)
+    pos2 = pos + jnp.asarray([1, 1, 0, 0], jnp.int32)
+    ref2 = paged_decode_step(params, cur2, tabs, pos2, kr, vr)[0]
+    fl2 = paged_flash_decode_step(params, cur2, tabs, pos2, kf, vf)[0]
+    assert float(jnp.max(jnp.abs(ref2[:2] - fl2[:2]))) <= TOL
+
+
+def test_decode_parity_row_at_block_boundary(params):
+    """pos exactly at a block edge: the write lands in a fresh block
+    while attention spans the full previous one — the off-by-one spot
+    for the inclusive <= pos mask."""
+    kp, vp = _pools()
+    _, kp, vp = _prefill_ref(params, _prompt(BS, 4), [5], kp, vp)
+    tabs = jnp.asarray(np.array([[5, 11, 0, 0]], np.int32))
+    cur = jnp.asarray([7], jnp.int32)
+    pos = jnp.asarray([BS], jnp.int32)          # first slot of block 11
+    ref = paged_decode_step(params, cur, tabs, pos, kp, vp)[0]
+    fl = paged_flash_decode_step(params, cur, tabs, pos, kp, vp)[0]
+    assert float(jnp.max(jnp.abs(ref - fl))) <= TOL
+
+
+# -- prefill / chunk parity --------------------------------------------------
+
+def test_chunk_matches_full_prefill_reference(params):
+    """One chunk covering the whole prompt == the apply_seq_kv prefill
+    (logits AND pool contents) — the bridge that lets the chunk family
+    replace whole-prompt prefill for pallas/quantized stores."""
+    prompt = _prompt(12, 5)
+    n, s_b = 12, 16
+    ids = jnp.asarray(np.pad(prompt, (0, s_b - n))[None, :], jnp.int32)
+    bi, bo = _targets(n, [3, 9], s_b)
+    kp, vp = _pools()
+    ref, kr, vr = paged_prefill(params, ids, bi, bo, kp, vp, n - 1)
+    kp, vp = _pools()
+    chk, kc, vc = paged_prefill_chunk(
+        params, ids, jnp.int32(0), bi, bo, _table([3, 9]), kp, vp, n - 1)
+    assert float(jnp.max(jnp.abs(ref - chk))) <= TOL
+    assert float(jnp.max(jnp.abs(kr[:, 1:] - kc[:, 1:]))) <= TOL
+    kp, vp = _pools()
+    fl, kf, vf = paged_flash_prefill_chunk(
+        params, ids, jnp.int32(0), bi, bo, _table([3, 9]), kp, vp, n - 1)
+    assert float(jnp.max(jnp.abs(ref - fl))) <= TOL
+    assert float(jnp.max(jnp.abs(kr[:, 1:] - kf[:, 1:]))) <= TOL
+
+
+@pytest.mark.parametrize("flavor", ["xla", "pallas"])
+def test_chunked_equals_unchunked(params, flavor):
+    """Three 8-token chunks == one 24-token prefill: later chunks
+    attend earlier chunks' pool KV through the table, and the causal
+    mask is positional, not chunk-local."""
+    fn = paged_prefill_chunk if flavor == "xla" \
+        else paged_flash_prefill_chunk
+    prompt = _prompt(24, 6)
+    blocks = [2, 6, 13]                         # holes on purpose
+    tab = _table(blocks)
+    kp, vp = _pools()
+    ref, _, _ = _prefill_ref(params, prompt, blocks, kp, vp)
+    kp, vp = _pools()
+    out = None
+    for c0 in range(0, 24, 8):
+        seg = prompt[c0:c0 + 8]
+        ids = jnp.asarray(seg[None, :], jnp.int32)
+        bi, bo = _targets(len(seg), blocks, 8, pos0=c0)
+        out, kp, vp = fn(params, ids, jnp.int32(c0), bi, bo, tab,
+                         kp, vp, len(seg) - 1)
+    assert float(jnp.max(jnp.abs(ref - out))) <= TOL
+
+
+def test_chunk_padded_tail_hits_scratch_only(params):
+    """A short final chunk padded to its bucket must leave every live
+    block untouched beyond the real tokens — padding rows write to the
+    scratch block only."""
+    prompt = _prompt(3, 7)
+    ids = jnp.asarray(np.pad(prompt, (0, 5))[None, :], jnp.int32)
+    bi, bo = _targets(3, [4], 8)
+    kp, vp = _pools()
+    _, kp, vp = paged_flash_prefill_chunk(
+        params, ids, jnp.int32(0), bi, bo, _table([4]), kp, vp, 2)
+    # block 4 slots beyond position 2 stay zero
+    assert float(jnp.max(jnp.abs(kp[:, 4, 3:]))) == 0.0
+    # every other non-scratch block is untouched
+    live = np.ones(NB, bool)
+    live[[0, 4]] = False
+    assert float(jnp.max(jnp.abs(kp[:, live]))) == 0.0
+
+
+# -- quantized (W8A8) cross-kernel parity ------------------------------------
+
+def test_quantized_chunk_and_decode_parity(params):
+    from nnstreamer_tpu.models.quant import quantize_transformer
+
+    qp = quantize_transformer(params)
+    prompt = _prompt(10, 8)
+    ids = jnp.asarray(np.pad(prompt, (0, 6))[None, :], jnp.int32)
+    bi, bo = _targets(10, [3, 8], 16)
+    tab = _table([3, 8])
+    kp, vp = _pools()
+    ref, kr, vr = paged_prefill_chunk(
+        qp, ids, jnp.int32(0), bi, bo, tab, kp, vp, 9)
+    kp, vp = _pools()
+    fl, kf, vf = paged_flash_prefill_chunk(
+        qp, ids, jnp.int32(0), bi, bo, tab, kp, vp, 9)
+    assert float(jnp.max(jnp.abs(ref - fl))) <= TOL
+    tabs = jnp.asarray(np.array([[3, 8, 0, 0]], np.int32))
+    cur = jnp.asarray([21], jnp.int32)
+    pos = jnp.asarray([10], jnp.int32)
+    refd = paged_decode_step(qp, cur, tabs, pos, kr, vr)[0]
+    fld = paged_flash_decode_step(qp, cur, tabs, pos, kf, vf)[0]
+    assert float(jnp.max(jnp.abs(refd - fld))) <= TOL
+
+
+# -- engine-level: kernel knob, fallback, chunked serving --------------------
+
+def _run_engine(params, prompts, **kw):
+    eng = LLMEngine(dict(params), n_heads=4, block_size=8,
+                    num_blocks=64, max_batch=4, max_len=128, **kw)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.drain()
+    return [tuple(r.tokens) for r in reqs], eng
+
+
+def test_engine_pallas_equals_xla_tokens(params):
+    prompts = [_prompt(9, 11), _prompt(21, 12), _prompt(4, 13)]
+    base, _ = _run_engine(params, prompts)
+    pal, eng = _run_engine(params, prompts, paged_kernel="pallas")
+    assert base == pal
+    ex = eng.stats()["executor"]
+    assert ex["paged_kernel"] == "pallas"
+    assert ex["kernel_invokes"]["pallas"] > 0
+    assert ex["kernel_fallback"] == 0
+
+
+def test_engine_chunked_prefill_equals_whole(params):
+    prompts = [_prompt(40, 14), _prompt(7, 15)]
+    base, _ = _run_engine(params, prompts)
+    for kern in ("xla", "pallas"):
+        chunked, eng = _run_engine(params, prompts, prefill_chunk=16,
+                                   paged_kernel=kern)
+        assert chunked == base, kern
+        assert eng.stats()["executor"]["chunk_prefills"] >= 3
+
+
+def test_engine_chunked_prefill_interleaves_decode(params):
+    """The ITL-bounding structure itself: while a long prompt is mid
+    chunk-prefill, every engine step still advances the live decode
+    batch — the long admit never stalls token production."""
+    eng = LLMEngine(dict(params), n_heads=4, block_size=8,
+                    num_blocks=64, max_batch=4, max_len=128,
+                    prefill_chunk=8)
+    short = eng.submit(_prompt(4, 16), max_new_tokens=32)
+    eng.step()                       # short admits + first token
+    assert len(short.tokens) >= 1
+    long_req = eng.submit(_prompt(48, 17), max_new_tokens=4)
+    grew = []
+    while long_req.state != "active" and eng.has_work:
+        before = len(short.tokens)
+        eng.step()
+        grew.append(len(short.tokens) > before)
+        assert long_req.state in ("prefilling", "active")
+    # every chunk step also produced a decode token for the short req
+    assert grew and all(grew)
+    assert eng.executor.chunk_prefills >= 48 // 8
+    eng.drain()
+    assert long_req.finish_reason is not None
+
+
+def test_engine_unavailable_pallas_counts_fallback(params, monkeypatch):
+    from nnstreamer_tpu.backends import pallas_paged as pp
+
+    monkeypatch.setattr(pp, "available", lambda: False)
+    eng = LLMEngine(dict(params), n_heads=4, block_size=8,
+                    num_blocks=32, max_batch=2, max_len=64,
+                    paged_kernel="pallas")
+    eng.submit(_prompt(5, 18), max_new_tokens=3)
+    eng.drain()
+    ex = eng.stats()["executor"]
+    assert ex["paged_kernel"] == "xla"           # served anyway
+    assert ex["kernel_fallback"] == 1
+    assert ex["kernel_invokes"]["xla"] > 0
+    assert ex["kernel_invokes"]["pallas"] == 0
+
+
+def test_step_batches_prefill_syncs(params):
+    """Satellite fix: a step admitting many requests resolves their
+    logits with ONE forced device_sync (plus one for the decode batch),
+    not one per admission."""
+    from nnstreamer_tpu.runtime.sync import forced_sync_count
+
+    eng = LLMEngine(dict(params), n_heads=4, block_size=8,
+                    num_blocks=64, max_batch=4, max_len=64)
+    for i in range(4):
+        eng.submit(_prompt(5 + i, 20 + i), max_new_tokens=4)
+    # absorb compile-time warm syncs by pre-compiling the buckets
+    eng.prewarm(16)
+    n0 = forced_sync_count()
+    eng.step()                       # 4 admissions + 1 decode batch
+    assert forced_sync_count() - n0 == 2
+    n1 = forced_sync_count()
+    eng.step()                       # steady state: decode only
+    assert forced_sync_count() - n1 == 1
+    eng.drain()
+
+
+# -- metrics surface ---------------------------------------------------------
+
+def test_llm_kernel_metrics_render(params):
+    from nnstreamer_tpu.serving.metrics import (
+        metrics_snapshot, parse_prometheus, render_prometheus)
+
+    _, eng = _run_engine(params, [_prompt(6, 30)],
+                         paged_kernel="pallas")
+    text = render_prometheus(metrics_snapshot(
+        llm={"llm0": eng.stats()}))
+    fams = parse_prometheus(text)
+    inv = fams["nns_llm_kernel_invokes_total"]
+    assert inv["type"] == "counter"
+    pallas_row = 'nns_llm_kernel_invokes_total' \
+        '{element="llm0",kernel="pallas"}'
+    assert inv["samples"][pallas_row] > 0
+    assert fams["nns_llm_kernel_fallback_total"]["samples"][
+        'nns_llm_kernel_fallback_total{element="llm0"}'] == 0
+    info = fams["nns_llm_paged_kernel_info"]["samples"]
+    assert info[
+        'nns_llm_paged_kernel_info{element="llm0",kernel="pallas"}'] \
+        == 1.0
+
+
+def test_tracer_kernel_spans(params):
+    from nnstreamer_tpu.runtime.tracing import Tracer
+
+    tr = Tracer()
+    eng = LLMEngine(dict(params), n_heads=4, block_size=8,
+                    num_blocks=32, max_batch=2, max_len=64,
+                    paged_kernel="pallas", tracer=tr)
+    eng.submit(_prompt(5, 31), max_new_tokens=3)
+    eng.drain()
+    spans = tr.kernel_spans()
+    assert spans.get(("llm", "pallas"), 0) > 0
+
+
+# -- chip-only compiled run --------------------------------------------------
+
+@pytest.mark.pallas
+def test_compiled_pallas_on_tpu(params):
+    """The same decode parity case, compiled for real (not interpret).
+    Only meaningful where `jax.default_backend() == "tpu"`."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("requires a TPU (interpret-mode twin runs in tier-1)")
+    kp, vp = _pools()
+    _, kp, vp = _prefill_ref(params, _prompt(12, 1), [3, 9], kp, vp)
+    tabs = jnp.asarray(np.array([[3, 9, 0, 0]], np.int32))
+    cur = jnp.asarray([17], jnp.int32)
+    pos = jnp.asarray([12], jnp.int32)
+    ref = paged_decode_step(params, cur, tabs, pos, kp, vp)[0]
+    fl = paged_flash_decode_step(params, cur, tabs, pos, kp, vp)[0]
+    assert float(jnp.max(jnp.abs(ref - fl))) <= 5e-5
