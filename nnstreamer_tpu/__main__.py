@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -329,6 +330,12 @@ def _serve_main(argv) -> int:
     ap.add_argument("--trace-out", default=None, metavar="FILE",
                     help="write the merged multi-process Chrome trace "
                          "here at drain (also turns on the pool tracer)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="arm the SLO-breach flight recorder "
+                         "(docs/observability.md): forensic bundles "
+                         "dumped into DIR on SLO breach / conservation "
+                         "mismatch / worker fence / watchdog (also "
+                         "turns on the pool tracer + device profiler)")
     ap.add_argument("--join", default=None, metavar="HOST:PORT",
                     help="register this pool as a host of a mesh "
                          "router (python -m nnstreamer_tpu mesh "
@@ -345,10 +352,15 @@ def _serve_main(argv) -> int:
     from nnstreamer_tpu.serving.worker import WorkerSpec
 
     tracer = None
-    if args.metrics_port is not None or args.trace_out:
+    if args.metrics_port is not None or args.trace_out or args.flight_dir:
         from nnstreamer_tpu.runtime.tracing import Tracer
 
         tracer = Tracer()
+    prof = None
+    if args.metrics_port is not None or args.flight_dir:
+        from nnstreamer_tpu.runtime import devprof
+
+        prof = devprof.get().enable()
     table = None
     if args.tenants:
         from nnstreamer_tpu.serving.tenancy import TenantTable
@@ -391,22 +403,42 @@ def _serve_main(argv) -> int:
         print(f"slo autotuner active "
               f"(dry_run={bool(args.autotune_dry_run)})",
               file=sys.stderr)
+    def collect():
+        from nnstreamer_tpu.serving.metrics import metrics_snapshot
+
+        s = pqs.stats()
+        return metrics_snapshot(
+            tracer=tracer, admission=s.pop("admission"), pool=s,
+            autotune=tuner.stats() if tuner is not None else None,
+            devprof=prof.stats() if prof is not None else None)
+
     msrv = None
     if args.metrics_port is not None:
-        from nnstreamer_tpu.serving.metrics import (
-            MetricsServer, metrics_snapshot)
-
-        def collect():
-            s = pqs.stats()
-            return metrics_snapshot(
-                tracer=tracer, admission=s.pop("admission"), pool=s,
-                autotune=tuner.stats() if tuner is not None else None)
+        from nnstreamer_tpu.serving.metrics import MetricsServer
 
         msrv = MetricsServer(collect, host=args.metrics_host,
                              port=args.metrics_port,
                              health=lambda: {"pool": pqs.stats()["pool"]})
         print(f"metrics on http://{args.metrics_host}:{msrv.port}"
               f"/metrics", file=sys.stderr)
+    flight = None
+    if args.flight_dir:
+        from nnstreamer_tpu.runtime.flightrec import FlightRecorder
+        from nnstreamer_tpu.serving.metrics import render_prometheus
+
+        def _flight_env():
+            return {"cmd": "serve", "argv": list(argv),
+                    "workers": args.workers, "port": pqs.port,
+                    "devprof": prof.stats() if prof is not None else None}
+
+        flight = FlightRecorder(args.flight_dir).attach(
+            tracer=tracer, autotune=tuner,
+            prom=lambda: render_prometheus(collect()),
+            env=_flight_env)
+        flight.run_background(
+            lambda: {"admission": pqs.stats().get("admission")})
+        print(f"flight recorder armed -> {args.flight_dir}",
+              file=sys.stderr)
     agent = None
     if args.join:
         from nnstreamer_tpu.serving.mesh import pool_join
@@ -435,6 +467,8 @@ def _serve_main(argv) -> int:
     finally:
         if tuner is not None:
             tuner.stop()
+        if flight is not None:
+            flight.close()
         if agent is not None:
             agent.stop()
         pqs.close()
@@ -688,6 +722,11 @@ def _traffic_main(argv) -> int:
                     help="with --workers: run the pool traced and "
                          "write the merged multi-process Chrome trace "
                          "here (implies --trace)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="post-run forensic scan: if the drill "
+                         "breached its p99 budget or broke admission "
+                         "conservation, dump a flight bundle into DIR "
+                         "(docs/observability.md)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -804,6 +843,25 @@ def _traffic_main(argv) -> int:
             max_inflight=args.max_inflight, shed_policy=args.shed_policy,
             p99_budget_ms=args.budget_ms, seed=args.seed,
             trace=args.trace)
+    if args.flight_dir:
+        from nnstreamer_tpu.runtime.flightrec import FlightRecorder
+
+        rec = FlightRecorder(args.flight_dir)
+        rec.attach(env=lambda: {"cmd": "traffic", "report": report})
+        rec.tick({"report_summary": {
+            k: report.get(k) for k in ("goodput_rps", "lost",
+                                       "conserved", "p99_budget_ms")}})
+        lat = report.get("latency_ms") or {}
+        sig = {"p99_ms": lat.get("p99"),
+               "p99_budget_ms": report.get("p99_budget_ms"),
+               "admission": report.get("admission")}
+        # two scans: the conservation predicate needs two consecutive
+        # mismatched reads before it trusts a final, settled ledger
+        fired = rec.scan(**sig)
+        fired += [k for k in rec.scan(**sig) if k not in fired]
+        for kind in fired:
+            print(f"flight bundle dumped ({kind}) -> {args.flight_dir}",
+                  file=sys.stderr)
     if args.json:
         print(json.dumps(report, default=float))
         return 0
@@ -836,6 +894,48 @@ def _traffic_main(argv) -> int:
     return 0 if lost == 0 else 1
 
 
+def _flight_main(argv) -> int:
+    """`flight` subcommand: list / inspect the forensic bundles a
+    flight recorder (runtime/flightrec.py) dumped into a directory."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu flight",
+        description="inspect SLO-breach flight-recorder bundles "
+                    "(docs/observability.md)")
+    ap.add_argument("dir", help="flight directory (serve --flight-dir)")
+    ap.add_argument("--inspect", default=None, metavar="NAME",
+                    help="print one bundle's parsed artifacts "
+                         "(bundle dir name, e.g. flight-0001-slo_breach)")
+    ap.add_argument("--json", action="store_true",
+                    help="print raw JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    from nnstreamer_tpu.runtime.flightrec import list_bundles, load_bundle
+
+    if args.inspect:
+        bundle = load_bundle(os.path.join(args.dir, args.inspect))
+        print(json.dumps(bundle, indent=None if args.json else 2,
+                         default=str))
+        return 0
+    bundles = list_bundles(args.dir)
+    if args.json:
+        print(json.dumps(bundles, default=str))
+        return 0
+    if not bundles:
+        print(f"no flight bundles in {args.dir}", file=sys.stderr)
+        return 1
+    print(f"{'bundle':<36} {'kind':<16} {'when':<20} cause")
+    print("-" * 100)
+    for b in bundles:
+        when = time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(b.get("wall_time") or 0))
+        cause = json.dumps(b.get("cause") or {}, default=str)
+        if len(cause) > 40:
+            cause = cause[:37] + "..."
+        print(f"{b['name']:<36} {str(b.get('kind')):<16} {when:<20} "
+              f"{cause}")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
@@ -852,6 +952,8 @@ def main(argv=None) -> int:
         return _mesh_main(argv[1:])
     if argv and argv[0] == "top":
         return _top_main(argv[1:])
+    if argv and argv[0] == "flight":
+        return _flight_main(argv[1:])
     if argv and argv[0] == "lint":
         from nnstreamer_tpu.analysis.cli import main as lint_main
 

@@ -20,6 +20,12 @@ NNL009 placement-audit    explicit device picks (jax.devices()[i])
                           only inside serving/placement.py and
                           parallel/ — placement decisions route
                           through the subsystem
+NNL010 device-accounting  XLA cost-model reads (cost_analysis()),
+                          device memory ledgers (memory_stats()) and
+                          peak-FLOPs/bandwidth tables only inside
+                          runtime/devprof.py (bench.py keeps its own
+                          sweep-local copy) — one accounting site for
+                          "peak" vs "achieved"
 
 Every rule is pure AST — nothing here imports the code under analysis.
 Heuristics err toward silence (a missed finding is a review problem; a
@@ -744,11 +750,63 @@ class PlacementAudit(Rule):
                     f"route through serving/placement.device_of()")
 
 
+class DeviceAccountingAudit(Rule):
+    rule_id = "NNL010"
+    title = "device-accounting"
+    rationale = (
+        "MFU / roofline / HBM numbers are only trustworthy when "
+        "'peak' and 'achieved' come from ONE accounting site. XLA "
+        "cost-model reads (`.cost_analysis()`), device memory ledgers "
+        "(`.memory_stats()`) and peak-FLOPs/bandwidth constant tables "
+        "live in runtime/devprof.py; everything else reports into the "
+        "profiler (capture_cost / note_dispatch) and reads stats() "
+        "back out. bench.py (outside the package) keeps its own "
+        "sweep-local peak table by design")
+
+    #: the blessed accounting sites; everything else is flagged
+    ALLOWED = ("runtime/devprof.py", "bench.py")
+    #: attribute calls that ARE device accounting
+    ACCOUNTING_ATTRS = ("cost_analysis", "memory_stats")
+    #: module/class-level constant names that smell like a peak table
+    PEAK_NAMES = ("TFLOPS", "GFLOPS", "FLOPS", "GBPS", "HBM_BW")
+
+    def check(self, module: Module, project: Project):
+        p = f"/{module.path}"
+        if any(f"/{a}" in p for a in self.ALLOWED):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in self.ACCOUNTING_ATTRS:
+                yield node, (
+                    f"device-accounting read `.{node.func.attr}()` "
+                    f"outside runtime/devprof.py: report through "
+                    f"DeviceProfiler.capture_cost() / read the ledger "
+                    f"via devprof.get().stats() so 'peak' and "
+                    f"'achieved' share one accounting site")
+        # peak tables: module-scope constant assignments whose name
+        # declares hardware peaks (PEAK_BF16_TFLOPS, PEAK_HBM_GBPS, …)
+        for node in module.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                name = t.id if isinstance(t, ast.Name) else ""
+                if name.isupper() and "PEAK" in name and any(
+                        s in name for s in self.PEAK_NAMES):
+                    yield node, (
+                        f"hardware peak table `{name}` outside "
+                        f"runtime/devprof.py: use devprof.PEAK_TFLOPS "
+                        f"/ devprof.peak_for() — a second copy is how "
+                        f"MFU denominators drift")
+
+
 #: registry, in catalog order
 ALL_RULES: List[Rule] = [
     ElementContract(), ForcedSync(), LockDiscipline(), JitPurity(),
     SpawnSafety(), PicklableErrors(), ThreadAudit(), SocketAudit(),
-    PlacementAudit(),
+    PlacementAudit(), DeviceAccountingAudit(),
 ]
 
 

@@ -45,6 +45,7 @@ import numpy as np
 from nnstreamer_tpu.core.errors import BackendError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.llm.paged_cache import SCRATCH_BLOCK, PagedKVCache
+from nnstreamer_tpu.runtime import devprof
 from nnstreamer_tpu.runtime.sync import device_sync
 from nnstreamer_tpu.runtime.tracing import NULL_TRACER
 
@@ -282,6 +283,30 @@ class PagedLLMExecutor:
         if self.tracer.active:
             self.tracer.backend_span(self.name, kind, t0, t1, **args)
 
+    # -- device performance plane (runtime/devprof.py) ---------------------
+    def resident_bytes(self) -> int:
+        """Device bytes this executor pins: params + the paged KV pool
+        — the executor-level HBM attribution row."""
+        import jax
+
+        n = sum(getattr(a, "nbytes", 0)
+                for a in jax.tree_util.tree_leaves(self.params))
+        for a in (self.cache.k, self.cache.v):
+            n += getattr(a, "nbytes", 0)
+        return n
+
+    def _prof_capture(self, bucket: str, jitted, args: tuple,
+                      kwargs: dict, seconds: float) -> None:
+        """Compile-event capture: cost-model read on the freshly
+        compiled bucket (re-lower only; compile misses are rare by
+        construction — prewarm_buckets exists to make them zero)."""
+        prof = devprof.get()
+        if not prof.enabled:
+            return
+        prof.attach_model(self.name, self)
+        prof.capture_cost(self.name, bucket, jitted, args,
+                          kwargs=kwargs, seconds=seconds)
+
     # -- prefill -----------------------------------------------------------
     def prefill(self, prompt: np.ndarray, block_table: List[int],
                 *, sync: bool = True):
@@ -309,6 +334,9 @@ class PagedLLMExecutor:
         blk_idx[:plen] = np.asarray(block_table, np.int32)[pos // bs]
         blk_off = (np.arange(s_b) % bs).astype(np.int32)
         jitted, fresh = self._get_jit("prefill", s_b)
+        prof = devprof.get()
+        if prof.enabled:
+            prof.note_dispatch(self.name, f"prefill:{s_b}")
         t0 = time.perf_counter()
         logits, self.cache.k, self.cache.v = jitted(
             self.params, ids, blk_idx, blk_off, self.cache.k,
@@ -323,6 +351,11 @@ class PagedLLMExecutor:
             self._span("compile", t0, t1, what="llm_prefill", bucket=s_b,
                        kernel="xla")
             self._note_bucket(("llmp", s_b))
+            self._prof_capture(
+                f"prefill:{s_b}", jitted,
+                (self.params, ids, blk_idx, blk_off, self.cache.k,
+                 self.cache.v, np.int32(plen - 1)),
+                {"n_heads": self.n_heads, "dtype": self.dtype}, t1 - t0)
         else:
             self._span("invoke", t0, t1, what="llm_prefill", bucket=s_b,
                        plen=plen, kernel="xla")
@@ -363,6 +396,9 @@ class PagedLLMExecutor:
                 n_heads=self.n_heads, dtype=self.dtype)
             return logits, fresh
 
+        prof = devprof.get()
+        if prof.enabled:
+            prof.note_dispatch(self.name, f"chunk:{c_b}")
         t0 = time.perf_counter()
         try:
             logits, fresh = _run()
@@ -381,6 +417,12 @@ class PagedLLMExecutor:
             self._span("compile", t0, t1, what="llm_prefill_chunk",
                        bucket=c_b, kernel=kernel)
             self._note_bucket(("llmp_chunk", c_b))
+            jitted, _ = self._get_jit("chunk", c_b)
+            self._prof_capture(
+                f"chunk:{c_b}", jitted,
+                (self.params, args[0], np.int32(pos0), args[1], args[2],
+                 args[3], self.cache.k, self.cache.v, args[4]),
+                {"n_heads": self.n_heads, "dtype": self.dtype}, t1 - t0)
         else:
             self._span("invoke", t0, t1, what="llm_prefill_chunk",
                        bucket=c_b, clen=clen, kernel=kernel)
@@ -416,6 +458,9 @@ class PagedLLMExecutor:
                 self.cache.v, n_heads=self.n_heads, dtype=self.dtype)
             return logits, fresh
 
+        prof = devprof.get()
+        if prof.enabled:
+            prof.note_dispatch(self.name, f"decode:{b_b}")
         t0 = time.perf_counter()
         try:
             logits, fresh = _run()
@@ -434,6 +479,12 @@ class PagedLLMExecutor:
             self._span("compile", t0, t1, what="llm_decode", bucket=b_b,
                        kernel=kernel)
             self._note_bucket(("llmd", b_b))
+            jitted, _ = self._get_jit("decode", b_b)
+            self._prof_capture(
+                f"decode:{b_b}", jitted,
+                (self.params, cur_a, tab_a, pos_a, self.cache.k,
+                 self.cache.v),
+                {"n_heads": self.n_heads, "dtype": self.dtype}, t1 - t0)
         else:
             self._span("invoke", t0, t1, what="llm_decode", bucket=b_b,
                        rows=n, kernel=kernel)
@@ -456,6 +507,9 @@ class PagedLLMExecutor:
             return False
         jitted, _ = self._get_jit(kind, bucket, version)
         params = self.params if params is None else params
+        prof = devprof.get()
+        if prof.enabled:
+            prof.note_dispatch(self.name, f"{kind}:{bucket}")
         t0 = time.perf_counter()
         if kind == "prefill":
             ids = np.zeros((1, bucket), np.int32)
@@ -465,6 +519,8 @@ class PagedLLMExecutor:
             logits, self.cache.k, self.cache.v = jitted(
                 params, ids, blk, off, self.cache.k, self.cache.v,
                 np.int32(0), n_heads=self.n_heads, dtype=self.dtype)
+            largs = (params, ids, blk, off, self.cache.k, self.cache.v,
+                     np.int32(0))
         elif kind == "chunk":
             ids = np.zeros((1, bucket), np.int32)
             blk = np.full((bucket,), SCRATCH_BLOCK, np.int32)
@@ -475,6 +531,8 @@ class PagedLLMExecutor:
                 params, ids, np.int32(0), blk, off, tab, self.cache.k,
                 self.cache.v, np.int32(0), n_heads=self.n_heads,
                 dtype=self.dtype)
+            largs = (params, ids, np.int32(0), blk, off, tab,
+                     self.cache.k, self.cache.v, np.int32(0))
         else:
             cur = np.zeros((bucket,), np.int32)
             tab = np.full((bucket, self.max_blocks), SCRATCH_BLOCK,
@@ -483,11 +541,16 @@ class PagedLLMExecutor:
             logits, self.cache.k, self.cache.v = jitted(
                 params, cur, tab, pos, self.cache.k, self.cache.v,
                 n_heads=self.n_heads, dtype=self.dtype)
+            largs = (params, cur, tab, pos, self.cache.k, self.cache.v)
         device_sync(logits, tracer=self.tracer,
                     name=f"{self.name}:warm_{kind}")
         self.compile_count += 1
-        self._span("compile", t0, time.perf_counter(),
-                   what=f"llm_{kind}_warm", bucket=bucket)
+        t1 = time.perf_counter()
+        self._span("compile", t0, t1, what=f"llm_{kind}_warm",
+                   bucket=bucket)
+        self._prof_capture(f"{kind}:{bucket}", jitted, largs,
+                           {"n_heads": self.n_heads, "dtype": self.dtype},
+                           t1 - t0)
         return True
 
     def prewarm_buckets(self, *, max_batch: int, max_prompt: int,

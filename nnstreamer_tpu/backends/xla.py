@@ -41,6 +41,7 @@ from nnstreamer_tpu.backends.base import (
 )
 from nnstreamer_tpu.core.errors import BackendError, SegmentStageError
 from nnstreamer_tpu.core.log import get_logger
+from nnstreamer_tpu.runtime import devprof
 from nnstreamer_tpu.tensor.dtypes import DType
 from nnstreamer_tpu.tensor.info import TensorInfo, TensorsSpec
 
@@ -723,6 +724,11 @@ class XLABackend(FilterBackend):
             args = tuple(
                 jax.device_put(np_.zeros(s, dtype=np_.dtype(d)),
                                self._device) for s, d in specs)
+            prof = devprof.get()
+            if prof.enabled:
+                prof.note_dispatch(self._prof_label(),
+                                   devprof.bucket_label(basekey))
+            t0 = time.perf_counter()
             try:
                 out = _to_tuple(jitted(packed, *args))
                 device_sync(out, self.tracer, self.trace_name)
@@ -733,6 +739,9 @@ class XLABackend(FilterBackend):
                     f"{[s for s, _ in specs]}: {e} — swap aborted before "
                     f"the epoch flip; the serving version is unchanged"
                 ) from e
+            self._prof_capture(devprof.bucket_label(basekey), jitted,
+                               (packed,) + args,
+                               time.perf_counter() - t0)
             jits[basekey] = jitted
             compiled += 1
         self._staged[version] = {"vstate": vs, "jits": jits}
@@ -766,11 +775,16 @@ class XLABackend(FilterBackend):
             specs = self._bucket_array_specs(basekey)
             if specs is None:
                 continue
+            prof = devprof.get()
+            t0 = time.perf_counter()
             try:
                 jitted = jax.jit(self._full_fn(bundle=vs.bundle))
                 args = tuple(
                     jax.device_put(np_.zeros(s, dtype=np_.dtype(d)),
                                    self._device) for s, d in specs)
+                if prof.enabled:
+                    prof.note_dispatch(self._prof_label(),
+                                       devprof.bucket_label(basekey))
                 device_sync(_to_tuple(jitted(packed, *args)),
                             self.tracer, self.trace_name)
             except Exception as e:
@@ -779,6 +793,9 @@ class XLABackend(FilterBackend):
                 log.warning("warm-start bucket %s skipped: %s",
                             basekey[:2], e)
                 continue
+            self._prof_capture(devprof.bucket_label(basekey), jitted,
+                               (packed,) + args,
+                               time.perf_counter() - t0)
             self._insert_jit(key, jitted)
             self._served.setdefault(basekey, True)
             compiled += 1
@@ -815,6 +832,31 @@ class XLABackend(FilterBackend):
         dt = time.perf_counter() - t0
         self._store_entry.record(version, dt, error=error)
         return dt
+
+    # -- device performance plane (runtime/devprof.py) ---------------------
+    def _prof_label(self) -> str:
+        """Stable filter label for devprof keys: the element's trace
+        name, else the store model name, else the bundle name."""
+        if self.trace_name:
+            return self.trace_name
+        if self._store_entry is not None:
+            return self._store_entry.name
+        if self._bundle is not None and self._bundle.name:
+            return self._bundle.name
+        return "xla"
+
+    def _prof_capture(self, bucket: str, jitted, args: tuple,
+                      seconds: float) -> None:
+        """Compile-event hook: register this backend for HBM
+        attribution and hand the jitted program + concrete args to the
+        profiler's cost-model capture (a re-lower, compile misses
+        only — never the steady-state hot path)."""
+        prof = devprof.get()
+        if not prof.enabled:
+            return
+        label = self._prof_label()
+        prof.attach_model(label, self)
+        prof.capture_cost(label, bucket, jitted, args, seconds=seconds)
 
     def _stage(self, arrs) -> Tuple[ArrayTuple, bool]:
         """Move inputs to the target device, skipping `device_put` for
@@ -865,6 +907,10 @@ class XLABackend(FilterBackend):
             (self._ns(ver),) + basekey + self._seg_suffix(),
             make=lambda: jax.jit(self._full_fn(bundle=vs.bundle)))
         staged, _ = self._stage(arrs)
+        prof = devprof.get()
+        blabel = devprof.bucket_label(basekey)
+        if prof.enabled:
+            prof.note_dispatch(self._prof_label(), blabel)
         t0 = time.perf_counter()
         try:
             out = _to_tuple(jitted(packed, *staged))
@@ -872,6 +918,8 @@ class XLABackend(FilterBackend):
             self._record_invoke(ver, t0, error=True)
             raise
         dt = self._record_invoke(ver, t0)
+        if prof.enabled and self.cache_hits == hits0:
+            self._prof_capture(blabel, jitted, (packed,) + staged, dt)
         tr = self.tracer
         if tr.active:
             tr.backend_span(self.trace_name or "xla", "invoke", t0,
@@ -899,12 +947,19 @@ class XLABackend(FilterBackend):
         # already-device-committed inputs skip the put entirely
         staged, _ = self._stage(tensors)
         tr = self.tracer
-        if tr.active:
+        prof = devprof.get()
+        if prof.enabled:
+            prof.note_dispatch(self._prof_label(), "static")
+        if tr.active or (prof.enabled and fresh):
             t0 = time.perf_counter()
             out = self._jitted(params, *staged)
-            tr.backend_span(self.trace_name or "xla", "invoke", t0,
-                            time.perf_counter(),
-                            compile="fresh" if fresh else "cached")
+            t1 = time.perf_counter()
+            if tr.active:
+                tr.backend_span(self.trace_name or "xla", "invoke", t0,
+                                t1, compile="fresh" if fresh else "cached")
+            if prof.enabled and fresh:
+                self._prof_capture("static", self._jitted,
+                                   (params,) + staged, t1 - t0)
         else:
             out = self._jitted(params, *staged)
         return _to_tuple(out)
@@ -1078,13 +1133,22 @@ class XLABackend(FilterBackend):
         else:
             jitted = self._bucket_jit(key)
         tr = self.tracer
-        if tr.active:
+        prof = devprof.get()
+        miss = self.cache_hits == hits0
+        blabel = f"dynb:{nb}"
+        if prof.enabled:
+            prof.note_dispatch(self._prof_label(), blabel)
+        if tr.active or (prof.enabled and miss):
             t0 = time.perf_counter()
             out = _to_tuple(jitted(params, *staged))
-            tr.backend_span(self.trace_name or "xla", "invoke_batched",
-                            t0, time.perf_counter(), n=n, bucket=nb,
-                            cache="hit" if self.cache_hits > hits0
-                            else "miss")
+            t1 = time.perf_counter()
+            if tr.active:
+                tr.backend_span(self.trace_name or "xla",
+                                "invoke_batched", t0, t1, n=n, bucket=nb,
+                                cache="miss" if miss else "hit")
+            if prof.enabled and miss:
+                self._prof_capture(blabel, jitted,
+                                   (params,) + tuple(staged), t1 - t0)
         else:
             out = _to_tuple(jitted(params, *staged))
         return tuple(o[:n] for o in out)
@@ -1166,6 +1230,10 @@ class XLABackend(FilterBackend):
             jitted = self._bucket_jit(
                 verdict_key,
                 make=lambda: jax.jit(self._full_fn(bundle=vs.bundle)))
+        prof = devprof.get()
+        blabel = devprof.bucket_label(basekey)
+        if prof.enabled:
+            prof.note_dispatch(self._prof_label(), blabel)
         t0 = time.perf_counter()
         try:
             out = _to_tuple(jitted(packed, *staged))
@@ -1173,6 +1241,9 @@ class XLABackend(FilterBackend):
             self._record_invoke(ver, t0, error=True)
             raise
         dt = self._record_invoke(ver, t0)
+        if prof.enabled and self.cache_hits == hits0:
+            self._prof_capture(blabel, jitted,
+                               (packed,) + tuple(staged), dt)
         tr = self.tracer
         if tr.active:
             tr.backend_span(self.trace_name or "xla", "invoke_batched",
@@ -1245,16 +1316,22 @@ class XLABackend(FilterBackend):
             packed = self._packed_params()
         if key in self._dyn_jits:
             return True
+        prof = devprof.get()
+        t0 = time.perf_counter()
         try:
             jitted = jax.jit(fn)
             args = tuple(
                 jax.device_put(np_.zeros(s, dtype=np_.dtype(d)),
                                self._device) for s, d in batched)
+            if prof.enabled:
+                prof.note_dispatch(self._prof_label(), f"dynb:{nb}")
             device_sync(_to_tuple(jitted(packed, *args)),
                         self.tracer, self.trace_name)
         except Exception as e:
             log.warning("stage_bucket(%d) skipped: %s", nb, e)
             return False
+        self._prof_capture(f"dynb:{nb}", jitted, (packed,) + args,
+                           time.perf_counter() - t0)
         self._insert_jit(key, jitted)
         if ver is not None:
             self._note_bucket(ver, basekey)
@@ -1278,22 +1355,30 @@ class XLABackend(FilterBackend):
         self._jitted = None
         return n
 
+    @staticmethod
+    def _tree_bytes(params) -> int:
+        import jax
+
+        if params is None:
+            return 0
+        return sum(
+            getattr(a, "nbytes", 0)
+            for a in jax.tree_util.tree_leaves(params))
+
     def resident_bytes(self) -> int:
         """Device bytes held by this model's params (all resident store
         versions, or the single non-store param tree)."""
-        def tree_bytes(params) -> int:
-            import jax
-
-            if params is None:
-                return 0
-            return sum(
-                getattr(a, "nbytes", 0)
-                for a in jax.tree_util.tree_leaves(params))
-
         if self._vstates:
-            return sum(tree_bytes(vs.device_params)
+            return sum(self._tree_bytes(vs.device_params)
                        for vs in self._vstates.values())
-        return tree_bytes(self._device_params)
+        return self._tree_bytes(self._device_params)
+
+    def resident_bytes_by_version(self) -> Dict[str, int]:
+        """Per-resident-version device bytes ({"v<N>": bytes}) — the
+        devprof HBM ledger's per-model-version attribution; empty for
+        non-store models (the plain resident_bytes row covers those)."""
+        return {f"v{ver}": self._tree_bytes(vs.device_params)
+                for ver, vs in sorted(self._vstates.items())}
 
     def reload(self, model: Any) -> None:
         """Hot model swap (is-updatable analog): double-buffered — the new

@@ -6,18 +6,24 @@ through :func:`device_sync` instead of hand-rolled per-leaf
 ``block_until_ready`` loops.  One call site means:
 
 - one whole-tuple ``jax.block_until_ready`` (a single runtime round-trip
-  instead of a Python loop over leaves), and
+  instead of a Python loop over leaves),
 - the tracer can count *forced* syncs — the host-path tax the async
-  dispatch work exists to remove — as the ``forced_syncs`` stat.
+  dispatch work exists to remove — as the ``forced_syncs`` stat, and
+- the device profiler (runtime/devprof.py) can close its per-bucket
+  device-time samples exactly where device completion is forced,
+  without its own sync or any change to the forced-sync accounting.
 
 Kept free of package-internal imports (scheduler, filter, sinks and the
-XLA backend all call in here) and of an import-time jax dependency.
+XLA backend all call in here) — devprof is the one exception, itself a
+stdlib-only leaf — and of an import-time jax dependency.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+
+from nnstreamer_tpu.runtime import devprof
 
 _lock = threading.Lock()
 _forced = 0
@@ -48,6 +54,9 @@ def device_sync(tensors, tracer=None, name=None, forced=True):
     import jax
 
     jax.block_until_ready(tuple(leaves))
+    prof = devprof.get()
+    if prof.enabled:
+        prof.sample_sync()
     if forced:
         with _lock:
             _forced += 1

@@ -254,6 +254,21 @@ class NullTracer:
     def record_watchdog(self, name, kind, t, **args):
         pass
 
+    def watchdog_counts(self):
+        return {}
+
+    def record_flight(self, kind, t, **args):
+        pass
+
+    def flight_dumps(self):
+        return []
+
+    def record_device_counter(self, name, value, t):
+        pass
+
+    def worker_counts(self):
+        return {}
+
     def backend_span(self, name, kind, t0, t1, **args):
         pass
 
@@ -357,6 +372,13 @@ class Tracer:
         self._requests: List[Tuple[str, str, float, list, dict]] = []
         self._max_requests = 4096
         self._requests_dropped = 0
+        # element name -> {kind: count} of watchdog warnings: kept
+        # whole so the flight recorder's watchdog trigger sees totals
+        # that survive ring wrap
+        self._watchdogs: Dict[str, Dict[str, int]] = {}
+        # flight-recorder dumps (runtime/flightrec.py): kept whole —
+        # a forensic bundle is exactly the event a post-mortem is for
+        self._flights: List[Tuple[str, float, dict]] = []
         # autotuner decisions (serving/autotune.py): bounded keep-whole
         # list with the same FIFO drop scheme as _requests, plus
         # per-knob/outcome counts that survive the drop — the decision
@@ -433,9 +455,19 @@ class Tracer:
     def record_watchdog(self, name: str, kind: str, t: float,
                         **args) -> None:
         """A watchdog warning: kind is "stall" (process() over budget)
-        or "queue" (input queue at capacity over budget)."""
+        or "queue" (input queue at capacity over budget). Counted per
+        (element, kind) wrap-proof — the flight recorder's watchdog
+        trigger watches these totals, so they must survive ring wrap."""
+        c = self._watchdogs.get(name)
+        if c is None:
+            c = self._watchdogs[name] = {}
+        c[kind] = c.get(kind, 0) + 1
         self._append("i", "watchdog", name, f"watchdog_{kind}", t, 0.0,
                      args or None)
+
+    def watchdog_counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-element watchdog-kind totals (wrap-proof)."""
+        return {name: dict(c) for name, c in self._watchdogs.items()}
 
     def backend_span(self, name: str, kind: str, t0: float, t1: float,
                      **args) -> None:
@@ -562,6 +594,24 @@ class Tracer:
         c[outcome] = c.get(outcome, 0) + 1
         self._append("i", "autotune", name, f"tune_{knob}", t, 0.0,
                      args or None)
+
+    def record_flight(self, kind: str, t: float, **args) -> None:
+        """One flight-recorder dump (runtime/flightrec.py); args carry
+        the bundle path and trigger cause. Kept whole — dumps are rare
+        and each one is a post-mortem anchor."""
+        self._flights.append((kind, t, dict(args)))
+        self._append("i", "flight", "flightrec", f"flight_{kind}", t,
+                     0.0, args or None)
+
+    def flight_dumps(self) -> List[Tuple[str, float, dict]]:
+        return list(self._flights)
+
+    def record_device_counter(self, name: str, value: float,
+                              t: float) -> None:
+        """Device-plane counter sample (runtime/devprof.py): MFU per
+        bucket and HBM per device, rendered as Chrome-trace counter
+        tracks alongside queue depth and in-flight windows."""
+        self._append("C", "devprof", name, "devprof", t, 0.0, value)
 
     def autotune_events(self) -> List[Tuple[str, str, float, dict]]:
         return list(self._autotune)
@@ -981,12 +1031,20 @@ class Tracer:
                     if args:
                         ev["args"] = dict(args)
                 elif ph == "C":
-                    track = ("inflight" if cat == "inflight"
-                             else "queue")
-                    ev = {"ph": "C", "cat": cat,
-                          "name": f"{track}:{name}",
-                          "pid": pid, "tid": 0, "ts": us,
-                          "args": {"depth": args}}
+                    if cat == "devprof":
+                        # device-plane counter tracks: name already
+                        # carries the mfu:/hbm: prefix, value is the
+                        # sampled counter value (not a queue depth)
+                        ev = {"ph": "C", "cat": cat, "name": name,
+                              "pid": pid, "tid": 0, "ts": us,
+                              "args": {"value": args}}
+                    else:
+                        track = ("inflight" if cat == "inflight"
+                                 else "queue")
+                        ev = {"ph": "C", "cat": cat,
+                              "name": f"{track}:{name}",
+                              "pid": pid, "tid": 0, "ts": us,
+                              "args": {"depth": args}}
                 else:  # "i" instant, scoped to the element's track
                     ev = {"ph": "i", "cat": cat, "name": label,
                           "pid": pid, "tid": tid_of(pid, name),

@@ -267,6 +267,9 @@ class AutoTuner:
         self.hysteresis_frac = float(hysteresis_frac)
         self.cooldown_s = float(cooldown_s)
         self.name = name
+        # flight recorder (runtime/flightrec.py attach()): when set,
+        # tick() feeds it observed-p99-over-budget breaches
+        self.flight: Any = None
         self._on_apply = on_apply
         self._on_victims = on_victims
         self._now = now
@@ -327,6 +330,14 @@ class AutoTuner:
                 out.extend(fn(now))
             except Exception:
                 log.exception("autotune stage %s failed", fn.__name__)
+        if self.flight is not None:
+            try:
+                p99 = self._observed_p99_ms()
+                if p99 is not None and p99 > self.slo.p99_budget_ms:
+                    self.flight.note_slo_breach(
+                        p99, self.slo.p99_budget_ms, source=self.name)
+            except Exception:
+                log.exception("flight-recorder SLO feed failed")
         return out
 
     # -- stages ------------------------------------------------------------

@@ -58,6 +58,7 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                      segments: Optional[Dict[str, dict]] = None,
                      autotune: Optional[dict] = None,
                      llm: Optional[Dict[str, dict]] = None,
+                     devprof: Optional[dict] = None,
                      extra: Optional[Dict[str, float]] = None,
                      namespace: str = "nns") -> List[Series]:
     """Flatten runtime state into typed series.
@@ -87,6 +88,16 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                  counter, token/finished totals and the selected-kernel
                  info gauge — one scrape proves which attention path
                  served
+    devprof    — DeviceProfiler.stats() (runtime/devprof.py): the
+                 device performance plane.  Cost-registry rows become
+                 nns_jit_* (flops / bytes accessed / compile seconds
+                 per {filter, bucket}); invoke reservoirs become
+                 nns_invoke_* (MFU, achieved TFLOP/s, cumulative
+                 sampled seconds — Σ nns_invoke_seconds_total is
+                 reconcilable against the tracer's proctime histograms
+                 from the same scrape); the HBM ledger becomes
+                 nns_device_hbm_* labelled {device, kind} with a
+                 headroom gauge per device
     extra      — arbitrary numeric gauges {name: value} the caller owns
                  (backend cache sizes, build info, …)
     """
@@ -476,6 +487,99 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
             [({"element": el}, float(st.get("prefilling", 0)))
              for el, st, _ in rows]))
 
+    if devprof:
+        jit = devprof.get("jit", [])
+        inv = devprof.get("invoke", [])
+        out.append(_series(
+            f"{ns}_jit_flops", "gauge",
+            "XLA cost-model FLOPs of the compiled program (a property "
+            "of the (filter, bucket) program, not a rate)",
+            [({"filter": r["filter"], "bucket": r["bucket"]},
+              float(r["flops"])) for r in jit]
+            or [({"filter": "none", "bucket": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_jit_bytes_accessed", "gauge",
+            "XLA cost-model bytes accessed of the compiled program",
+            [({"filter": r["filter"], "bucket": r["bucket"]},
+              float(r["bytes_accessed"])) for r in jit]))
+        out.append(_series(
+            f"{ns}_jit_roofline_info", "gauge",
+            "1 for the bucket's roofline verdict (compute / memory / "
+            "unknown) vs the chip's ridge point",
+            [({"filter": r["filter"], "bucket": r["bucket"],
+               "bound": r["roofline"]}, 1.0) for r in jit]))
+        out.append(_series(
+            f"{ns}_compile_seconds_total", "counter",
+            "cumulative compile wall-seconds per {filter, bucket}",
+            [({"filter": r["filter"], "bucket": r["bucket"]},
+              float(r["compile_s"])) for r in jit]
+            or [({"filter": "none", "bucket": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_compiles_total", "counter",
+            "compile events (fresh executables) per {filter, bucket}",
+            [({"filter": r["filter"], "bucket": r["bucket"]},
+              float(r["compiles"])) for r in jit]))
+        out.append(_series(
+            f"{ns}_invoke_mfu", "gauge",
+            "model FLOPs utilization: achieved TFLOP/s over the "
+            "declared per-chip peak (0 where no peak is declared — "
+            "CPU emulation; see nns_invoke_mfu_calibrated)",
+            [({"filter": r["filter"], "bucket": r["bucket"],
+               "device": r["device"]}, float(r["mfu"])) for r in inv]
+            or [({"filter": "none", "bucket": "none",
+                  "device": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_invoke_mfu_calibrated", "gauge",
+            "achieved TFLOP/s over the best achieved so far — the "
+            "measured calibration denominator where no declared peak "
+            "exists",
+            [({"filter": r["filter"], "bucket": r["bucket"],
+               "device": r["device"]}, float(r["mfu_calibrated"]))
+             for r in inv]))
+        out.append(_series(
+            f"{ns}_invoke_tflops", "gauge",
+            "achieved TFLOP/s (cost-model flops / median sampled "
+            "device seconds)",
+            [({"filter": r["filter"], "bucket": r["bucket"],
+               "device": r["device"]}, float(r["achieved_tflops"]))
+             for r in inv]))
+        out.append(_series(
+            f"{ns}_invoke_seconds_total", "counter",
+            "cumulative sampled device-seconds per {filter, bucket} — "
+            "reconcilable against the proctime histograms' sum from "
+            "the same scrape",
+            [({"filter": r["filter"], "bucket": r["bucket"]},
+              float(r["seconds_total"])) for r in inv]
+            or [({"filter": "none", "bucket": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_invoke_samples_total", "counter",
+            "device-time samples taken per {filter, bucket}",
+            [({"filter": r["filter"], "bucket": r["bucket"]},
+              float(r["samples_total"])) for r in inv]))
+        out.append(_series(
+            f"{ns}_device_hbm_bytes", "gauge",
+            "device memory ledger: memory_stats() rows per {device, "
+            "kind} plus model:<label> attribution rows",
+            [({"device": r["device"], "kind": r["kind"]},
+              float(r["bytes"])) for r in devprof.get("hbm", [])]
+            or [({"device": "none", "kind": "none"}, 0.0)]))
+        out.append(_series(
+            f"{ns}_device_hbm_headroom", "gauge",
+            "fraction of the device's memory limit in use",
+            [({"device": r["device"]}, float(r["frac"]))
+             for r in devprof.get("headroom", [])]))
+        out.append(_series(
+            f"{ns}_device_peak_tflops", "gauge",
+            "declared per-chip bf16 peak TFLOP/s applied as the MFU "
+            "denominator (0 = none declared)",
+            [({"device_kind": str(devprof.get("device_kind", "none"))},
+              float(devprof.get("peak_tflops", 0.0)))]))
+        out.append(_series(
+            f"{ns}_device_calibration_tflops", "gauge",
+            "best achieved TFLOP/s observed (the measured calibration "
+            "peak on platforms with no declared peak)",
+            [({}, float(devprof.get("calibration_tflops", 0.0)))]))
+
     if extra:
         for name, value in sorted(extra.items()):
             try:
@@ -687,6 +791,14 @@ _TOP_KEY_FAMILIES = (
     # autotuner rows: decision rate by knob/outcome + where every
     # controlled knob sits right now
     "nns_autotune_decisions_total", "nns_autotune_knob",
+    # LLM serving rows: token rate = generation goodput, kernel invoke
+    # rate = which attention path is hot, prefilling = admission wave
+    "nns_llm_tokens_total", "nns_llm_kernel_invokes_total",
+    "nns_llm_prefilling",
+    # device performance plane (runtime/devprof.py): MFU and HBM
+    # headroom answer "how close to the hardware" at a glance
+    "nns_invoke_mfu", "nns_invoke_seconds_total",
+    "nns_device_hbm_headroom", "nns_compile_seconds_total",
     "nns_pool_restarts_total", "nns_trace_events_total",
 )
 

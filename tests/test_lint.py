@@ -498,6 +498,50 @@ def test_nnl009_blessed_in_placement_and_parallel():
     })
 
 
+# -- NNL010 device-accounting ------------------------------------------------
+
+BAD_ACCOUNTING = '''
+import jax
+
+PEAK_BF16_TFLOPS = 275.0                 # second peak table: drift bait
+
+def probe(jitted, args):
+    cost = jitted.lower(*args).cost_analysis()   # cost-model read
+    ms = jax.devices()[0].memory_stats()         # memory ledger read
+    return cost, ms
+'''
+
+GOOD_ACCOUNTING = '''
+from nnstreamer_tpu.runtime import devprof
+
+def probe(jitted, args, dt):
+    prof = devprof.get()
+    prof.capture_cost("f", "static", jitted, args, seconds=dt)
+    return prof.stats()
+'''
+
+
+def test_nnl010_fires_on_accounting_outside_devprof():
+    findings = assert_fires(
+        "NNL010", {REPO_PATHS["backend"]: BAD_ACCOUNTING}, n_min=3)
+    msgs = " ".join(f.message for f in findings)
+    assert "cost_analysis" in msgs and "memory_stats" in msgs
+    assert "PEAK_BF16_TFLOPS" in msgs
+
+
+def test_nnl010_silent_on_profiler_reporting():
+    assert_silent("NNL010", {REPO_PATHS["backend"]: GOOD_ACCOUNTING})
+
+
+def test_nnl010_blessed_in_devprof_and_bench():
+    # runtime/devprof.py IS the accounting site; bench.py keeps its
+    # sweep-local peak table by design (it lives outside the package)
+    assert_silent("NNL010", {
+        "nnstreamer_tpu/runtime/devprof.py": BAD_ACCOUNTING,
+        "bench.py": BAD_ACCOUNTING,
+    })
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_inline_suppression_waives_a_finding():
