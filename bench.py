@@ -2089,6 +2089,66 @@ def multitenant_serve() -> dict:
     return out
 
 
+def scenario_serve() -> dict:
+    """Adversarial scenario family (nnstreamer_tpu/scenario): seeded
+    declarative world drills with ONE property checker (four standing
+    invariants from one scrape) and bit-exact replay. Always runs the
+    pool drills from the builtin catalog (smoke + worker-kill) and a
+    replay of the smoke run. BENCH_SCENARIO_GATE=1 additionally runs
+    the composed mesh storm — flash-crowd × blackhole-then-heal ×
+    swap-storm × tenant-flood under one root seed — and gates on zero
+    lost, all four invariants, recovery, and replay totals matching
+    the first run exactly."""
+    from nnstreamer_tpu.scenario import (
+        builtin_specs, replay_scenario, run_scenario)
+
+    specs = builtin_specs()
+    out: dict = {}
+
+    def _point(r: dict) -> dict:
+        check = r.get("check") or {}
+        return {"totals": r["totals"],
+                "capacity_rps": r["capacity_rps"],
+                "invariants": check.get("invariants"),
+                "ok": check.get("ok"),
+                "recovered": r["report"].get("recovered"),
+                "violations": check.get("violations") or []}
+
+    r_smoke = run_scenario(specs["smoke_pool"])
+    out["smoke_pool"] = _point(r_smoke)
+    _family_partial(dict(out))
+    rep = replay_scenario(r_smoke)
+    out["smoke_replay"] = {"replay_match": rep.get("replay_match"),
+                           "replay_diff": rep.get("replay_diff")}
+    _family_partial(dict(out))
+    r_kill = run_scenario(specs["kill_pool"])
+    out["kill_pool"] = _point(r_kill)
+    out["scenario_ok"] = bool(
+        out["smoke_pool"]["ok"] and out["kill_pool"]["ok"]
+        and out["smoke_replay"]["replay_match"])
+    if not out["scenario_ok"]:
+        out["unverified"] = True   # ship the numbers, flag the claim
+    _family_partial(dict(out))
+    if os.environ.get("BENCH_SCENARIO_GATE") == "1":
+        r1 = run_scenario(specs["composed_storm"])
+        out["composed_storm"] = _point(r1)
+        _family_partial(dict(out))
+        r2 = replay_scenario(r1)
+        out["composed_replay"] = {
+            "replay_match": r2.get("replay_match"),
+            "replay_diff": r2.get("replay_diff")}
+        c1 = r1.get("check") or {}
+        out["scenario_gate_ok"] = bool(
+            c1.get("ok") and r1["totals"]["lost"] == 0
+            and all((c1.get("invariants") or {}).values())
+            and r1["report"].get("recovered")
+            and r2.get("replay_match"))
+        if not out["scenario_gate_ok"]:
+            out["unverified"] = True   # ship the numbers, flag it
+        _family_partial(dict(out))
+    return out
+
+
 def multichip_serve() -> dict:
     """Multi-chip placement family (serving/placement.py), on the
     8-device emulated host mesh (_family_main forces JAX_PLATFORMS=cpu
@@ -2269,6 +2329,7 @@ _FAMILIES = {
     "traffic": lambda: traffic_serve(),
     "autotune": lambda: autotune_serve(),
     "multitenant": lambda: multitenant_serve(),
+    "scenario": lambda: scenario_serve(),
     "multichip": lambda: multichip_serve(),
 }
 for _d in OFFLOAD_DELAYS:
@@ -2449,8 +2510,8 @@ def _ordered_families() -> list:
         return list(_FAMILIES)
     return (["cfg_label_device", "pallas", "transformer_prefill",
              "mxu_peak", "batch_sweep", "dyn_batch", "host_path",
-             "llm_serve", "traffic", "multitenant", "multichip",
-             "autotune"]
+             "llm_serve", "traffic", "multitenant", "scenario",
+             "multichip", "autotune"]
             + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
             + [f"offload_{d}" for d in OFFLOAD_DELAYS]
             + ["int8_native", "model_swap", "chaos_smoke"])

@@ -913,6 +913,17 @@ def _flight_main(argv) -> int:
 
     if args.inspect:
         bundle = load_bundle(os.path.join(args.dir, args.inspect))
+        # scenario_violation bundles carry the failing spec in the
+        # cause — surface the repro recipe before the raw dump
+        cause = (bundle.get("cause") or {}).get("cause") or {}
+        spec = cause.get("scenario_spec")
+        if spec and not args.json:
+            print(f"scenario {cause.get('scenario')!r} "
+                  f"seed={cause.get('seed')} — "
+                  f"{len(cause.get('violations') or [])} violation(s); "
+                  f"replay: python -m nnstreamer_tpu scenario run "
+                  f"SPEC.json (spec below in cause.scenario_spec)",
+                  file=sys.stderr)
         print(json.dumps(bundle, indent=None if args.json else 2,
                          default=str))
         return 0
@@ -936,6 +947,155 @@ def _flight_main(argv) -> int:
     return 0
 
 
+def _scenario_load(ref: str, seed=None):
+    """Resolve `ref` to a ScenarioSpec: a builtin catalog name, a spec
+    JSON file, or a saved `scenario run` result JSON (spec embedded)."""
+    from nnstreamer_tpu.scenario import ScenarioSpec, builtin_specs
+
+    specs = builtin_specs()
+    if ref in specs:
+        spec = specs[ref]
+    else:
+        with open(ref, "r", encoding="utf-8") as f:
+            d = json.load(f)
+        if isinstance(d.get("spec"), dict):   # a saved result
+            d = d["spec"]
+        spec = ScenarioSpec.from_dict(d)
+    if seed is not None:
+        import dataclasses
+
+        spec = dataclasses.replace(spec, seed=int(seed))
+    return spec
+
+
+def _scenario_emit(result: dict, out, full: bool) -> None:
+    """Print a result (stdout or --out FILE); per-reply trace contexts
+    are dropped unless --full — they dwarf the ledger."""
+    slim = dict(result)
+    if not full and isinstance(slim.get("report"), dict):
+        slim["report"] = {k: v for k, v in slim["report"].items()
+                          if k != "traces"}
+    text = json.dumps(slim, indent=2, default=str)
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+
+
+def _scenario_main(argv) -> int:
+    """`scenario` subcommand: run / replay / shrink / list seeded
+    adversarial world drills (docs/scenarios.md)."""
+    ap = argparse.ArgumentParser(
+        prog="nnstreamer_tpu scenario",
+        description="composable seeded scenario drills: declarative "
+                    "arrival+fault programs against a real worker pool "
+                    "or mesh, one property checker, deterministic "
+                    "replay and shrinking (docs/scenarios.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    runp = sub.add_parser("run", help="run a scenario; exit 0 iff all "
+                                      "invariants hold")
+    runp.add_argument("spec", help="builtin name (see `scenario list`) "
+                                   "or spec/result JSON file")
+    runp.add_argument("--seed", type=int, default=None,
+                      help="override the root seed")
+    runp.add_argument("--flight-dir", default=None, metavar="DIR",
+                      help="dump a flight bundle here on violation")
+    runp.add_argument("--out", default=None, metavar="FILE",
+                      help="write the result JSON here (else stdout)")
+    runp.add_argument("--full", action="store_true",
+                      help="keep per-reply trace contexts in the JSON")
+    rep = sub.add_parser("replay", help="re-run a saved result's spec "
+                                        "under the same seed and demand "
+                                        "bit-equal ledger totals")
+    rep.add_argument("result", help="result JSON from `scenario run`")
+    rep.add_argument("--out", default=None, metavar="FILE")
+    rep.add_argument("--full", action="store_true")
+    shr = sub.add_parser("shrink", help="ddmin a failing scenario to a "
+                                        "minimal still-failing repro")
+    shr.add_argument("spec", help="builtin name or spec/result JSON")
+    shr.add_argument("--max-runs", type=int, default=40,
+                     help="live-run budget for the search (default 40)")
+    shr.add_argument("--out", default=None, metavar="FILE",
+                     help="write the minimal spec JSON here")
+    sub.add_parser("list", help="list the builtin drill catalog")
+    args = ap.parse_args(argv)
+
+    from nnstreamer_tpu.scenario import builtin_specs
+
+    if args.cmd == "list":
+        print(f"{'name':<16} {'topology':<22} {'arrivals':<9} "
+              f"{'faults':<7} size")
+        print("-" * 62)
+        for name, s in builtin_specs().items():
+            topo = (f"{s.topology.kind}"
+                    f"({s.topology.hosts}x{s.topology.workers}w)")
+            print(f"{name:<16} {topo:<22} {len(s.arrivals):<9} "
+                  f"{len(s.faults):<7} {s.size()}")
+        return 0
+
+    from nnstreamer_tpu.scenario import run_scenario
+
+    if args.cmd == "run":
+        spec = _scenario_load(args.spec, args.seed)
+        result = run_scenario(spec, flight_dir=args.flight_dir)
+        check = result.get("check") or {}
+        _scenario_emit(result, args.out, args.full)
+        for v in check.get("violations") or []:
+            print(f"VIOLATION [{v['invariant']}] {v['detail']}",
+                  file=sys.stderr)
+        print(f"scenario {spec.name!r} seed={spec.seed}: "
+              f"{result['totals']} "
+              f"{'OK' if check.get('ok') else 'FAIL'}",
+              file=sys.stderr)
+        return 0 if check.get("ok") else 1
+
+    if args.cmd == "replay":
+        from nnstreamer_tpu.scenario import replay_scenario
+
+        with open(args.result, "r", encoding="utf-8") as f:
+            prev = json.load(f)
+        result = replay_scenario(prev)
+        _scenario_emit(result, args.out, args.full)
+        match = result.get("replay_match")
+        ok = bool((result.get("check") or {}).get("ok"))
+        if match is False:
+            print(f"replay DIVERGED: {result.get('replay_diff')}",
+                  file=sys.stderr)
+        else:
+            print(f"replay totals match: {result['totals']}",
+                  file=sys.stderr)
+        return 0 if (match is not False and ok) else 1
+
+    # shrink
+    from nnstreamer_tpu.scenario import ShrinkBudgetExceeded, shrink
+
+    spec = _scenario_load(args.spec)
+
+    def fails(candidate) -> bool:
+        r = run_scenario(candidate)
+        return not (r.get("check") or {}).get("ok", False)
+
+    try:
+        minimal, stats = shrink(spec, fails, max_runs=args.max_runs)
+    except ValueError as e:
+        print(f"shrink: {e}", file=sys.stderr)
+        return 1
+    except ShrinkBudgetExceeded as e:
+        print(f"shrink: {e}", file=sys.stderr)
+        return 1
+    text = minimal.to_json()
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    print(f"shrunk {spec.name!r}: size {stats['initial_size']} -> "
+          f"{stats['final_size']} in {stats['runs']} run(s)",
+          file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
@@ -954,6 +1114,8 @@ def main(argv=None) -> int:
         return _top_main(argv[1:])
     if argv and argv[0] == "flight":
         return _flight_main(argv[1:])
+    if argv and argv[0] == "scenario":
+        return _scenario_main(argv[1:])
     if argv and argv[0] == "lint":
         from nnstreamer_tpu.analysis.cli import main as lint_main
 

@@ -26,6 +26,12 @@ NNL010 device-accounting  XLA cost-model reads (cost_analysis()),
                           runtime/devprof.py (bench.py keeps its own
                           sweep-local copy) — one accounting site for
                           "peak" vs "achieved"
+NNL011 seeded-chaos       no unseeded RNG construction
+                          (random.Random() / np.random.default_rng()
+                          with no arguments) in the chaos/load paths
+                          (traffic/, scenario/, serving worker chaos
+                          hooks) — every drill must replay bit-exact
+                          from its recorded seed
 
 Every rule is pure AST — nothing here imports the code under analysis.
 Heuristics err toward silence (a missed finding is a review problem; a
@@ -802,11 +808,52 @@ class DeviceAccountingAudit(Rule):
                         f"MFU denominators drift")
 
 
+class SeededChaosAudit(Rule):
+    rule_id = "NNL011"
+    title = "seeded-chaos"
+    rationale = (
+        "the whole value of a chaos drill is that a failure replays "
+        "bit-exact from its recorded seed (scenario replay, ChaosProxy "
+        "streams, shrinker repros). One `random.Random()` or "
+        "`np.random.default_rng()` constructed WITHOUT a seed anywhere "
+        "in the load/fault path and the repro is theater: the schedule "
+        "that failed last night cannot be rebuilt. Inside the chaos "
+        "paths every RNG takes an explicit seed derived from the run's "
+        "root (ScenarioSpec.sub_seed / per-connection streams)")
+
+    #: the paths where determinism is load-bearing; elsewhere an
+    #: unseeded rng is someone else's design decision
+    SCOPED = ("traffic/", "scenario/", "serving/worker.py")
+    #: constructors that mint a fresh RNG; unseeded = zero positional
+    #: args and no seed= keyword
+    RNG_CALLS = ("random.Random", "np.random.default_rng",
+                 "numpy.random.default_rng", "default_rng")
+
+    def check(self, module: Module, project: Project):
+        p = f"/{module.path}"
+        if not any(f"/{s}" in p for s in self.SCOPED):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name not in self.RNG_CALLS:
+                continue
+            seeded = bool(node.args) or any(
+                k.arg in ("seed", "x") for k in node.keywords)
+            if not seeded:
+                yield node, (
+                    f"unseeded `{name}()` in a chaos/load path: pass a "
+                    f"seed derived from the run's root "
+                    f"(ScenarioSpec.sub_seed / the harness seed) so "
+                    f"the drill replays bit-exact")
+
+
 #: registry, in catalog order
 ALL_RULES: List[Rule] = [
     ElementContract(), ForcedSync(), LockDiscipline(), JitPurity(),
     SpawnSafety(), PicklableErrors(), ThreadAudit(), SocketAudit(),
-    PlacementAudit(), DeviceAccountingAudit(),
+    PlacementAudit(), DeviceAccountingAudit(), SeededChaosAudit(),
 ]
 
 

@@ -50,7 +50,8 @@ log = get_logger("runtime.flightrec")
 #: the trigger kinds a recorder can fire (fixed taxonomy; cause.json
 #: carries the evidence)
 TRIGGERS = ("slo_breach", "conservation", "worker_fence",
-            "kernel_fallback", "watchdog", "manual")
+            "kernel_fallback", "watchdog", "manual",
+            "scenario_violation")
 
 DEFAULT_COOLDOWN_S = 60.0
 

@@ -173,6 +173,31 @@ def hop_spans(hops: List[dict]) -> Dict[str, Any]:
     return out
 
 
+#: the hop chain every REPLIED frame's trace must carry on the serving
+#: path (client_send/client_recv are recorded locally by the loadgen,
+#: not serialized, so they are not part of the reply's context). A
+#: redelivered frame repeats hops; completeness only asks that each
+#: stage appears at least once.
+REQUIRED_REPLY_HOPS = ("admit", "dequeue", "dispatch", "worker_recv",
+                       "worker_done", "reply")
+
+
+def missing_hops(hops: List[dict],
+                 required: tuple = REQUIRED_REPLY_HOPS) -> tuple:
+    """The required hop names absent from a trace's hop list, in
+    canonical order — empty tuple means the chain is complete."""
+    seen = {h.get("hop") for h in hops if isinstance(h, dict)}
+    return tuple(r for r in required if r not in seen)
+
+
+def trace_chain_complete(hops: List[dict],
+                         required: tuple = REQUIRED_REPLY_HOPS) -> bool:
+    """True iff the trace carries the full serving hop chain — the
+    trace-completeness invariant the scenario checker
+    (scenario/checker.py) evaluates for every replied frame."""
+    return not missing_hops(hops, required)
+
+
 #: histogram bucket upper bounds (seconds) for per-element proctime —
 #: log-spaced 10µs → 10s, the range a pipeline stage can plausibly
 #: occupy; rendered as Prometheus `le` buckets by serving/metrics.py

@@ -153,6 +153,7 @@ class MeshRouter:
                  frame_deadline_s: float = 30.0,
                  zone: str = "",
                  tracer=None,
+                 tenants=None,
                  name: str = "mesh"):
         self.name = name
         self.zone = zone
@@ -169,6 +170,8 @@ class MeshRouter:
         self.qs.frames.configure(max_pending=max_pending,
                                  max_inflight=max_inflight,
                                  shed_policy=shed_policy)
+        if tenants is not None:
+            self.set_tenants(tenants)
         if tracer is not None:
             self.qs.tracer = tracer
         self._lock = threading.RLock()
@@ -648,6 +651,15 @@ class MeshRouter:
 
     def depth_probe(self) -> int:
         return self.qs.frames.depth
+
+    def set_tenants(self, table) -> None:
+        """Install (or clear, with None) a weighted-fair `TenantTable`
+        on the router's admission queue — the mesh twin of
+        `PooledQueryServer(tenants=...)`. The class resolved at offer
+        rides the frame's meta through the host round-trip (workers
+        echo meta), so the reply settles against the right class and
+        the per-class conservation books close across hosts."""
+        self.qs.frames.set_tenants(table)
 
     def admission_counters(self) -> dict:
         return self.qs.frames.counters()

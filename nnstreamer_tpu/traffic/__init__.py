@@ -25,10 +25,12 @@ traffic`, and `bench.py --family traffic`. See docs/traffic.md.
 from nnstreamer_tpu.traffic.admission import (
     DEADLINE_META, SHED_POLICIES, AdmissionDecision, AdmissionQueue)
 from nnstreamer_tpu.traffic.loadgen import (
-    EchoServer, bursty_arrivals, merge_tenant_arrivals,
-    noisy_neighbor_drill, poisson_arrivals, run_against_echo,
-    run_against_mesh, run_against_pool, run_autotune_ramp,
-    run_multitenant, run_open_loop)
+    EchoServer, MeshWorld, bursty_arrivals, conservation_ok,
+    diurnal_arrivals, flash_crowd_arrivals, merge_tenant_arrivals,
+    noisy_neighbor_drill, poisson_arrivals, replay_report,
+    run_against_echo, run_against_mesh, run_against_pool,
+    run_autotune_ramp, run_multitenant, run_open_loop,
+    schedule_worker_kills, tenant_conservation_ok)
 from nnstreamer_tpu.traffic.netchaos import ChaosProxy
 
 __all__ = [
@@ -38,14 +40,21 @@ __all__ = [
     "DEADLINE_META",
     "SHED_POLICIES",
     "EchoServer",
+    "MeshWorld",
     "bursty_arrivals",
+    "conservation_ok",
+    "diurnal_arrivals",
+    "flash_crowd_arrivals",
     "merge_tenant_arrivals",
     "noisy_neighbor_drill",
     "poisson_arrivals",
+    "replay_report",
     "run_against_echo",
     "run_against_mesh",
     "run_against_pool",
     "run_autotune_ramp",
     "run_multitenant",
     "run_open_loop",
+    "schedule_worker_kills",
+    "tenant_conservation_ok",
 ]

@@ -15,6 +15,13 @@ Arrival processes:
   alternates between a high-rate and a low-rate state with
   exponentially-distributed dwell times. Same mean rate as a Poisson
   source can carry; the bursts are what break naive admission.
+- ``diurnal_arrivals(n, ...)``   — inhomogeneous Poisson whose rate
+  swings sinusoidally between a trough and a peak (a day/night load
+  curve compressed to seconds).
+- ``flash_crowd_arrivals(n, ...)`` — base-rate Poisson until
+  ``ramp_at_s``, then a linear ramp to the peak rate over ``ramp_s``
+  that stays there: the thundering-herd shape scenario drills
+  (scenario/) compose with faults.
 
 Per-request outcome accounting is exhaustive: every sent request ends
 as *completed* (RESULT received), *rejected* (typed BUSY received), or
@@ -85,6 +92,67 @@ def bursty_arrivals(n: int, *, rate_high_hz: float, rate_low_hz: float,
     return np.asarray(out)
 
 
+def _inhomogeneous_arrivals(n: int, rate_of: Callable[[float], float],
+                            rng: np.random.Generator) -> np.ndarray:
+    """`n` cumulative arrival times of an inhomogeneous Poisson process
+    whose instantaneous rate is `rate_of(t)`: each inter-arrival gap is
+    drawn exponential at the rate in force when it starts. For rates
+    that vary slowly relative to the gap (every program here) this is
+    indistinguishable from thinning and stays strictly sequential in
+    the rng — one draw per arrival, so seeds replay bit-exact."""
+    out: List[float] = []
+    t = 0.0
+    for _ in range(n):
+        t += float(rng.exponential(1.0 / max(rate_of(t), 1e-9)))
+        out.append(t)
+    return np.asarray(out)
+
+
+def diurnal_arrivals(n: int, *, peak_hz: float, trough_hz: float,
+                     period_s: float = 4.0,
+                     rng: Optional[np.random.Generator] = None
+                     ) -> np.ndarray:
+    """`n` cumulative arrival times whose rate swings sinusoidally
+    between `trough_hz` and `peak_hz` with period `period_s`, starting
+    at the midpoint on the rising edge."""
+    if trough_hz <= 0 or peak_hz < trough_hz:
+        raise ValueError(
+            f"need 0 < trough_hz <= peak_hz, got {trough_hz}/{peak_hz}")
+    if period_s <= 0:
+        raise ValueError(f"period_s must be > 0, got {period_s}")
+    rng = rng if rng is not None else np.random.default_rng(0)
+    mid = (peak_hz + trough_hz) / 2.0
+    amp = (peak_hz - trough_hz) / 2.0
+    return _inhomogeneous_arrivals(
+        n, lambda t: mid + amp * float(np.sin(2 * np.pi * t / period_s)),
+        rng)
+
+
+def flash_crowd_arrivals(n: int, *, base_hz: float, peak_hz: float,
+                         ramp_at_s: float, ramp_s: float = 0.5,
+                         rng: Optional[np.random.Generator] = None
+                         ) -> np.ndarray:
+    """`n` cumulative arrival times of a flash crowd: Poisson at
+    `base_hz` until `ramp_at_s`, then a linear rate ramp to `peak_hz`
+    over `ramp_s` that never comes back down."""
+    if base_hz <= 0 or peak_hz < base_hz:
+        raise ValueError(
+            f"need 0 < base_hz <= peak_hz, got {base_hz}/{peak_hz}")
+    if ramp_at_s < 0 or ramp_s <= 0:
+        raise ValueError(
+            f"need ramp_at_s >= 0 and ramp_s > 0, got "
+            f"{ramp_at_s}/{ramp_s}")
+    rng = rng if rng is not None else np.random.default_rng(0)
+
+    def rate_of(t: float) -> float:
+        if t < ramp_at_s:
+            return base_hz
+        frac = min(1.0, (t - ramp_at_s) / ramp_s)
+        return base_hz + (peak_hz - base_hz) * frac
+
+    return _inhomogeneous_arrivals(n, rate_of, rng)
+
+
 # -- open-loop runner --------------------------------------------------------
 
 def run_open_loop(host: str, port: int, *, dims: str,
@@ -97,7 +165,8 @@ def run_open_loop(host: str, port: int, *, dims: str,
                   depth_probe: Optional[Callable[[], int]] = None,
                   depth_sample_ms: float = 25.0,
                   group_of: Optional[Callable[[int], str]] = None,
-                  trace: bool = False) -> dict:
+                  trace: bool = False,
+                  collect_traces: bool = False) -> dict:
     """Drive one live query server open-loop; return the SLO report.
 
     make_frame(i) builds request i's TensorBuffer (its pts is forced to
@@ -334,6 +403,12 @@ def run_open_loop(host: str, port: int, *, dims: str,
                               else v) for k, v in spans.items()},
             }
         report["traced_replies"] = len(traces)
+        if collect_traces:
+            # raw per-reply trace contexts, keyed by pts — what the
+            # scenario property checker needs to prove every replied
+            # frame carries the full hop chain
+            report["traces"] = {int(i): ctx for i, ctx in traces.items()
+                                if i < n_sent}
         # redelivery audit: replies whose trace context carries a
         # router/mesh "reoffer" hop survived a worker death or a host
         # fence — list which workers/hosts each one touched, proving
@@ -480,6 +555,12 @@ def run_against_echo(*, pattern: str = "poisson", load_x: float = 2.0,
         report["server_crashed"] = srv.crashed()
         report["admission"] = srv.admission_counters()
         report["seed"] = int(seed)
+        report["schedule"] = {
+            "kind": "echo", "pattern": pattern, "load_x": load_x,
+            "n": n, "service_ms": service_ms,
+            "max_pending": max_pending, "max_inflight": max_inflight,
+            "shed_policy": shed_policy,
+            "p99_budget_ms": p99_budget_ms, "trace": bool(trace)}
         return report
     finally:
         srv.stop()
@@ -495,7 +576,7 @@ def _arrivals_for(pattern: str, rate: float, n: int,
     raise ValueError(f"pattern must be poisson|bursty, got {pattern!r}")
 
 
-def _conservation_ok(c: dict) -> bool:
+def conservation_ok(c: dict) -> bool:
     """The PR-9 invariants, checked over an admission counters()
     snapshot — they must hold exactly even across a worker kill."""
     return (c["offered"] == c["admitted"] + sum(c["rejected"].values())
@@ -503,12 +584,12 @@ def _conservation_ok(c: dict) -> bool:
             + c["depth"] + c["inflight"])
 
 
-def _tenant_conservation_ok(c: dict) -> bool:
+def tenant_conservation_ok(c: dict) -> bool:
     """Per-class form of the invariants: each class's counters must
     close exactly on their own, AND the classes must sum back to the
     global counters — shed load can move between classes only through
     the books."""
-    if not _conservation_ok(c):
+    if not conservation_ok(c):
         return False
     classes = c.get("classes")
     if not classes:
@@ -605,7 +686,7 @@ def run_autotune_ramp(*, ramp=(0.5, 1.0, 1.5, 2.0, 2.5),
 
             def on_apply(rec):
                 conservation_after_apply.append(
-                    _conservation_ok(adm.counters()))
+                    conservation_ok(adm.counters()))
                 applied.append({"knob": rec["knob"], "old": rec["old"],
                                 "new": rec["new"]})
 
@@ -636,7 +717,7 @@ def run_autotune_ramp(*, ramp=(0.5, 1.0, 1.5, 2.0, 2.5),
             report["audit"] = tuner.audit()
             report["conservation_after_apply"] = conservation_after_apply
             report["applied"] = applied
-        report["conservation_final"] = _conservation_ok(
+        report["conservation_final"] = conservation_ok(
             srv.admission_counters())
         report["admission"] = srv.admission_counters()
         report["ramp"] = [float(x) for x in ramp]
@@ -647,6 +728,14 @@ def run_autotune_ramp(*, ramp=(0.5, 1.0, 1.5, 2.0, 2.5),
         report["dry_run"] = bool(dry_run)
         report["server_crashed"] = srv.crashed()
         report["seed"] = int(seed)
+        report["schedule"] = {
+            "kind": "autotune_ramp", "ramp": [float(x) for x in ramp],
+            "n_per_step": n_per_step, "service_ms": service_ms,
+            "static_max_pending": static_max_pending,
+            "p99_budget_ms": p99_budget_ms, "tuned": bool(tuned),
+            "dry_run": bool(dry_run),
+            "tick_interval_s": tick_interval_s,
+            "cooldown_s": cooldown_s}
         return report
     finally:
         if tuner is not None:
@@ -729,8 +818,17 @@ def run_multitenant(*, tenants: Dict[str, dict],
             "tenants": {name: {"rate_hz": rate_hz.get(name),
                                "n": n_per_tenant.get(name, 0)}
                         for name in tenants},
-            "conserved": _tenant_conservation_ok(c),
+            "conserved": tenant_conservation_ok(c),
             "admission": c,
+            "schedule": {
+                "kind": "multitenant",
+                "tenants": {k: dict(v) for k, v in tenants.items()},
+                "n_per_tenant": dict(n_per_tenant),
+                "rate_hz": {k: float(v) for k, v in rate_hz.items()},
+                "workers": workers, "service_ms": service_ms,
+                "max_pending": max_pending,
+                "shed_policy": shed_policy,
+                "p99_budget_ms": p99_budget_ms},
         })
         return report
     finally:
@@ -803,6 +901,42 @@ def noisy_neighbor_drill(*, victim_weight: float = 1.0,
     }
 
 
+def schedule_worker_kills(pool, *, workers: int,
+                          rng: np.random.Generator,
+                          kill_at_s: float, kills: int,
+                          stagger_s: float = 0.25
+                          ) -> "tuple[List[dict], List[threading.Timer]]":
+    """Fault-injector primitive: plan `kills` SIGKILLs of rng-chosen
+    workers starting at `kill_at_s` (staggered by `stagger_s`). Returns
+    (schedule, timers); the caller starts the timers when its clock
+    starts and cancels them when the run ends. Each schedule entry's
+    ``pid`` is filled in when its kill actually lands, so the executed
+    schedule is the replay record. Shared by `run_against_pool` and the
+    scenario executor (scenario/executor.py)."""
+    schedule: List[dict] = []
+    timers: List[threading.Timer] = []
+    for k in range(max(0, kills)):
+        t_k = kill_at_s + k * stagger_s
+        wid = int(rng.integers(0, workers))
+        entry = {"t_s": round(t_k, 3), "wid": wid, "pid": None}
+        schedule.append(entry)
+
+        def do_kill(entry=entry):
+            # the chosen slot may be dead/restarting already: fall
+            # back to any live worker so the kill still happens
+            pid = pool.kill_worker(entry["wid"])
+            if pid is None:
+                pid = pool.kill_worker(None)
+            entry["pid"] = pid
+
+        t = threading.Timer(t_k, do_kill)
+        # cancelled by the caller; daemon besides, so an exception
+        # between here and start() can't hang exit
+        t.daemon = True
+        timers.append(t)
+    return schedule, timers
+
+
 def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,
                      n: int = 300, service_ms: float = 20.0,
                      workers: int = 2, max_pending: int = 32,
@@ -839,27 +973,9 @@ def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,
         arrivals = _arrivals_for(pattern, rate, n, rng)
         if kill_at_s is None:
             kill_at_s = float(arrivals[len(arrivals) // 2])
-        schedule: List[dict] = []
-        timers: List[threading.Timer] = []
-        for k in range(max(0, kills)):
-            t_k = kill_at_s + k * 0.25    # stagger multi-kill runs
-            wid = int(rng.integers(0, workers))
-            entry = {"t_s": round(t_k, 3), "wid": wid, "pid": None}
-            schedule.append(entry)
-
-            def do_kill(entry=entry):
-                # the chosen slot may be dead/restarting already: fall
-                # back to any live worker so the kill still happens
-                pid = pool.kill_worker(entry["wid"])
-                if pid is None:
-                    pid = pool.kill_worker(None)
-                entry["pid"] = pid
-
-            t = threading.Timer(t_k, do_kill)
-            # cancelled in the finally below; daemon besides, so an
-            # exception between here and start() can't hang exit
-            t.daemon = True
-            timers.append(t)
+        schedule, timers = schedule_worker_kills(
+            pool, workers=workers, rng=rng, kill_at_s=kill_at_s,
+            kills=kills)
 
         x = np.ones((8, 1), np.float32)
         for t in timers:
@@ -887,10 +1003,19 @@ def run_against_pool(*, pattern: str = "poisson", load_x: float = 1.5,
             "service_ms": service_ms, "workers": workers,
             "capacity_rps": round(pqs.capacity_rps, 1),
             "seed": int(seed),
+            "schedule": {
+                "kind": "pool", "pattern": pattern, "load_x": load_x,
+                "n": n, "service_ms": service_ms, "workers": workers,
+                "max_pending": max_pending,
+                "max_inflight": max_inflight,
+                "shed_policy": shed_policy,
+                "p99_budget_ms": p99_budget_ms,
+                "kill_at_s": round(float(kill_at_s), 3),
+                "kills": kills, "trace": bool(trace)},
             "kill_schedule": schedule,
             "recovered": recovered,
             "recovery_s": round(time.perf_counter() - t_rec, 3),
-            "conserved": _conservation_ok(c),
+            "conserved": conservation_ok(c),
             "admission": c,
             "pool": pool.stats(),
         })
@@ -918,6 +1043,86 @@ def _next_mesh_sid() -> int:
 
         _mesh_sids = itertools.count(9500)
     return next(_mesh_sids)
+
+
+class MeshWorld:
+    """A live multi-host mesh fixture: a `MeshRouter` fronting `hosts`
+    subprocess worker pools joined by `HostAgent`s, with a seeded
+    `ChaosProxy` inserted in front of every host index in
+    `proxy_hosts`. The build/teardown half of `run_against_mesh`,
+    extracted so the scenario executor (scenario/executor.py) can
+    compose its own fault programs against the same world. Drive
+    traffic at ``world.router.port``; call `all_pids()` BEFORE
+    `close()` to feed the post-close orphan audit."""
+
+    def __init__(self, *, hosts: int, workers_per_host: int = 1,
+                 service_ms: float = 20.0, max_pending: int = 64,
+                 lease_s: float = 1.0, max_redeliver: int = 2,
+                 seed: int = 0, proxy_hosts=(),
+                 dims: str = "8:1", types: str = "float32",
+                 connect_timeout_s: float = 2.0,
+                 wait_timeout_s: float = 10.0,
+                 trace_hosts: bool = False, **mesh_kwargs):
+        from nnstreamer_tpu.runtime.tracing import Tracer
+        from nnstreamer_tpu.serving.mesh import MeshRouter, pool_join
+        from nnstreamer_tpu.serving.pool import PooledQueryServer
+        from nnstreamer_tpu.traffic.netchaos import ChaosProxy
+
+        if hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {hosts}")
+        self.hosts = hosts
+        self.workers_per_host = workers_per_host
+        self.service_ms = service_ms
+        self.router = MeshRouter(
+            sid=_next_mesh_sid(), dims=dims, types=types,
+            max_pending=max_pending, lease_s=lease_s,
+            max_redeliver=max_redeliver, **mesh_kwargs)
+        self.pools: List = []
+        self.agents: List = []
+        self.proxies: Dict[int, "ChaosProxy"] = {}
+        try:
+            for k in range(hosts):
+                # a traced host pool runs traced workers, which is what
+                # puts worker_recv/worker_done on the reply hop chain
+                # (tracing.REQUIRED_REPLY_HOPS) the scenario checker
+                # audits — plain drills skip the decode cost
+                pqs = PooledQueryServer.echo(
+                    workers=workers_per_host, service_ms=service_ms,
+                    sid=_next_mesh_sid(), max_pending=max_pending,
+                    tracer=Tracer() if trace_hosts else None)
+                self.pools.append(pqs)
+                r_host, r_port = "127.0.0.1", self.router.port
+                if k in proxy_hosts:
+                    proxy = ChaosProxy("127.0.0.1", self.router.port,
+                                       seed=seed)
+                    self.proxies[k] = proxy
+                    r_host, r_port = proxy.host, proxy.port
+                self.agents.append(pool_join(
+                    pqs, r_host, r_port, name=f"host{k}",
+                    connect_timeout_s=connect_timeout_s))
+            if not self.router.wait_hosts(hosts,
+                                          timeout_s=wait_timeout_s):
+                raise StreamError(
+                    f"mesh harness: only {self.router.ready_hosts()}"
+                    f"/{hosts} hosts registered")
+        except Exception:
+            self.close()
+            raise
+
+    @property
+    def capacity_rps(self) -> float:
+        return self.hosts * self.workers_per_host * 1e3 / self.service_ms
+
+    def all_pids(self) -> List[int]:
+        return [p for pqs in self.pools
+                for p in pqs.pool.all_pids_ever()]
+
+    def close(self) -> None:
+        _mesh_teardown(self.agents, list(self.proxies.values()),
+                       self.pools, self.router)
+        self.agents, self.pools = [], []
+        self.proxies = {}
+        self.router = None
 
 
 def run_against_mesh(*, hosts: int = 2, workers_per_host: int = 1,
@@ -950,75 +1155,42 @@ def run_against_mesh(*, hosts: int = 2, workers_per_host: int = 1,
     starts and the report waits for the agent's rejoin
     (`rejoined`) — the full fence → re-offer → rejoin cycle.
     """
-    from nnstreamer_tpu.serving.mesh import MeshRouter, pool_join
-    from nnstreamer_tpu.serving.pool import PooledQueryServer, proc_alive
-    from nnstreamer_tpu.traffic.netchaos import ChaosProxy
+    from nnstreamer_tpu.serving.pool import proc_alive
 
-    if hosts < 1:
-        raise ValueError(f"hosts must be >= 1, got {hosts}")
     rng = np.random.default_rng(seed)
-    router = MeshRouter(
-        sid=_next_mesh_sid(), dims="8:1", types="float32",
-        max_pending=max_pending, lease_s=lease_s,
-        max_redeliver=max_redeliver, **mesh_kwargs)
-    pools: List = []
-    agents: List = []
-    proxies: List = []
-    timers: List[threading.Timer] = []
-    t_bh = [None]                    # monotonic blackhole instant
+    world = MeshWorld(
+        hosts=hosts, workers_per_host=workers_per_host,
+        service_ms=service_ms, max_pending=max_pending,
+        lease_s=lease_s, max_redeliver=max_redeliver, seed=seed,
+        proxy_hosts=(() if blackhole_host is None
+                     else (blackhole_host,)), **mesh_kwargs)
+    router = world.router
+    closed = False
     try:
-        for k in range(hosts):
-            pqs = PooledQueryServer.echo(
-                workers=workers_per_host, service_ms=service_ms,
-                sid=_next_mesh_sid(), max_pending=max_pending)
-            pools.append(pqs)
-            r_host, r_port = "127.0.0.1", router.port
-            if blackhole_host is not None and k == blackhole_host:
-                proxy = ChaosProxy("127.0.0.1", router.port, seed=seed)
-                proxies.append(proxy)
-                r_host, r_port = proxy.host, proxy.port
-            agents.append(pool_join(
-                pqs, r_host, r_port, name=f"host{k}",
-                connect_timeout_s=2.0))
-        if not router.wait_hosts(hosts, timeout_s=10.0):
-            raise StreamError(
-                f"mesh harness: only {router.ready_hosts()}/{hosts} "
-                f"hosts registered")
-
-        capacity = hosts * workers_per_host * 1e3 / service_ms
+        capacity = world.capacity_rps
         arrivals = _arrivals_for(pattern, load_x * capacity, n, rng)
         if blackhole_at_s is None:
             blackhole_at_s = float(arrivals[len(arrivals) // 2])
-        if proxies:
-            proxy = proxies[0]
-
-            def do_blackhole():
-                t_bh[0] = time.monotonic()
-                proxy.blackhole()
-
-            t = threading.Timer(blackhole_at_s, do_blackhole)
-            t.daemon = True
-            timers.append(t)
+        proxy = world.proxies.get(blackhole_host) \
+            if blackhole_host is not None else None
+        t_prog = time.monotonic()
+        if proxy is not None:
+            # the partition is a scheduled ChaosProxy program, not
+            # hand-rolled timers: the harness owns the clock instant
+            # and the proxy's applied-event log is the ground truth
+            events = [(blackhole_at_s, "blackhole")]
             if heal_after_s is not None:
-                t2 = threading.Timer(blackhole_at_s + heal_after_s,
-                                     proxy.heal)
-                t2.daemon = True
-                timers.append(t2)
+                events.append((blackhole_at_s + heal_after_s, "heal"))
+            proxy.program(events, t0=t_prog)
 
         x = np.ones((8, 1), np.float32)
-        for t in timers:
-            t.start()
-        try:
-            report = run_open_loop(
-                "127.0.0.1", router.port, dims="8:1", types="float32",
-                arrivals=arrivals,
-                make_frame=lambda i: TensorBuffer.of(x, pts=i),
-                p99_budget_ms=p99_budget_ms,
-                drain_timeout_s=drain_timeout_s,
-                depth_probe=router.depth_probe, trace=trace)
-        finally:
-            for t in timers:
-                t.cancel()
+        report = run_open_loop(
+            "127.0.0.1", router.port, dims="8:1", types="float32",
+            arrivals=arrivals,
+            make_frame=lambda i: TensorBuffer.of(x, pts=i),
+            p99_budget_ms=p99_budget_ms,
+            drain_timeout_s=drain_timeout_s,
+            depth_probe=router.depth_probe, trace=trace)
         c = router.admission_counters()
         stats = router.stats()
         report.update({
@@ -1027,8 +1199,22 @@ def run_against_mesh(*, hosts: int = 2, workers_per_host: int = 1,
             "workers_per_host": workers_per_host,
             "capacity_rps": round(capacity, 1),
             "seed": int(seed),
+            "schedule": {
+                "kind": "mesh", "hosts": hosts,
+                "workers_per_host": workers_per_host,
+                "pattern": pattern, "load_x": load_x, "n": n,
+                "service_ms": service_ms, "max_pending": max_pending,
+                "p99_budget_ms": p99_budget_ms, "lease_s": lease_s,
+                "max_redeliver": max_redeliver,
+                "blackhole_at_s": (round(float(blackhole_at_s), 3)
+                                   if blackhole_host is not None
+                                   else None),
+                "blackhole_host": blackhole_host,
+                "heal_after_s": heal_after_s,
+                "drain_timeout_s": drain_timeout_s,
+                "trace": bool(trace)},
             "lease_s": lease_s,
-            "conserved": _conservation_ok(c),
+            "conserved": conservation_ok(c),
             "admission": c,
             "mesh": stats,
             # every router reply maps to exactly one host reply: the
@@ -1036,10 +1222,15 @@ def run_against_mesh(*, hosts: int = 2, workers_per_host: int = 1,
             "perhost_replied_sum": sum(h["replied"]
                                        for h in stats["hosts"]),
         })
-        if t_bh[0] is not None:
+        t_bh = proxy.applied("blackhole") if proxy is not None else None
+        if proxy is not None and t_bh is None:
+            # flood drained before the partition was due: drop the
+            # pending program so no surprise fault lands mid-teardown
+            proxy.cancel_program()
+        if t_bh is not None:
             fences = [e for e in router.events
-                      if e[2] == "fence" and e[0] >= t_bh[0]]
-            detect_s = (fences[0][0] - t_bh[0]) if fences else None
+                      if e[2] == "fence" and e[0] >= t_bh]
+            detect_s = (fences[0][0] - t_bh) if fences else None
             report["blackhole_at_s"] = round(blackhole_at_s, 3)
             report["fence_detect_s"] = \
                 round(detect_s, 3) if detect_s is not None else None
@@ -1049,13 +1240,12 @@ def run_against_mesh(*, hosts: int = 2, workers_per_host: int = 1,
                 fences and detect_s <= 2.0 * lease_s
                 and report["lost"] == 0 and report["conserved"])
             if heal_after_s is not None:
-                # the flood may drain before the heal timer fires (it
-                # was cancelled with the rest) — the harness owns the
-                # schedule, so heal at the promised offset regardless
-                wait = (t_bh[0] + heal_after_s) - time.monotonic()
-                if wait > 0:
-                    time.sleep(wait)
-                proxies[0].heal()        # idempotent if timer won
+                # the flood may drain early, but the program still
+                # heals at the promised scenario-clock offset — wait
+                # for its last event to land, then for the rejoin
+                remaining = (t_prog + blackhole_at_s + heal_after_s) \
+                    - time.monotonic()
+                proxy.wait_program(max(0.0, remaining) + 10.0)
                 deadline = time.monotonic() + 10.0
                 while time.monotonic() < deadline and \
                         router.ready_hosts() < hosts:
@@ -1063,15 +1253,14 @@ def run_against_mesh(*, hosts: int = 2, workers_per_host: int = 1,
                 report["rejoined"] = router.ready_hosts() >= hosts
         # orphan audit must run AFTER close(): a pid still alive once
         # every pool drained is a leaked child
-        all_pids = [p for pqs in pools
-                    for p in pqs.pool.all_pids_ever()]
-        _mesh_teardown(agents, proxies, pools, router)
-        agents, proxies, pools = [], [], []
-        router = None
+        all_pids = world.all_pids()
+        world.close()
+        closed = True
         report["orphans"] = [p for p in all_pids if proc_alive(p)]
         return report
     finally:
-        _mesh_teardown(agents, proxies, pools, router)
+        if not closed:
+            world.close()
 
 
 def _mesh_teardown(agents, proxies, pools, router) -> None:
@@ -1083,3 +1272,41 @@ def _mesh_teardown(agents, proxies, pools, router) -> None:
         pqs.close()
     if router is not None:
         router.close()
+
+
+# -- replay ------------------------------------------------------------------
+
+def replay_report(report: dict) -> dict:
+    """Re-run the exact drill a ``run_*`` report records. Every runner
+    stamps a top-level ``{"seed", "schedule"}`` block sufficient to
+    reconstruct its run; this dispatches back into the runner with the
+    recorded arguments. Same seed → same arrival trace and same
+    planned fault schedule; a quiescent run (zero lost, fully drained)
+    replays to the same offered/admitted/replied totals."""
+    sched = report.get("schedule")
+    seed = report.get("seed")
+    if not isinstance(sched, dict) or "kind" not in sched \
+            or seed is None:
+        raise ValueError(
+            "report carries no replayable {'seed', 'schedule'} block")
+    kw = dict(sched)
+    kind = kw.pop("kind")
+    fn = _REPLAY_RUNNERS.get(kind)
+    if fn is None:
+        raise ValueError(
+            f"unknown schedule kind {kind!r}; expected one of "
+            f"{sorted(_REPLAY_RUNNERS)}")
+    return fn(seed=int(seed), **kw)
+
+
+_REPLAY_RUNNERS: Dict[str, Callable[..., dict]] = {
+    "echo": run_against_echo,
+    "autotune_ramp": run_autotune_ramp,
+    "multitenant": run_multitenant,
+    "pool": run_against_pool,
+    "mesh": run_against_mesh,
+}
+
+#: pre-PR-19 private names, kept for the callers that grew up with them
+_conservation_ok = conservation_ok
+_tenant_conservation_ok = tenant_conservation_ok

@@ -23,6 +23,10 @@ Fault model (docs/robustness.md failure matrix):
   keeping the connection open, so the sender's kernel buffer fills and
   unbounded ``sendall`` calls wedge (what `Connection.send(timeout=)`
   exists to survive); after the linger everything closes.
+- ``program(events)`` — the switches above applied on a schedule
+  relative to one clock instant, so a scenario executor (rather than
+  ad-hoc caller sleeps) owns WHEN faults land; applied events are
+  logged for fence math and replay audits.
 
 Determinism: every per-message decision comes from `random.Random`
 streams seeded from (seed, connection index, direction) — same seed,
@@ -103,6 +107,8 @@ class ChaosProxy:
         self._blackholed = threading.Event()
         self._frozen = threading.Event()   # slow_close: stop reading
         self._stopping = threading.Event()
+        self._program = None               # (thread, cancel, done)
+        self.program_log: List[dict] = []
         self._lock = threading.Lock()
         self._routes: List[_Route] = []
         self._next_idx = 0
@@ -161,6 +167,104 @@ class ChaosProxy:
 
         threading.Thread(target=finish, name="netchaos-slow-close",
                          daemon=True).start()
+
+    # -- scheduled fault programs ------------------------------------------
+    #: switch ops a program may apply; slow_close takes the linger arg
+    PROGRAM_OPS = ("blackhole", "heal", "slow_close")
+
+    def program(self, events, *, t0: Optional[float] = None) -> None:
+        """Apply fault switches at scenario-clock offsets: ``events``
+        is a list of ``(t_s, op)`` or ``(t_s, op, arg)`` with op in
+        `PROGRAM_OPS` and t_s seconds relative to ``t0`` (a
+        `time.monotonic` instant; default: now). One scheduler thread
+        sleeps to each offset and flips the switch — callers stop
+        hand-rolling Timer/sleep choreography and the executor
+        (scenario/executor.py) owns the clock.
+
+        Only the switches move; per-message fault decisions still come
+        from the per-(seed, connection, direction) RNG streams, drawn
+        for every message in fixed order — a scheduled program does not
+        perturb where an existing seed places its drops.
+
+        Applied events land in `program_log` as
+        ``{"t_s", "op", "applied_monotonic"}`` rows, the ground truth
+        for fence-detection math and replay audits."""
+        evs = []
+        for ev in events:
+            if len(ev) == 2:
+                t_s, op = ev
+                arg = None
+            elif len(ev) == 3:
+                t_s, op, arg = ev
+            else:
+                raise ValueError(f"program event must be (t_s, op[, arg]),"
+                                 f" got {ev!r}")
+            if op not in self.PROGRAM_OPS:
+                raise ValueError(
+                    f"unknown program op {op!r}; expected one of "
+                    f"{self.PROGRAM_OPS}")
+            if float(t_s) < 0:
+                raise ValueError(f"program offset must be >= 0, got {t_s}")
+            evs.append((float(t_s), op, arg))
+        evs.sort(key=lambda e: e[0])
+        self.cancel_program()
+        start = time.monotonic() if t0 is None else float(t0)
+        cancel = threading.Event()
+        done = threading.Event()
+
+        def run():
+            try:
+                for t_s, op, arg in evs:
+                    wait = start + t_s - time.monotonic()
+                    if wait > 0 and cancel.wait(wait):
+                        return
+                    if cancel.is_set() or self._stopping.is_set():
+                        return
+                    if op == "blackhole":
+                        self.blackhole()
+                    elif op == "heal":
+                        self.heal()
+                    else:
+                        self.slow_close(arg if arg is not None else 0.5)
+                    with self._lock:
+                        self.program_log.append({
+                            "t_s": round(t_s, 3), "op": op,
+                            "applied_monotonic": time.monotonic()})
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, name=f"netchaos-prog:{self.port}",
+                             daemon=True)
+        self._program = (t, cancel, done)
+        t.start()
+
+    def cancel_program(self) -> None:
+        """Stop a running program; already-applied switches stay."""
+        prog = getattr(self, "_program", None)
+        if prog is None:
+            return
+        t, cancel, done = prog
+        cancel.set()
+        done.set()
+        t.join(timeout=2)
+        self._program = None
+
+    def wait_program(self, timeout_s: float = 10.0) -> bool:
+        """Block until the current program applied its last event (or
+        was cancelled). True if it finished within the timeout."""
+        prog = getattr(self, "_program", None)
+        if prog is None:
+            return True
+        return prog[2].wait(timeout_s)
+
+    def applied(self, op: str) -> Optional[float]:
+        """Monotonic instant the program FIRST applied `op` (None if
+        not yet) — e.g. the blackhole instant fence math measures from."""
+        with self._lock:
+            for row in self.program_log:
+                if row["op"] == op:
+                    return row["applied_monotonic"]
+        return None
 
     def set_faults(self, *, delay_ms: Optional[float] = None,
                    jitter_ms: Optional[float] = None,
@@ -277,6 +381,7 @@ class ChaosProxy:
 
     def close(self) -> None:
         self._stopping.set()
+        self.cancel_program()
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

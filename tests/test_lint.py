@@ -542,6 +542,51 @@ def test_nnl010_blessed_in_devprof_and_bench():
     })
 
 
+# -- NNL011 seeded-chaos -----------------------------------------------------
+
+BAD_CHAOS_RNG = '''
+import random
+import numpy as np
+
+def schedule_faults():
+    jitter = random.Random()                 # OS-entropy: no replay
+    rng = np.random.default_rng()            # ditto
+    return jitter.random(), rng.random()
+'''
+
+GOOD_CHAOS_RNG = '''
+import random
+import numpy as np
+
+def schedule_faults(seed):
+    jitter = random.Random(seed)
+    rng = np.random.default_rng(seed + 1)
+    kw = np.random.default_rng(seed=seed)
+    return jitter.random(), rng.random(), kw.random()
+'''
+
+
+def test_nnl011_fires_on_unseeded_rng_in_chaos_paths():
+    for path in ("nnstreamer_tpu/traffic/fix.py",
+                 "nnstreamer_tpu/scenario/fix.py",
+                 "nnstreamer_tpu/serving/worker.py"):
+        findings = assert_fires("NNL011", {path: BAD_CHAOS_RNG},
+                                n_min=2)
+        msgs = " ".join(f.message for f in findings)
+        assert "random.Random" in msgs and "default_rng" in msgs
+
+
+def test_nnl011_silent_on_seeded_rng():
+    assert_silent("NNL011",
+                  {"nnstreamer_tpu/traffic/fix.py": GOOD_CHAOS_RNG})
+
+
+def test_nnl011_silent_outside_the_chaos_paths():
+    # an unseeded rng elsewhere is someone else's design decision
+    assert_silent("NNL011", {REPO_PATHS["backend"]: BAD_CHAOS_RNG,
+                             REPO_PATHS["elem"]: BAD_CHAOS_RNG})
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_inline_suppression_waives_a_finding():

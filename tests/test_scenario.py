@@ -1,0 +1,410 @@
+"""Scenario engine tests: spec validation + JSON round-trip, the
+four-invariant property checker against hand-built violating scrapes,
+flight-bundle forensics on violation, deterministic shrinking, seeded
+ChaosProxy programs, replay-from-report, and live executor drills
+(ISSUE 18 acceptance)."""
+
+import dataclasses
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.edge import QueryServer
+from nnstreamer_tpu.scenario import (
+    INVARIANTS, ArrivalProgram, FaultProgram, ScenarioSLO,
+    ScenarioSpec, ShrinkBudgetExceeded, Topology, builtin_specs,
+    check_result, check_scrape, compile_arrivals, replay_scenario,
+    run_scenario, shrink)
+
+
+@pytest.fixture(autouse=True)
+def _clean_servers():
+    yield
+    QueryServer.reset_all()
+
+
+def _spec(**kw) -> ScenarioSpec:
+    base = dict(
+        name="t", seed=5,
+        topology=Topology(kind="pool", workers=2, service_ms=2.0),
+        arrivals=(ArrivalProgram(kind="constant", n=10, rate_x=0.5),))
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+# -- spec validation + round-trip --------------------------------------------
+
+class TestSpec:
+    def test_json_round_trip_exact(self):
+        spec = builtin_specs()["composed_storm"]
+        back = ScenarioSpec.from_json(spec.to_json())
+        assert back == spec
+        assert back.to_json() == spec.to_json()
+
+    def test_labels_assigned_by_position_and_frozen(self):
+        spec = builtin_specs()["composed_storm"]
+        assert [a.label for a in spec.arrivals] == ["a0", "a1"]
+        assert [f.label for f in spec.faults] == ["f0", "f1", "f2"]
+        back = ScenarioSpec.from_json(spec.to_json())
+        assert back.sub_seed("fault", "f0") == \
+            spec.sub_seed("fault", "f0")
+
+    def test_sub_seed_depends_on_root_and_label(self):
+        spec = _spec()
+        other = dataclasses.replace(spec, seed=6)
+        assert spec.sub_seed("arrival", "a0") != \
+            other.sub_seed("arrival", "a0")
+        assert spec.sub_seed("arrival", "a0") != \
+            spec.sub_seed("arrival", "a1")
+
+    def test_unknown_kinds_refused_eagerly(self):
+        with pytest.raises(ValueError, match="arrival kind"):
+            ArrivalProgram(kind="sawtooth", n=5, rate_x=1.0)
+        with pytest.raises(ValueError, match="fault kind"):
+            FaultProgram(kind="meteor", at_s=0.1)
+        with pytest.raises(ValueError, match="topology kind"):
+            Topology(kind="cloud")
+
+    def test_unknown_json_keys_refused(self):
+        d = json.loads(_spec().to_json())
+        d["arrivals"][0]["typo_key"] = 1
+        with pytest.raises(ValueError, match="typo_key"):
+            ScenarioSpec.from_dict(d)
+
+    def test_net_fault_requires_mesh(self):
+        with pytest.raises(ValueError, match="mesh"):
+            _spec(faults=(FaultProgram(kind="blackhole", at_s=0.1),))
+
+    def test_fault_host_bounded_by_topology(self):
+        with pytest.raises(ValueError, match="host"):
+            _spec(topology=Topology(kind="mesh", hosts=2),
+                  faults=(FaultProgram(kind="blackhole", at_s=0.1,
+                                       host=5),))
+
+    def test_undeclared_tenant_refused(self):
+        with pytest.raises(ValueError, match="unknown tenant"):
+            _spec(topology=Topology(kind="pool",
+                                    tenants={"paid": {}}),
+                  arrivals=(ArrivalProgram(kind="constant", n=5,
+                                           rate_x=0.5,
+                                           tenant="ghost"),))
+
+    def test_size_counts_programs_and_load(self):
+        spec = _spec(faults=(FaultProgram(kind="worker_kill",
+                                          at_s=0.1),))
+        assert spec.size() == 1 + 1 + 10   # fault + arrival + n
+
+
+# -- arrival compilation ------------------------------------------------------
+
+class TestCompileArrivals:
+    def test_deterministic_and_sorted(self):
+        spec = builtin_specs()["composed_storm"]
+        a1, o1, seg1 = compile_arrivals(spec)
+        a2, o2, _ = compile_arrivals(spec)
+        assert np.array_equal(a1, a2) and o1 == o2
+        assert np.all(np.diff(a1) >= 0)
+        assert len(a1) == len(o1) == 240 + 80 + 60
+        assert {s["label"] for s in seg1} == {"a0", "a1", "f2"}
+
+    def test_flood_rides_fault_seed_not_arrival_seed(self):
+        spec = builtin_specs()["composed_storm"]
+        reseeded = dataclasses.replace(spec, seed=spec.seed + 1)
+        a1, _, _ = compile_arrivals(spec)
+        a2, _, _ = compile_arrivals(reseeded)
+        assert not np.array_equal(a1, a2)
+
+
+# -- the property checker -----------------------------------------------------
+
+def _clean_admission(n=10):
+    return {"offered": n, "admitted": n, "replied": n,
+            "rejected": {}, "shed": {}, "depth": 0, "inflight": 0}
+
+
+def _scrape(**kw):
+    s = {"admission": _clean_admission(), "orphans": [],
+         "completed": 10, "report": {"lost": 0}}
+    s.update(kw)
+    return s
+
+
+class TestChecker:
+    def test_clean_scrape_passes_all_four(self):
+        v = check_scrape(_scrape())
+        assert v["ok"] and all(v["invariants"].values())
+        assert set(v["invariants"]) == set(INVARIANTS)
+
+    def test_offered_admitted_violation(self):
+        c = _clean_admission()
+        c["offered"] = 12              # 2 requests vanished at the door
+        v = check_scrape(_scrape(admission=c))
+        assert not v["ok"]
+        assert not v["invariants"]["offered_admitted"]
+
+    def test_admitted_settled_violation(self):
+        c = _clean_admission()
+        c["replied"] = 9               # one admitted request unsettled
+        v = check_scrape(_scrape(admission=c))
+        assert not v["invariants"]["admitted_settled"]
+
+    def test_per_class_books_must_sum_to_global(self):
+        c = _clean_admission()
+        c["classes"] = {
+            "paid": {"offered": 6, "admitted": 6, "replied": 6,
+                     "rejected": {}, "shed": {}, "depth": 0,
+                     "inflight": 0},
+            "free": {"offered": 3, "admitted": 3, "replied": 3,
+                     "rejected": {}, "shed": {}, "depth": 0,
+                     "inflight": 0}}   # sums 9 != global 10
+        v = check_scrape(_scrape(admission=c))
+        assert not v["invariants"]["admitted_settled"]
+        assert any("class sums" in x["detail"]
+                   for x in v["violations"])
+
+    def test_perhost_replied_sum_cross_check(self):
+        v = check_scrape(_scrape(perhost_replied_sum=9))
+        assert not v["invariants"]["admitted_settled"]
+
+    def test_zero_orphans_violation(self):
+        v = check_scrape(_scrape(orphans=[4242]))
+        assert not v["invariants"]["zero_orphans"]
+        assert "4242" in v["violations"][0]["detail"]
+
+    def test_trace_complete_missing_hop(self):
+        hops = [{"hop": h} for h in
+                ("admit", "dequeue", "dispatch", "reply")]
+        traces = {i: {"id": "x", "hops": hops} for i in range(10)}
+        v = check_scrape(_scrape(traces=traces))
+        assert not v["invariants"]["trace_complete"]
+        assert "worker_recv" in v["violations"][0]["detail"]
+
+    def test_trace_complete_missing_context(self):
+        full = [{"hop": h} for h in
+                ("admit", "dequeue", "dispatch", "worker_recv",
+                 "worker_done", "reply")]
+        traces = {i: {"id": "x", "hops": full} for i in range(9)}
+        v = check_scrape(_scrape(traces=traces))   # 10 completed
+        assert not v["invariants"]["trace_complete"]
+
+    def test_slo_layer_does_not_touch_standing_flags(self):
+        v = check_scrape(_scrape(report={"lost": 3}),
+                         slo=ScenarioSLO(require_zero_lost=True))
+        assert not v["ok"] and all(v["invariants"].values())
+        assert v["violations"][0]["invariant"] == "slo"
+
+    def test_violation_dumps_flight_bundle_with_spec(self, tmp_path):
+        from nnstreamer_tpu.runtime.flightrec import (
+            FlightRecorder, load_bundle)
+
+        spec = _spec()
+        c = _clean_admission()
+        c["offered"] = 99
+        result = {"scenario": spec.name, "seed": spec.seed,
+                  "spec": spec.to_dict(), "admission": c,
+                  "orphans": [], "report": {"completed": 10}}
+        rec = FlightRecorder(str(tmp_path), cooldown_s=0.0)
+        v = check_result(result, spec, recorder=rec)
+        assert not v["ok"] and v.get("flight_bundle")
+        bundle = load_bundle(v["flight_bundle"])
+        cause = bundle["cause"]["cause"]
+        assert cause["scenario_spec"] == spec.to_dict()
+        assert cause["violations"] == v["violations"]
+
+
+# -- shrinking ----------------------------------------------------------------
+
+class TestShrink:
+    def test_deterministic_minimal_repro(self):
+        spec = builtin_specs()["composed_storm"]
+
+        def fails(s):
+            return any(f.label == "f0" for f in s.faults)
+
+        m1, st1 = shrink(spec, fails)
+        m2, st2 = shrink(spec, fails)
+        assert m1.to_json() == m2.to_json() and st1 == st2
+        assert [f.label for f in m1.faults] == ["f0"]
+        assert len(m1.arrivals) == 1 and m1.arrivals[0].n == 1
+        assert st1["final_size"] < st1["initial_size"]
+        assert fails(m1)
+
+    def test_survivor_sub_seeds_preserved(self):
+        spec = builtin_specs()["composed_storm"]
+        m, _ = shrink(spec, lambda s: any(f.label == "f0"
+                                          for f in s.faults))
+        assert m.sub_seed("fault", "f0") == \
+            spec.sub_seed("fault", "f0")
+        a = m.arrivals[0]
+        assert m.sub_seed("arrival", a.label) == \
+            spec.sub_seed("arrival", a.label)
+
+    def test_always_failing_drops_every_fault(self):
+        spec = builtin_specs()["kill_pool"]
+        m, _ = shrink(spec, lambda s: True)
+        assert m.faults == () and len(m.arrivals) == 1
+        assert m.arrivals[0].n == 1 and m.size() == 2
+
+    def test_non_failing_spec_refused(self):
+        with pytest.raises(ValueError, match="does not fail"):
+            shrink(_spec(), lambda s: False)
+
+    def test_budget_exceeded_raises(self):
+        spec = builtin_specs()["composed_storm"]
+        with pytest.raises(ShrinkBudgetExceeded):
+            shrink(spec, lambda s: any(f.label == "f0"
+                                       for f in s.faults),
+                   max_runs=2)
+
+    def test_memoised_candidates_do_not_burn_budget(self):
+        spec = _spec()
+        calls = []
+
+        def fails(s):
+            calls.append(s.to_json())
+            return True
+
+        _, st = shrink(spec, fails)
+        assert st["runs"] == len(calls) == len(set(calls))
+
+
+# -- ChaosProxy scheduled programs (satellite c) ------------------------------
+
+class TestChaosProxyProgram:
+    def _echo_world(self):
+        from nnstreamer_tpu.traffic.loadgen import EchoServer
+        from nnstreamer_tpu.traffic.netchaos import ChaosProxy
+
+        es = EchoServer(service_ms=1.0)
+        proxy = ChaosProxy("127.0.0.1", es.port, seed=3)
+        return es, proxy
+
+    def test_program_validates_eagerly(self):
+        es, proxy = self._echo_world()
+        try:
+            with pytest.raises(ValueError, match="op"):
+                proxy.program([(0.1, "meteor")])
+            with pytest.raises(ValueError):
+                proxy.program([(-0.5, "blackhole")])
+        finally:
+            proxy.close()
+            es.stop()
+
+    def test_scheduled_blackhole_then_heal_applies_in_order(self):
+        es, proxy = self._echo_world()
+        try:
+            proxy.program([(0.05, "blackhole"), (0.15, "heal")])
+            assert proxy.wait_program(5.0)
+            ops = [e["op"] for e in proxy.program_log]
+            assert ops == ["blackhole", "heal"]
+            t_bh = proxy.applied("blackhole")
+            t_heal = proxy.applied("heal")
+            assert t_bh is not None and t_heal is not None
+            assert t_heal > t_bh
+        finally:
+            proxy.close()
+            es.stop()
+
+    def test_cancel_program_stops_pending_events(self):
+        es, proxy = self._echo_world()
+        try:
+            proxy.program([(30.0, "blackhole")])
+            proxy.cancel_program()
+            assert proxy.applied("blackhole") is None
+        finally:
+            proxy.close()
+            es.stop()
+
+
+# -- replay from report (satellite a) -----------------------------------------
+
+class TestReplayFromReport:
+    def test_echo_report_carries_seed_and_schedule(self):
+        from nnstreamer_tpu.traffic import (
+            replay_report, run_against_echo)
+
+        r1 = run_against_echo(pattern="poisson", load_x=0.3, n=40,
+                              service_ms=1.0, seed=9)
+        assert r1["seed"] == 9
+        assert r1["schedule"]["kind"] == "echo"
+        r2 = replay_report(r1)
+        # under-capacity + same seed → the ledger reproduces exactly
+        assert r2["completed"] == r1["completed"] == 40
+        assert r2["lost"] == r1["lost"] == 0
+        for k in ("offered", "admitted", "replied"):
+            assert r2["admission"][k] == r1["admission"][k]
+
+    def test_replay_refuses_reports_without_block(self):
+        from nnstreamer_tpu.traffic import replay_report
+
+        with pytest.raises(ValueError):
+            replay_report({"seed": 1})
+        with pytest.raises(ValueError):
+            replay_report({"schedule": {"kind": "echo"}})
+
+
+# -- live executor drills -----------------------------------------------------
+
+class TestExecutorPool:
+    def test_smoke_pool_all_invariants_and_replay(self):
+        r = run_scenario(builtin_specs()["smoke_pool"])
+        assert r["check"]["ok"], r["check"]["violations"]
+        assert all(r["check"]["invariants"].values())
+        assert r["totals"]["lost"] == 0
+        r2 = replay_scenario(r)
+        assert r2["replay_match"], r2.get("replay_diff")
+
+    @pytest.mark.chaos
+    def test_kill_pool_recovers_and_conserves(self):
+        r = run_scenario(builtin_specs()["kill_pool"])
+        assert r["check"]["ok"], r["check"]["violations"]
+        assert r["report"]["recovered"]
+        assert r["fault_log"]["kills"][0]["schedule"]
+        assert r["totals"]["lost"] == 0
+
+    def test_tenant_classes_scraped_per_class(self):
+        spec = _spec(
+            topology=Topology(kind="pool", workers=2, service_ms=2.0,
+                              tenants={"paid": {"weight": 2.0},
+                                       "free": {"weight": 1.0}}),
+            arrivals=(
+                ArrivalProgram(kind="constant", n=12, rate_x=0.3,
+                               tenant="paid"),
+                ArrivalProgram(kind="poisson", n=8, rate_x=0.1,
+                               tenant="free"),
+            ))
+        r = run_scenario(spec)
+        assert r["check"]["ok"], r["check"]["violations"]
+        classes = r["admission"]["classes"]
+        assert classes["paid"]["replied"] == 12
+        assert classes["free"]["replied"] == 8
+
+
+@pytest.mark.mesh
+@pytest.mark.slow
+class TestExecutorMesh:
+    def test_flash_mesh_blackhole_heal_zero_lost(self):
+        r = run_scenario(builtin_specs()["flash_mesh"])
+        assert r["check"]["ok"], r["check"]["violations"]
+        assert r["totals"]["lost"] == 0
+        assert r["report"]["recovered"]
+        log = r["fault_log"]["proxies"]["0"]
+        assert [e["op"] for e in log] == ["blackhole", "heal"]
+        assert r["perhost_replied_sum"] == r["totals"]["replied"]
+
+    def test_composed_storm_acceptance(self):
+        """ISSUE 18 acceptance: flash-crowd × blackhole-then-heal ×
+        swap-storm × tenant-flood against a real mesh under one root
+        seed — zero lost, four invariants from one scrape, and replay
+        reproduces the exact ledger."""
+        r = run_scenario(builtin_specs()["composed_storm"])
+        assert r["check"]["ok"], r["check"]["violations"]
+        assert all(r["check"]["invariants"].values())
+        assert r["totals"]["lost"] == 0
+        assert r["report"]["recovered"]
+        assert {"paid", "free"} <= set(r["admission"]["classes"])
+        r2 = replay_scenario(r)
+        assert r2["replay_match"], r2.get("replay_diff")
