@@ -2299,6 +2299,151 @@ def multichip_serve() -> dict:
     return out
 
 
+def sharded_serve() -> dict:
+    """Sharded-serving family (serving/sharding.py), on the 8-device
+    emulated host mesh (_family_main forces the same env as multichip
+    BEFORE jax loads). Three sections: (a) paged LLM decode tokens/s +
+    prefill latency at shards 1/2/4/8 with the bit-parity check vs the
+    shards=1 blocked reference (the canonical-blocking contract);
+    (b) ring prefill latency vs blocked at the same width on a long
+    prompt (allclose, not exact — different attention order by design);
+    (c) the dense ShardedReplicaSet conservation drill: frames through
+    2 groups of 2 chips with ONE member chip fenced mid-stream —
+    Σ group invokes must equal frames exactly. Emulated devices are
+    host threads, so the per-width ratios measure the shard_map
+    dispatch path, not chip speedup; BENCH_SHARDED_GATE=1 gates on
+    exact parity + conservation, never on the emulated ratios."""
+    import numpy as np
+
+    from nnstreamer_tpu.backends.llm_exec import PagedLLMExecutor
+    from nnstreamer_tpu.backends.xla import ModelBundle
+    from nnstreamer_tpu.models.transformer import init_params
+    from nnstreamer_tpu.serving.placement import visible_devices
+    from nnstreamer_tpu.serving.sharding import ShardedReplicaSet
+
+    ndev = len(visible_devices())
+    out: dict = {"visible_devices": ndev}
+    params = init_params(d_model=64, n_heads=8, n_layers=2, vocab=256)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, 256, size=24).astype(np.int32)
+    decode_steps = 48
+
+    # (a) decode tokens/s + prefill latency per shard width, bit-parity
+    widths: dict = {}
+    ref_logits = None
+    parity_exact = True
+    base_tps = None
+    for n in [s for s in (1, 2, 4, 8) if s <= ndev]:
+        ex = PagedLLMExecutor(dict(params), n_heads=8, block_size=8,
+                              num_blocks=16, max_len=128, shards=n,
+                              name=f"bench-tp{n}")
+        try:
+            blocks = ex.cache.allocator.alloc(ex.cache.blocks_for(
+                len(prompt)))
+            t0 = time.perf_counter()
+            lg = ex.prefill(prompt, blocks)
+            prefill_ms = (time.perf_counter() - t0) * 1e3
+            logits = [np.asarray(lg)]
+            tok = int(np.argmax(lg))
+            pos = len(prompt)
+            t0 = time.perf_counter()
+            for _ in range(decode_steps):
+                dl = ex.decode([tok], [blocks], [pos])
+                logits.append(np.asarray(dl[0]))
+                tok = int(np.argmax(dl[0]))
+                pos += 1
+            dt = time.perf_counter() - t0
+        finally:
+            ex.close()
+        tps = decode_steps / dt if dt > 0 else 0.0
+        if ref_logits is None:
+            ref_logits = logits          # shards=1: the blocked reference
+            base_tps = tps
+        else:
+            parity_exact &= all(
+                np.array_equal(a, b) for a, b in zip(logits, ref_logits))
+        widths[f"shards_{n}"] = {
+            "decode_tokens_per_s": round(tps, 1),
+            "prefill_ms": round(prefill_ms, 1),
+            "ratio_vs_shards1": round(tps / base_tps, 3)
+            if base_tps else 0.0,
+        }
+        out["llm"] = dict(widths, parity_exact_vs_shards1=parity_exact)
+        _family_partial(dict(out))
+
+    # (b) ring prefill vs blocked at shards=2 on the same long prompt
+    ring_ok = True
+    if ndev >= 2:
+        exr = PagedLLMExecutor(dict(params), n_heads=8, block_size=8,
+                               num_blocks=16, max_len=128, shards=2,
+                               ring_prefill_min=16, name="bench-ring")
+        exb = PagedLLMExecutor(dict(params), n_heads=8, block_size=8,
+                               num_blocks=16, max_len=128, shards=2,
+                               name="bench-ringref")
+        try:
+            res = {}
+            for tag, ex in (("ring", exr), ("blocked", exb)):
+                blocks = ex.cache.allocator.alloc(ex.cache.blocks_for(
+                    len(prompt)))
+                t0 = time.perf_counter()
+                lg = ex.prefill(prompt, blocks)
+                res[tag] = (np.asarray(lg),
+                            (time.perf_counter() - t0) * 1e3)
+            err = float(np.max(np.abs(res["ring"][0]
+                                      - res["blocked"][0])))
+            ring_ok = err <= 1e-3
+            out["ring_prefill"] = {
+                "ring_ms": round(res["ring"][1], 1),
+                "blocked_ms": round(res["blocked"][1], 1),
+                "max_abs_err": err,
+            }
+        finally:
+            exr.close()
+            exb.close()
+        _family_partial(dict(out))
+
+    # (c) dense conservation through a mid-stream member fence
+    conserved = True
+    fence_ok = True
+    if ndev >= 4:
+        w = rng.normal(size=(64, 64)).astype(np.float32) / 8.0
+        bundle = ModelBundle(
+            fn=lambda p, x: (x @ p["w"],), params={"w": w},
+            name="bench_shard_mlp")
+        x = rng.normal(size=(8, 64)).astype(np.float32)
+        frames = 40
+        rs = ShardedReplicaSet.open_sharded(bundle, shards=2, groups=2,
+                                            name="bench-shard-fence")
+        try:
+            for i in range(frames):
+                if i == frames // 2:     # mid-stream: fence ONE member
+                    fence_ok = rs.fence_device(
+                        rs.stats()["replicas"][1]["devices"][0],
+                        "bench drill")
+                rs.invoke((x,))
+            st = rs.stats()
+        finally:
+            rs.close()
+        conserved = sum(
+            r["invokes"] for r in st["replicas"]) == frames
+        dead = [r for r in st["replicas"] if r["state"] == "fenced"]
+        out["fence_drill"] = {
+            "frames": frames,
+            "group_invokes": [r["invokes"] for r in st["replicas"]],
+            "fenced_groups": len(dead),
+            "conserved": conserved,
+            "leases": st.get("leases"),
+        }
+        _family_partial(dict(out))
+
+    if os.environ.get("BENCH_SHARDED_GATE") == "1":
+        out["sharded_gate_ok"] = bool(
+            parity_exact and ring_ok and conserved and fence_ok)
+        if not out["sharded_gate_ok"]:
+            out["unverified"] = True   # ship the numbers, flag the claim
+    return out
+
+
 #: pipeline configs, each its own subprocess family as well — host-path
 #: configs do per-frame D2H, and running them after anything else in
 #: one process measured 2x drift (label 157 -> 76 FPS across trials)
@@ -2331,6 +2476,7 @@ _FAMILIES = {
     "multitenant": lambda: multitenant_serve(),
     "scenario": lambda: scenario_serve(),
     "multichip": lambda: multichip_serve(),
+    "sharded": lambda: sharded_serve(),
 }
 for _d in OFFLOAD_DELAYS:
     _FAMILIES[f"offload_{_d}"] = (
@@ -2456,10 +2602,10 @@ def _enable_compile_cache() -> None:
 
 
 def _family_main(name: str) -> int:
-    if name == "multichip":
-        # This family measures placement, not the chip: force the
-        # 8-device emulated host mesh (same technique as tests/
-        # conftest.py) BEFORE _enable_compile_cache imports jax.
+    if name in ("multichip", "sharded"):
+        # These families measure placement/sharding, not the chip:
+        # force the 8-device emulated host mesh (same technique as
+        # tests/conftest.py) BEFORE _enable_compile_cache imports jax.
         os.environ["JAX_PLATFORMS"] = "cpu"
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -2467,7 +2613,7 @@ def _family_main(name: str) -> int:
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
     _enable_compile_cache()
-    if name == "multichip":
+    if name in ("multichip", "sharded"):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -2511,7 +2657,7 @@ def _ordered_families() -> list:
     return (["cfg_label_device", "pallas", "transformer_prefill",
              "mxu_peak", "batch_sweep", "dyn_batch", "host_path",
              "llm_serve", "traffic", "multitenant", "scenario",
-             "multichip", "autotune"]
+             "multichip", "sharded", "autotune"]
             + [f"cfg_{n}" for n in _CONFIGS if n != "label_device"]
             + [f"offload_{d}" for d in OFFLOAD_DELAYS]
             + ["int8_native", "model_swap", "chaos_smoke"])

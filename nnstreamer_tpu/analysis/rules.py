@@ -32,6 +32,14 @@ NNL011 seeded-chaos       no unseeded RNG construction
                           (traffic/, scenario/, serving worker chaos
                           hooks) — every drill must replay bit-exact
                           from its recorded seed
+NNL012 shard-safety       shard_map / NamedSharding / PartitionSpec
+                          construction (and their jax imports) only
+                          inside parallel/ and serving/sharding.py —
+                          sharded serving's bit-parity contract holds
+                          because every mesh program goes through the
+                          canonical-blocking helpers; a stray
+                          shard_map elsewhere reintroduces
+                          shard-count-dependent numerics
 
 Every rule is pure AST — nothing here imports the code under analysis.
 Heuristics err toward silence (a missed finding is a review problem; a
@@ -849,11 +857,62 @@ class SeededChaosAudit(Rule):
                     f"the drill replays bit-exact")
 
 
+class ShardSafety(Rule):
+    rule_id = "NNL012"
+    title = "shard-safety"
+    rationale = (
+        "sharded serving's headline guarantee — shards=N is "
+        "bit-identical to shards=1 — holds because every mesh program "
+        "is built by the canonical-blocking helpers in "
+        "serving/sharding.py (fixed block count, fixed combine order) "
+        "or the reviewed collectives in parallel/. A shard_map / "
+        "NamedSharding / PartitionSpec constructed anywhere else is a "
+        "private mesh program whose reduction order depends on the "
+        "shard count: exactly the numerics drift the subsystem exists "
+        "to rule out. Like NNL009, everything outside the subsystem "
+        "consumes sharded trees and placers, it never builds them")
+
+    #: the subsystem allowed to build mesh programs; everything else
+    #: receives placed arrays / placer callables from it
+    ALLOWED = ("parallel/", "serving/sharding.py")
+    SHARD_NAMES = ("shard_map", "NamedSharding", "PartitionSpec")
+
+    def check(self, module: Module, project: Project):
+        p = f"/{module.path}"
+        if any(f"/{a}" in p for a in self.ALLOWED):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if not (mod == "jax" or mod.startswith("jax.")):
+                    continue
+                for alias in node.names:
+                    if alias.name in self.SHARD_NAMES:
+                        yield node, (
+                            f"`from {mod} import {alias.name}` outside "
+                            f"the sharding subsystem: mesh programs are "
+                            f"built only in parallel/ and "
+                            f"serving/sharding.py — take a placed tree "
+                            f"or a placer callable from there instead")
+            elif isinstance(node, ast.Call):
+                name = dotted(node.func).split(".")[-1]
+                if name in self.SHARD_NAMES:
+                    yield node, (
+                        f"`{name}(...)` constructed outside the "
+                        f"sharding subsystem: a private mesh program's "
+                        f"reduction order depends on the shard count, "
+                        f"breaking the shards=N bit-parity contract — "
+                        f"route through serving/sharding.py "
+                        f"(shard_params / kv_pool_placer / "
+                        f"make_llm_fns) or parallel/")
+
+
 #: registry, in catalog order
 ALL_RULES: List[Rule] = [
     ElementContract(), ForcedSync(), LockDiscipline(), JitPurity(),
     SpawnSafety(), PicklableErrors(), ThreadAudit(), SocketAudit(),
     PlacementAudit(), DeviceAccountingAudit(), SeededChaosAudit(),
+    ShardSafety(),
 ]
 
 

@@ -9,6 +9,17 @@ model-store hot swap composes: the store's swap controller calls
 engine adopts at a step boundary (one scheduler thread ⇒ a step sees
 exactly one version snapshot).
 
+``shards=N`` opens the executor tensor-parallel over N chips
+(serving/sharding.py): projections are served canonically blocked and
+head-sharded, the KV pools are sharded along the kv-head axis next to
+them, and the jit namespace becomes ``("tp", N, version)`` — same
+per-bucket compile accounting, same swap protocol, one SPMD executable
+per bucket. The sharded path is XLA-only and float-only (Pallas and
+W8A8 refuse loudly); prompts at or past ``ring_prefill_min`` prefill
+through the sequence-parallel ring-attention twin instead of the
+blocked path (allclose-, not bit-, equivalent — decode from ring KV is
+still the blocked bit-exact program).
+
 Buckets:
 - prefill: prompt length padded to pow2 (``("llmp", S)`` in the
   compile-cache manifest — replayed by ``warm_start`` so a restarted
@@ -86,11 +97,15 @@ class PagedLLMExecutor:
     def __init__(self, model="store://transformer", *, n_heads: int = 4,
                  dtype=None, block_size: int = 16, num_blocks: int = 64,
                  max_len: int = 128, paged_kernel: Optional[str] = None,
+                 shards: int = 0, shard_chips=None,
+                 ring_prefill_min: int = 0,
                  tracer=NULL_TRACER, name: str = "llm"):
         import jax.numpy as jnp
 
         self.name = name
         self.tracer = tracer
+        self.shards = int(shards)
+        self.ring_prefill_min = int(ring_prefill_min)
         self.kernel_fallback = 0
         self.kernel_invokes: Dict[str, int] = {"pallas": 0, "xla": 0}
         kern = (paged_kernel or os.environ.get("NNS_PAGED_KERNEL")
@@ -98,6 +113,13 @@ class PagedLLMExecutor:
         if kern not in ("pallas", "xla"):
             raise BackendError(
                 f"paged_kernel must be 'pallas' or 'xla', got {kern!r}")
+        if kern == "pallas" and self.shards > 0:
+            log.warning(
+                "llm %s: paged_kernel=pallas is single-chip; shards=%d "
+                "serves on the sharded XLA path (counted fallback)",
+                name, self.shards)
+            self.kernel_fallback += 1
+            kern = "xla"
         if kern == "pallas":
             from nnstreamer_tpu.backends import pallas_paged
 
@@ -143,12 +165,38 @@ class PagedLLMExecutor:
                 f"dict, got {type(model).__name__}")
         dims = _derive_dims(self.params, self.n_heads)
         self.__dict__.update(dims)
+        self._mesh = None
+        self._shard_chips: tuple = ()
+        self._sparams: Dict[Any, Any] = {}   # vkey → blocked+placed tree
+        self._rparams: Dict[Any, Any] = {}   # vkey → replicated raw (ring)
+        self._sspecs = None
+        self._sfns = None
+        placer = None
+        if self.shards:
+            from nnstreamer_tpu.serving import sharding as shg
+
+            shg.validate_shards(self.shards)
+            chips = tuple(int(c) for c in shard_chips) \
+                if shard_chips is not None else tuple(range(self.shards))
+            if len(chips) != self.shards:
+                raise BackendError(
+                    f"llm {name}: shards={self.shards} but {len(chips)} "
+                    f"chips leased: {chips}")
+            self._shard_chips = chips
+            self._shard_devs = shg.shard_devices(chips)
+            self._mesh = shg._tp_mesh(self._shard_devs)
+            # raises the typed float-only / 8-divisibility errors up
+            # front, before any pool or jit exists
+            placed, self._sspecs = shg.shard_llm_params(
+                self.params, self._mesh, n_heads=self.n_heads)
+            self._sparams[self._vkey()] = placed
+            placer = shg.kv_pool_placer(self._mesh)
         bs = int(block_size)
         self.max_blocks = max(1, -(-self.max_len // bs))
         self.cache = PagedKVCache(
             num_blocks=int(num_blocks), block_size=bs,
             n_layers=self.n_layers, n_kv=self.n_kv,
-            head_dim=self.head_dim)
+            head_dim=self.head_dim, placer=placer)
         #: (ns, kind, bucket) → jitted callable
         self._jits: Dict[tuple, Any] = {}
         self.compile_count = 0
@@ -159,11 +207,54 @@ class PagedLLMExecutor:
         self.decode_steps = 0
 
     # -- store integration -------------------------------------------------
+    def _vkey(self, version: Optional[int] = None):
+        """Version key for the sharded param caches: the explicit
+        version, else the bound one, else 0 for raw-dict models."""
+        if version is not None:
+            return version
+        return self._version if self._entry is not None else 0
+
     def _ns(self, version: Optional[int] = None) -> tuple:
+        if self.shards:
+            return ("tp", self.shards, self._vkey(version))
         if self._entry is not None:
             return ("v", version if version is not None
                     else self._version)
         return ("g", 0)
+
+    # -- sharded serving (serving/sharding.py) -----------------------------
+    def _shard_fns(self):
+        if self._sfns is None:
+            from nnstreamer_tpu.serving import sharding as shg
+
+            self._sfns = shg.make_llm_fns(self._mesh, self._sspecs,
+                                          self._shard_devs)
+        return self._sfns
+
+    def _raw_params(self, vkey):
+        if self._entry is not None and vkey != self._version:
+            return self._entry.bundle(vkey).params
+        return self.params
+
+    def _exec_params(self, kind: str = "prefill", version=None):
+        """The params tree one jit call serves: single-chip, the raw
+        host tree; sharded, the canonically-blocked head-sharded tree
+        for the version (ring prefill: the replicated raw tree), placed
+        once per version and cached — a hot-path call is a dict hit."""
+        if not self.shards:
+            return self.params
+        from nnstreamer_tpu.serving import sharding as shg
+
+        vkey = self._vkey(version)
+        if kind == "ring":
+            if vkey not in self._rparams:
+                self._rparams[vkey] = shg.replicate_params(
+                    self._raw_params(vkey), self._mesh)
+            return self._rparams[vkey]
+        if vkey not in self._sparams:
+            self._sparams[vkey], _ = shg.shard_llm_params(
+                self._raw_params(vkey), self._mesh, n_heads=self.n_heads)
+        return self._sparams[vkey]
 
     @property
     def tracks_store_epoch(self) -> bool:
@@ -191,10 +282,21 @@ class PagedLLMExecutor:
                 f"cache geometry (layers/kv-heads/head-dim); restart the "
                 f"tensor_llm element to serve it")
         self.__dict__.update(dims)
-        for k in [k for k in self._jits
-                  if k[0][0] == "v" and k[0][1] not in
-                  (cur, self._pinned)]:
-            del self._jits[k]
+        keep = {cur, self._pinned}
+        if self.shards:
+            for k in [k for k in self._jits
+                      if k[0][0] == "tp" and k[0][2] not in keep]:
+                del self._jits[k]
+            self._sparams = {v: p for v, p in self._sparams.items()
+                             if v in keep}
+            self._rparams = {v: p for v, p in self._rparams.items()
+                             if v in keep}
+            # place cur now if the swap controller's prewarm missed us
+            self._exec_params("prefill", cur)
+        else:
+            for k in [k for k in self._jits
+                      if k[0][0] == "v" and k[0][1] not in keep]:
+                del self._jits[k]
         self._version, self.adopted_epoch = cur, epoch
         self.swap_count += 1
         self.tracer.record_swap(
@@ -223,6 +325,10 @@ class PagedLLMExecutor:
         only and kernel-fixed; the chunk path is quant-aware and
         kernel-selectable. Float + xla keeps the original path, so the
         token-for-token `generate` parity contract is untouched there."""
+        if self.shards:
+            # sharded init already refused pallas and quantized params;
+            # the ring cutover is decided per prompt in prefill()
+            return "prefill"
         if self.paged_kernel == "pallas":
             return "chunk"
         try:
@@ -245,6 +351,20 @@ class PagedLLMExecutor:
             self.cache_hits += 1
             return jitted, False
         self.cache_misses += 1
+        if self.shards:
+            if kind == "chunk":
+                raise BackendError(
+                    f"llm {self.name}: chunked prefill is not supported "
+                    f"with shards={self.shards}; long prompts go through "
+                    f"the sequence-parallel ring prefill "
+                    f"(ring_prefill_min)")
+            # one SPMD executable per bucket under ("tp", N, version) —
+            # same donate/static discipline as the single-chip jits
+            jitted = jax.jit(self._shard_fns()[kind],
+                             static_argnames=("n_heads", "dtype"),
+                             donate_argnums=(4, 5))
+            self._jits[key] = jitted
+            return jitted, True
         if kind == "prefill":
             fn, donate = paged_prefill, (4, 5)
         elif kind == "chunk":
@@ -289,8 +409,17 @@ class PagedLLMExecutor:
         — the executor-level HBM attribution row."""
         import jax
 
-        n = sum(getattr(a, "nbytes", 0)
-                for a in jax.tree_util.tree_leaves(self.params))
+        if self.shards:
+            # device-resident = the placed trees (blocked + any ring
+            # replicas, every cached version), not the raw host pytree
+            n = sum(
+                getattr(a, "nbytes", 0)
+                for tree in list(self._sparams.values())
+                + list(self._rparams.values())
+                for a in jax.tree_util.tree_leaves(tree))
+        else:
+            n = sum(getattr(a, "nbytes", 0)
+                    for a in jax.tree_util.tree_leaves(self.params))
         for a in (self.cache.k, self.cache.v):
             n += getattr(a, "nbytes", 0)
         return n
@@ -325,6 +454,9 @@ class PagedLLMExecutor:
             return self.prefill_chunk(
                 prompt, 0, block_table,
                 bucket=_next_pow2(plen, 8), sync=sync)
+        kind = "prefill"
+        if self.shards and 0 < self.ring_prefill_min <= plen:
+            kind = "ring"    # sequence-parallel long-context cutover
         s_b = _next_pow2(plen, 8)
         bs = self.cache.block_size
         ids = np.zeros((1, s_b), np.int32)
@@ -333,32 +465,35 @@ class PagedLLMExecutor:
         pos = np.arange(plen)
         blk_idx[:plen] = np.asarray(block_table, np.int32)[pos // bs]
         blk_off = (np.arange(s_b) % bs).astype(np.int32)
-        jitted, fresh = self._get_jit("prefill", s_b)
+        jitted, fresh = self._get_jit(kind, s_b)
+        sp = self._exec_params(kind)
         prof = devprof.get()
         if prof.enabled:
-            prof.note_dispatch(self.name, f"prefill:{s_b}")
+            prof.note_dispatch(self.name, f"{kind}:{s_b}")
         t0 = time.perf_counter()
         logits, self.cache.k, self.cache.v = jitted(
-            self.params, ids, blk_idx, blk_off, self.cache.k,
+            sp, ids, blk_idx, blk_off, self.cache.k,
             self.cache.v, np.int32(plen - 1), n_heads=self.n_heads,
             dtype=self.dtype)
         out = np.asarray(device_sync(
             logits, tracer=self.tracer,
             name=f"{self.name}:prefill")) if sync else logits
         t1 = time.perf_counter()
+        kernel = "ring" if kind == "ring" else "xla"
         if fresh:
             self.compile_count += 1
             self._span("compile", t0, t1, what="llm_prefill", bucket=s_b,
-                       kernel="xla")
-            self._note_bucket(("llmp", s_b))
+                       kernel=kernel)
+            self._note_bucket(
+                ("llmr" if kind == "ring" else "llmp", s_b))
             self._prof_capture(
-                f"prefill:{s_b}", jitted,
-                (self.params, ids, blk_idx, blk_off, self.cache.k,
+                f"{kind}:{s_b}", jitted,
+                (sp, ids, blk_idx, blk_off, self.cache.k,
                  self.cache.v, np.int32(plen - 1)),
                 {"n_heads": self.n_heads, "dtype": self.dtype}, t1 - t0)
         else:
             self._span("invoke", t0, t1, what="llm_prefill", bucket=s_b,
-                       plen=plen, kernel="xla")
+                       plen=plen, kernel=kernel)
         self.prefills += 1
         self.kernel_invokes["xla"] += 1
         return out
@@ -375,6 +510,11 @@ class PagedLLMExecutor:
         the final chunk's value is meaningful to sampling."""
         from nnstreamer_tpu.backends.xla import _next_pow2
 
+        if self.shards:
+            raise BackendError(
+                f"llm {self.name}: chunked prefill is not supported with "
+                f"shards={self.shards}; long prompts go through the "
+                f"sequence-parallel ring prefill (ring_prefill_min)")
         clen = int(chunk.shape[0])
         c_b = max(int(bucket) or 0, _next_pow2(clen, 8))
         bs = self.cache.block_size
@@ -454,8 +594,9 @@ class PagedLLMExecutor:
         def _run():
             jitted, fresh = self._get_jit("decode", b_b)
             logits, self.cache.k, self.cache.v = jitted(
-                self.params, cur_a, tab_a, pos_a, self.cache.k,
-                self.cache.v, n_heads=self.n_heads, dtype=self.dtype)
+                self._exec_params("decode"), cur_a, tab_a, pos_a,
+                self.cache.k, self.cache.v, n_heads=self.n_heads,
+                dtype=self.dtype)
             return logits, fresh
 
         prof = devprof.get()
@@ -482,8 +623,8 @@ class PagedLLMExecutor:
             jitted, _ = self._get_jit("decode", b_b)
             self._prof_capture(
                 f"decode:{b_b}", jitted,
-                (self.params, cur_a, tab_a, pos_a, self.cache.k,
-                 self.cache.v),
+                (self._exec_params("decode"), cur_a, tab_a, pos_a,
+                 self.cache.k, self.cache.v),
                 {"n_heads": self.n_heads, "dtype": self.dtype}, t1 - t0)
         else:
             self._span("invoke", t0, t1, what="llm_decode", bucket=b_b,
@@ -506,12 +647,17 @@ class PagedLLMExecutor:
         if key in self._jits:
             return False
         jitted, _ = self._get_jit(kind, bucket, version)
-        params = self.params if params is None else params
+        if self.shards:
+            # sharded jits only accept the placed (blocked / replicated)
+            # tree for the version — never a caller-supplied raw tree
+            params = self._exec_params(kind, version)
+        else:
+            params = self.params if params is None else params
         prof = devprof.get()
         if prof.enabled:
             prof.note_dispatch(self.name, f"{kind}:{bucket}")
         t0 = time.perf_counter()
-        if kind == "prefill":
+        if kind in ("prefill", "ring"):
             ids = np.zeros((1, bucket), np.int32)
             blk = np.full((bucket,), SCRATCH_BLOCK, np.int32)
             off = (np.arange(bucket)
@@ -583,6 +729,12 @@ class PagedLLMExecutor:
         while s <= top_s:
             compiled += int(self._warm_compile("prefill", s))
             s *= 2
+        if self.shards and self.ring_prefill_min > 0:
+            # buckets a ring-cutover prompt can land in
+            s = _next_pow2(max(8, self.ring_prefill_min), 8)
+            while s <= top_s:
+                compiled += int(self._warm_compile("ring", s))
+                s *= 2
         return compiled
 
     def warm_start(self) -> int:
@@ -599,8 +751,10 @@ class PagedLLMExecutor:
                     compiled += int(self._warm_compile("prefill", bk[1]))
                 elif bk[0] == "llmd":
                     compiled += int(self._warm_compile("decode", bk[1]))
-                elif bk[0] == "llmp_chunk":
+                elif bk[0] == "llmp_chunk" and not self.shards:
                     compiled += int(self._warm_compile("chunk", bk[1]))
+                elif bk[0] == "llmr" and self.shards:
+                    compiled += int(self._warm_compile("ring", bk[1]))
             except Exception as e:    # warm start is never a gate
                 log.warning("llm warm_start bucket %s failed: %s", bk, e)
         return compiled
@@ -617,6 +771,17 @@ class PagedLLMExecutor:
                 f"incoming {self._entry.name}@{version} changes cache "
                 f"geometry; tensor_llm cannot hot-swap it over live "
                 f"paged state — swap aborted")
+        if self.shards:
+            # place the incoming version's blocked tree NOW, from the
+            # bundle in hand — if blocking refuses it (quantized, bad
+            # divisibility) the swap aborts before any epoch flips
+            from nnstreamer_tpu.serving import sharding as shg
+
+            self._sparams[version], _ = shg.shard_llm_params(
+                params, self._mesh, n_heads=self.n_heads)
+            if self.ring_prefill_min > 0:
+                self._rparams[version] = shg.replicate_params(
+                    params, self._mesh)
         served = sorted({(k[1], k[2]) for k in self._jits})
         compiled = 0
         for kind, bucket in served:
@@ -631,6 +796,9 @@ class PagedLLMExecutor:
             except Exception:
                 pass
         self._jits.clear()
+        self._sparams.clear()
+        self._rparams.clear()
+        self._sfns = None
 
     def stats(self) -> dict:
         out = {
@@ -645,6 +813,10 @@ class PagedLLMExecutor:
             "kernel_invokes": dict(self.kernel_invokes),
             "kernel_fallback": self.kernel_fallback,
         }
+        if self.shards:
+            out["shards"] = self.shards
+            out["shard_chips"] = list(self._shard_chips)
+            out["ring_prefill_min"] = self.ring_prefill_min
         if self._entry is not None:
             out["store"] = f"{self._entry.name}@{self._version}"
         return out

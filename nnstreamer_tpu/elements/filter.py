@@ -82,6 +82,15 @@ class TensorFilter(Element):
         # heads, explicit accelerator= pins, and shared model keys.
         "devices": PropDef(
             int, 0, "data-parallel replicas, one per device (0=off)"),
+        # tensor-parallel serving (serving/sharding.py): one mesh-
+        # sharded backend whose projections are head-sharded over N
+        # chips, leased as ONE shard group (a fenced member fences the
+        # group). With devices=M too, M//N such groups serve data-
+        # parallel behind the same ReplicaSet front door. Bit-parity
+        # with shards=1 by the canonical-blocking construction.
+        "shards": PropDef(
+            int, 0, "tensor-parallel shards per group: one mesh-sharded "
+                    "backend across N chips (0=off; 2/4/8)"),
         "input": PropDef(str, "", "override input dims (dim string list)"),
         "inputtype": PropDef(str, "", "override input types"),
         "output": PropDef(str, "", "override output dims"),
@@ -415,7 +424,8 @@ class TensorFilter(Element):
         behavior preserved): replication must never change what a
         pipeline computes, only where."""
         n = int(self.props["devices"] or 0)
-        if n <= 0:
+        shards = int(self.props["shards"] or 0)
+        if n <= 0 and shards <= 0:
             return
         decline = ""
         if self._members:
@@ -429,10 +439,41 @@ class TensorFilter(Element):
         elif "@" in str(self.props["model"] or "") and \
                 ":" in str(self.props["model"]).rpartition("@")[2]:
             decline = "store canary split routes per-backend (seeded RNG)"
+        if not decline and shards > 0:
+            # sharded groups serve the raw model bundle as one SPMD
+            # program (shapes re-infer per input signature) — a host-
+            # side pre/post chain or explicit I/O overrides have no
+            # per-shard replay story
+            if self._pre is not None or self._post is not None:
+                decline = ("custom-ops pre/post chain (sharded groups "
+                           "serve the raw model bundle)")
+            elif any(self.props[k] for k in
+                     ("input", "inputtype", "output", "outputtype",
+                      "inputname", "outputname")):
+                decline = "explicit I/O override props are single-backend"
+            elif fw not in ("", "xla"):
+                decline = f"framework {fw!r} (sharding is mesh/XLA-only)"
         if decline:
+            what = f"devices={n}" if shards <= 0 else f"shards={shards}"
             self._replica_decline = decline
-            log.warning("tensor_filter %s: devices=%d declined: %s",
-                        self.name, n, decline)
+            log.warning("tensor_filter %s: %s declined: %s",
+                        self.name, what, decline)
+            return
+        if shards > 0:
+            from nnstreamer_tpu.serving.sharding import (
+                SUPPORTED_SHARDS, ShardedReplicaSet)
+
+            if shards not in SUPPORTED_SHARDS:
+                self.fail_negotiation(
+                    f"shards must be one of {SUPPORTED_SHARDS} "
+                    f"(canonical 8-block serving layout), got {shards}")
+            groups = max(1, n // shards) if n > 0 else 1
+            try:
+                self.replicas = ShardedReplicaSet.open_sharded(
+                    self.props["model"], shards=shards, groups=groups,
+                    name=self.name, tracer=self._tracer)
+            except BackendError as e:
+                self.fail_negotiation(f"shards={shards}: {e}")
             return
         from nnstreamer_tpu.serving.placement import ReplicaSet
 
@@ -607,6 +648,11 @@ class TensorFilter(Element):
             out["replica_fences"] = rst["fences"]
             # per-chip rows ride along for the metrics plane
             out["replicas"] = rst["replicas"]
+            if "group_size" in rst:       # sharded groups
+                out["shards"] = rst["group_size"]
+                out["shard_groups"] = rst["devices"]
+                if "leases" in rst:
+                    out["leases"] = rst["leases"]
         if self._replica_decline:
             out["replica_decline"] = self._replica_decline
         return out

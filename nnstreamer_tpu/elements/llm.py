@@ -64,6 +64,14 @@ class TensorLLM(Element):
       interleaved with decode steps (0 = whole-prompt prefill), so a
       long prompt does not head-of-line block the batch's inter-token
       latency.
+    - shards: tensor-parallel shard count (2/4/8) — the executor opens
+      one mesh-sharded backend over N leased chips with head-sharded
+      projections and KV pools (docs/sharded_serving.md); bit-identical
+      to shards=1 by the canonical-blocking construction. Exclusive
+      with prefill_chunk and pallas.
+    - ring_prefill_min: with shards>0, prompts at least this long
+      prefill through sequence-parallel ring attention over the same
+      chips (allclose-, not bit-, equivalent; decode stays bit-exact).
     """
 
     ELEMENT_NAME = "tensor_llm"
@@ -100,6 +108,14 @@ class TensorLLM(Element):
         "prefill_chunk": PropDef(
             int, 0, "chunked-prefill chunk size in tokens "
                     "(0 = whole-prompt prefill)"),
+        "shards": PropDef(
+            int, 0, "tensor-parallel shard count (0 = single chip; "
+                    "2/4/8 serve one mesh-sharded backend whose chips "
+                    "are leased as one shard group)"),
+        "ring_prefill_min": PropDef(
+            int, 0, "with shards>0: prompts at least this long prefill "
+                    "through sequence-parallel ring attention "
+                    "(0 = always the blocked tensor-parallel path)"),
         "warm_start": PropDef(
             int, 1, "replay manifest prefill buckets at start()"),
         "prewarm": PropDef(
@@ -111,6 +127,7 @@ class TensorLLM(Element):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.engine = None
+        self._leases = None
         self._deadline: Optional[float] = None
         # per-request emission state, engine-thread only
         self._chunks: Dict[str, List[int]] = {}
@@ -136,6 +153,23 @@ class TensorLLM(Element):
             self.fail_negotiation(
                 f"prefill_chunk must be >= 0, got "
                 f"{self.props['prefill_chunk']}")
+        shards = int(self.props["shards"])
+        if shards > 0:
+            from nnstreamer_tpu.serving.sharding import SUPPORTED_SHARDS
+
+            if shards not in SUPPORTED_SHARDS:
+                self.fail_negotiation(
+                    f"shards must be one of {SUPPORTED_SHARDS} (canonical "
+                    f"8-block serving layout), got {shards}")
+            if int(self.props["prefill_chunk"]) > 0:
+                self.fail_negotiation(
+                    "prefill_chunk and shards are exclusive — sharded "
+                    "long prompts use ring_prefill_min (sequence-"
+                    "parallel ring prefill), not chunking")
+        elif int(self.props["ring_prefill_min"]) > 0:
+            self.fail_negotiation(
+                "ring_prefill_min needs shards>0 (ring prefill runs "
+                "over the shard group's chips)")
         if spec.format == TensorFormat.STATIC:
             for t in spec.tensors:
                 if np.dtype(t.dtype) != np.int32:
@@ -155,6 +189,16 @@ class TensorLLM(Element):
         model = self.props["model"]
         if isinstance(model, str) and "://" not in model:
             model = f"store://{model}"
+        shards = int(self.props["shards"])
+        chips = None
+        if shards > 0:
+            # lease the group's chips under ONE owner so a member-chip
+            # fence is one ledger row flip for the whole group
+            from nnstreamer_tpu.serving.placement import ChipLeaseTable
+            from nnstreamer_tpu.serving.sharding import visible_devices
+
+            self._leases = ChipLeaseTable(range(len(visible_devices())))
+            chips = self._leases.lease(self.name, shards)
         self.engine = LLMEngine(
             model,
             n_heads=int(self.props["n_heads"]),
@@ -166,6 +210,8 @@ class TensorLLM(Element):
             static_batching=self.props["scheduling"] == "static",
             prefill_chunk=int(self.props["prefill_chunk"]),
             paged_kernel=str(self.props["paged_kernel"]) or None,
+            shards=shards, shard_chips=chips,
+            ring_prefill_min=int(self.props["ring_prefill_min"]),
             tracer=self._tracer,
             name=self.name)
         if int(self.props["warm_start"]):
@@ -177,6 +223,8 @@ class TensorLLM(Element):
     def stop(self) -> None:
         if self.engine is not None:
             self.engine.executor.close()
+        if getattr(self, "_leases", None) is not None:
+            self._leases.release(self.name)
 
     # -- dataflow ----------------------------------------------------------
     def process(self, pad: int, buf: TensorBuffer) -> List[Emission]:
@@ -263,4 +311,6 @@ class TensorLLM(Element):
                  "warm_compiles": self.warm_compiles}
         if self.engine is not None:
             stats.update(self.engine.stats())
+        if self._leases is not None:
+            stats["leases"] = self._leases.snapshot()["counts"]
         return stats

@@ -107,8 +107,9 @@ class LLMEngine:
                  dtype=None, block_size: int = 16, num_blocks: int = 64,
                  max_batch: int = 8, max_len: int = 128,
                  static_batching: bool = False, prefill_chunk: int = 0,
-                 paged_kernel: Optional[str] = None, tracer=NULL_TRACER,
-                 name: str = "llm"):
+                 paged_kernel: Optional[str] = None, shards: int = 0,
+                 shard_chips=None, ring_prefill_min: int = 0,
+                 tracer=NULL_TRACER, name: str = "llm"):
         from nnstreamer_tpu.backends.llm_exec import PagedLLMExecutor
 
         self.name = name
@@ -119,10 +120,17 @@ class LLMEngine:
         if self.prefill_chunk < 0:
             raise BackendError(
                 f"prefill_chunk must be >= 0, got {self.prefill_chunk}")
+        if int(shards) > 0 and self.prefill_chunk > 0:
+            raise BackendError(
+                f"llm {name}: prefill_chunk and shards are exclusive — "
+                f"sharded long prompts go through the sequence-parallel "
+                f"ring prefill (ring_prefill_min), not chunking")
         self.executor = PagedLLMExecutor(
             model, n_heads=n_heads, dtype=dtype, block_size=block_size,
             num_blocks=num_blocks, max_len=max_len,
-            paged_kernel=paged_kernel, tracer=tracer, name=name)
+            paged_kernel=paged_kernel, shards=shards,
+            shard_chips=shard_chips, ring_prefill_min=ring_prefill_min,
+            tracer=tracer, name=name)
         self.cache = self.executor.cache
         self.queue: deque = deque()
         self.active: List[LLMRequest] = []

@@ -112,7 +112,7 @@ class PagedKVCache:
     """
 
     def __init__(self, *, num_blocks: int, block_size: int, n_layers: int,
-                 n_kv: int, head_dim: int, dtype=None):
+                 n_kv: int, head_dim: int, dtype=None, placer=None):
         import jax.numpy as jnp
 
         self.num_blocks = int(num_blocks)
@@ -125,6 +125,13 @@ class PagedKVCache:
                  self.n_kv, self.head_dim)
         self.k = jnp.zeros(shape, self.dtype)
         self.v = jnp.zeros(shape, self.dtype)
+        if placer is not None:
+            # sharded serving hands us a device-placement closure (pool
+            # sharded along the kv-head axis next to the projections —
+            # serving/sharding.kv_pool_placer); allocator/table logic is
+            # untouched, only where the bytes live changes
+            self.k = placer(self.k)
+            self.v = placer(self.v)
         self.allocator = BlockAllocator(self.num_blocks)
 
     def blocks_for(self, n_tokens: int) -> int:

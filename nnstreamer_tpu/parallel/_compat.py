@@ -1,0 +1,22 @@
+"""jax API compatibility shims shared by the parallel/ modules.
+
+One blessed copy of the `shard_map` import dance (previously pasted
+into moe.py, ring_attention.py and pipeline.py): jax >= 0.5 exports
+`jax.shard_map` with the `check_vma` keyword; older releases keep it in
+`jax.experimental.shard_map` under the `check_rep` spelling. Importers
+write `from nnstreamer_tpu.parallel._compat import shard_map` and use
+the modern keyword everywhere.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map
+except ImportError:                     # jax < 0.5 keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, **kw):            # the experimental API spells
+        kw["check_rep"] = kw.pop("check_vma", True)   # check_vma check_rep
+        return _shard_map_exp(f, **kw)
+
+__all__ = ["shard_map"]

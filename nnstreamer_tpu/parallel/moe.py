@@ -31,15 +31,9 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 from jax import lax
-try:
-    from jax import shard_map
-except ImportError:                     # jax < 0.5 keeps it in experimental
-    from jax.experimental.shard_map import shard_map as _shard_map_exp
-
-    def shard_map(f, **kw):            # the experimental API spells
-        kw["check_rep"] = kw.pop("check_vma", True)   # check_vma check_rep
-        return _shard_map_exp(f, **kw)
 from jax.sharding import Mesh, PartitionSpec as P
+
+from nnstreamer_tpu.parallel._compat import shard_map
 
 
 def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int,

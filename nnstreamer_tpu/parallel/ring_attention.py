@@ -24,14 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:                     # jax < 0.5 keeps it in experimental
-    from jax.experimental.shard_map import shard_map as _shard_map_exp
 
-    def shard_map(f, **kw):            # the experimental API spells
-        kw["check_rep"] = kw.pop("check_vma", True)   # check_vma check_rep
-        return _shard_map_exp(f, **kw)
+from nnstreamer_tpu.parallel._compat import shard_map
 
 NEG_INF = -1e30
 

@@ -69,7 +69,12 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                  per-chip invoke/error counters + queue-depth/up gauges
                  labelled by device; Σ nns_replica_invokes_total over
                  devices == that filter's invoke count — the replica
-                 conservation check, verifiable from one scrape
+                 conservation check, verifiable from one scrape.
+                 ShardedReplicaSet stats (rows carrying "group") emit
+                 the nns_shard_* family on top: per-group invoke/up/
+                 adopted-epoch series plus the shard width and the
+                 chip-lease ledger, with the same Σ-over-groups ==
+                 filter-invokes conservation contract
     segments   — {plan: SegmentPlan.report()}: per-stage profiled time
                  (labelled stage/device) + the plan's bubble fraction
     pool       — WorkerPool.stats() snapshot
@@ -254,6 +259,50 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
             "frames re-routed to a surviving replica after a fence",
             [({"filter": f}, float(st.get("reoffers", 0)))
              for f, st in sorted(replicas.items())]))
+        # sharded serving: rows carrying a "group" key come from a
+        # ShardedReplicaSet (serving/sharding.py) — one row per shard
+        # GROUP, i.e. N chips acting as one tensor-parallel backend.
+        # Σ nns_shard_group_invokes_total over groups equals the owning
+        # filter's invoke count, so tensor-parallel conservation is the
+        # same one-scrape check the per-chip replica family gives.
+        sh = [(f, r) for f, st in sorted(replicas.items())
+              for r in st.get("replicas", []) if "group" in r]
+        if sh:
+            out.append(_series(
+                f"{ns}_shard_group_invokes_total", "counter",
+                "per-shard-group invokes; summed over groups this "
+                "equals the owning filter's invoke count — the "
+                "tensor-parallel conservation check",
+                [({"filter": f, "group": str(r["group"]),
+                   "devices": ",".join(str(d) for d in r["devices"])},
+                  float(r["invokes"])) for f, r in sh]))
+            out.append(_series(
+                f"{ns}_shard_group_up", "gauge",
+                "1 when every member chip of the group serves; fencing "
+                "ONE member fences the whole group (state label says "
+                "which)",
+                [({"filter": f, "group": str(r["group"]),
+                   "state": r["state"]}, 1.0 if r["up"] else 0.0)
+                 for f, r in sh]))
+            out.append(_series(
+                f"{ns}_shard_group_adopted_epoch", "gauge",
+                "store swap epoch this group last adopted; all groups "
+                "of a filter reporting one value proves the hot swap "
+                "was epoch-atomic across the shard set",
+                [({"filter": f, "group": str(r["group"])},
+                  float(r.get("adopted_epoch", 0))) for f, r in sh]))
+            out.append(_series(
+                f"{ns}_shard_group_size", "gauge",
+                "chips per shard group (the tensor-parallel width)",
+                [({"filter": f}, float(st["group_size"]))
+                 for f, st in sorted(replicas.items())
+                 if "group_size" in st]))
+            out.append(_series(
+                f"{ns}_shard_leased_chips", "gauge",
+                "chip-lease ledger of the sharded filter, by state",
+                [({"filter": f, "state": state}, float(v))
+                 for f, st in sorted(replicas.items())
+                 for state, v in sorted(st.get("leases", {}).items())]))
 
     if segments:
         stage_rows = [(pl, row) for pl, rep in sorted(segments.items())
@@ -788,6 +837,11 @@ _TOP_KEY_FAMILIES = (
     # goodput, queue depth = where the backpressure is, up = fences
     "nns_replica_invokes_total", "nns_replica_queue_depth",
     "nns_replica_up",
+    # shard-group rows (serving/sharding.py): per-group goodput, the
+    # group fence state, and the adopted swap epoch — one value across
+    # groups means the flip was atomic
+    "nns_shard_group_invokes_total", "nns_shard_group_up",
+    "nns_shard_group_adopted_epoch",
     # autotuner rows: decision rate by knob/outcome + where every
     # controlled knob sits right now
     "nns_autotune_decisions_total", "nns_autotune_knob",

@@ -493,11 +493,18 @@ class SegmentPlan:
     """Where to cut a filter chain and which device runs each piece."""
 
     stages: List[List[str]]        # element names, dataflow order
-    devices: List[int]             # device ordinal per stage
+    devices: List[int]             # first device ordinal per stage
     stage_times_s: List[float]     # profiled per-stage proctime sum
     bubble_fraction: float         # steady-state device idle share
     total_s: float                 # profiled single-device total
     source: str = "profile"
+    tp: List[int] = field(default_factory=list)  # shards per stage (1 = none)
+
+    def tp_of(self, stage: int) -> int:
+        return self.tp[stage] if self.tp else 1
+
+    def chips_total(self) -> int:
+        return sum(self.tp) if self.tp else len(self.stages)
 
     def stage_of(self) -> Dict[str, int]:
         return {name: i for i, group in enumerate(self.stages)
@@ -509,11 +516,13 @@ class SegmentPlan:
             "stages": [
                 {"stage": i, "device": self.devices[i],
                  "elements": list(self.stages[i]),
-                 "time_s": self.stage_times_s[i]}
+                 "time_s": self.stage_times_s[i],
+                 "tp": self.tp_of(i)}
                 for i in range(len(self.stages))],
             "bubble_fraction": self.bubble_fraction,
             "bottleneck_s": max(self.stage_times_s, default=0.0),
             "total_s": self.total_s,
+            "chips_total": self.chips_total(),
             "source": self.source,
         }
 
@@ -601,18 +610,128 @@ def segment_plan(costs: Sequence[Tuple[str, float]],
                        total_s=prefix[n], source=source)
 
 
-def plan_from_tracer(tracer, names: Sequence[str],
-                     ndev: int) -> SegmentPlan:
+def _tp_speedup(t: int, eff: float) -> float:
+    """Modeled speedup of giving one stage `t` tensor-parallel shards:
+    each doubling buys 2·eff (eff < 1 pays for the all-gather/combine
+    collectives), so speedup(t) = t · eff^log2(t). speedup(1) == 1."""
+    return float(t) * (eff ** max(0, t.bit_length() - 1))
+
+
+def segment_plan_tp(costs: Sequence[Tuple[str, float]], ndev: int, *,
+                    tp_efficiency: float = 0.7,
+                    source: str = "profile") -> SegmentPlan:
+    """TP-vs-segmentation mix: spend a `ndev`-chip budget on pipeline
+    cuts AND tensor-parallel shard groups, minimizing the modeled
+    bottleneck. For every candidate stage count j the inner linear
+    partition DP (same recurrence as `segment_plan`) yields the best
+    j-way cut; the j-1 leftover chips are then spent greedily, always
+    doubling the TP width of the current bottleneck stage (widths stay
+    in `serving.sharding.SUPPORTED_SHARDS`, one shard group per stage).
+    The j whose mixed plan has the lowest bottleneck wins; ties prefer
+    fewer stages, then fewer chips — a cut or a shard that buys nothing
+    is not taken. `stage_times_s` holds the modeled post-TP times, so
+    `bubble_fraction` reflects the mixed plan; `devices[i]` is the
+    first chip ordinal of stage i's contiguous tp[i]-chip group."""
+    from nnstreamer_tpu.serving.sharding import SUPPORTED_SHARDS
+
+    names = [n for n, _ in costs]
+    ts = [max(0.0, float(t)) for _, t in costs]
+    n = len(ts)
+    if n == 0:
+        raise BackendError("segment_plan_tp: empty cost profile")
+    if not 0.0 < tp_efficiency <= 1.0:
+        raise BackendError(
+            f"segment_plan_tp: tp_efficiency must be in (0, 1], "
+            f"got {tp_efficiency}")
+    ndev = max(1, int(ndev))
+    k = min(ndev, n)
+    prefix = [0.0]
+    for t in ts:
+        prefix.append(prefix[-1] + t)
+    INF = float("inf")
+    best = [[INF] * (n + 1) for _ in range(k + 1)]
+    cut = [[0] * (n + 1) for _ in range(k + 1)]
+    best[0][0] = 0.0
+    for j in range(1, k + 1):
+        for i in range(j, n + 1):
+            for m in range(j - 1, i):
+                cand = max(best[j - 1][m], prefix[i] - prefix[m])
+                if cand < best[j][i]:
+                    best[j][i] = cand
+                    cut[j][i] = m
+
+    def _partition(j: int) -> List[float]:
+        bounds: List[int] = []
+        i = n
+        jj = j
+        while jj > 0:
+            bounds.append(i)
+            i = cut[jj][i]
+            jj -= 1
+        bounds.reverse()
+        return bounds
+
+    top_tp = max(s for s in SUPPORTED_SHARDS)
+    champion = None  # (bottleneck, j, chips, bounds, tps)
+    for j in range(1, k + 1):
+        bounds = _partition(j)
+        raw = []
+        lo = 0
+        for hi in bounds:
+            raw.append(prefix[hi] - prefix[lo])
+            lo = hi
+        tps = [1] * j
+        spare = ndev - j
+        # double the bottleneck's TP while a doubling fits the budget
+        # and actually lowers the modeled bottleneck
+        while True:
+            eff = [raw[s] / _tp_speedup(tps[s], tp_efficiency)
+                   for s in range(j)]
+            b = max(range(j), key=lambda s: eff[s])
+            grow = tps[b]  # doubling costs tps[b] more chips
+            if (tps[b] * 2 > top_tp or grow > spare
+                    or _tp_speedup(tps[b] * 2, tp_efficiency)
+                    <= _tp_speedup(tps[b], tp_efficiency)):
+                break
+            tps[b] *= 2
+            spare -= grow
+        eff = [raw[s] / _tp_speedup(tps[s], tp_efficiency)
+               for s in range(j)]
+        key = (max(eff), j, sum(tps))
+        if champion is None or key < champion[0]:
+            champion = (key, bounds, tps, eff)
+    _, bounds, tps, eff = champion
+    stages = []
+    lo = 0
+    for hi in bounds:
+        stages.append(names[lo:hi])
+        lo = hi
+    devices, off = [], 0
+    for t in tps:
+        devices.append(off)
+        off += t
+    return SegmentPlan(stages=stages, devices=devices,
+                       stage_times_s=eff, bubble_fraction=_bubble(eff),
+                       total_s=prefix[n], source=source, tp=tps)
+
+
+def plan_from_tracer(tracer, names: Sequence[str], ndev: int,
+                     tp_efficiency: Optional[float] = None) -> SegmentPlan:
     """Build a plan from the tracer's per-element proctime histograms
     (`Tracer.hists()`): each element's cost is its observed mean
     process() time. Elements with no profile yet cost zero (they ride
-    along with profiled neighbours)."""
+    along with profiled neighbours). Pass `tp_efficiency` to let the
+    planner trade pipeline cuts against tensor-parallel shard groups
+    (`segment_plan_tp`); None keeps the pure-segmentation DP."""
     hists = tracer.hists() if getattr(tracer, "active", False) else {}
     costs = []
     for nm in names:
         h = hists.get(nm)
         costs.append((nm, h["sum"] / h["count"]
                       if h and h["count"] else 0.0))
+    if tp_efficiency is not None:
+        return segment_plan_tp(costs, ndev, tp_efficiency=tp_efficiency,
+                               source="tracer")
     return segment_plan(costs, ndev, source="tracer")
 
 
@@ -620,10 +739,14 @@ def apply_plan(pipe, plan: SegmentPlan) -> int:
     """Pin each planned stage's filters to its device (sets the
     `accelerator` prop — must run BEFORE negotiation) and record the
     plan on the pipeline so `fuse_segments` splices plan-aware: members
-    fuse within a stage, never across a cut. Returns the number of
-    elements pinned."""
+    fuse within a stage, never across a cut. Stages the planner gave a
+    TP group (`plan.tp[i] > 1`) get the `shards` prop instead of a
+    device pin — the sharded backend leases its own chip group, so a
+    single-chip `accelerator` pin would fight the mesh. Returns the
+    number of elements pinned."""
     pinned = 0
-    for group, dev in zip(plan.stages, plan.devices):
+    for si, (group, dev) in enumerate(zip(plan.stages, plan.devices)):
+        tp = plan.tp_of(si)
         accel = accelerator_for(dev)
         for name in group:
             e = pipe.elements.get(name)
@@ -631,7 +754,10 @@ def apply_plan(pipe, plan: SegmentPlan) -> int:
                 log.warning("apply_plan: element %r not in pipeline "
                             "(already fused?)", name)
                 continue
-            if "accelerator" in e.PROPS or "accelerator" in e.props:
+            if tp > 1 and ("shards" in e.PROPS or "shards" in e.props):
+                e.props["shards"] = tp
+                pinned += 1
+            elif "accelerator" in e.PROPS or "accelerator" in e.props:
                 e.props["accelerator"] = accel
                 pinned += 1
     pipe.segment_plan = plan

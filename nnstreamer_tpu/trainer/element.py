@@ -132,11 +132,11 @@ class TensorTrainer(Element):
         state = init_state(params, opt)
         self._mesh = mesh
         if mesh is not None:
-            from jax.sharding import PartitionSpec as P
-
             state = shard_state(state, mesh)
-            self._step_fn = make_train_step(self._loss_fn, opt, mesh=mesh,
-                                            batch_spec=(P("dp"), P("dp")))
+            # batch_spec defaults to dp-sharded leading dims inside
+            # make_train_step — spec construction stays in parallel/
+            # (NNL012 shard-safety)
+            self._step_fn = make_train_step(self._loss_fn, opt, mesh=mesh)
         else:
             self._step_fn = make_train_step(self._loss_fn, opt)
         self._state = state

@@ -587,6 +587,53 @@ def test_nnl011_silent_outside_the_chaos_paths():
                              REPO_PATHS["elem"]: BAD_CHAOS_RNG})
 
 
+# -- NNL012 shard-safety -----------------------------------------------------
+
+BAD_SHARDING = '''
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+def place(mesh, tree, fn):
+    spec = PartitionSpec("tp")                       # private mesh program
+    placed = jax.device_put(tree, NamedSharding(mesh, spec))
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec,),
+                         out_specs=spec)(placed)
+'''
+
+GOOD_SHARDING = '''
+from nnstreamer_tpu.serving import sharding
+
+def place(params, mesh, n_heads):
+    placed, specs = sharding.shard_llm_params(params, mesh,
+                                              n_heads=n_heads)
+    return placed, sharding.kv_pool_placer(mesh)
+'''
+
+
+def test_nnl012_fires_on_mesh_program_outside_subsystem():
+    findings = assert_fires(
+        "NNL012", {REPO_PATHS["backend"]: BAD_SHARDING}, n_min=4)
+    msgs = " ".join(f.message for f in findings)
+    # both arms: the jax import and every construction site
+    assert "from jax.sharding import" in msgs
+    assert "shard_map" in msgs and "NamedSharding" in msgs \
+        and "PartitionSpec" in msgs
+
+
+def test_nnl012_silent_on_consuming_the_subsystem():
+    assert_silent("NNL012", {REPO_PATHS["backend"]: GOOD_SHARDING})
+
+
+def test_nnl012_blessed_in_parallel_and_sharding():
+    # parallel/ and serving/sharding.py ARE the sharding subsystem —
+    # the rule keeps private mesh programs from leaking anywhere else
+    assert_silent("NNL012", {
+        "nnstreamer_tpu/serving/sharding.py": BAD_SHARDING,
+        "nnstreamer_tpu/parallel/ring_attention.py": BAD_SHARDING,
+        "nnstreamer_tpu/parallel/_compat.py": BAD_SHARDING,
+    })
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_inline_suppression_waives_a_finding():

@@ -274,6 +274,87 @@ class TestMeshExposition:
         assert sum(local.values()) == (9 - 1) + (31 - 1)
 
 
+def _sharded_replicas(invokes=(6, 4), fenced=None):
+    """Synthetic ShardedReplicaSet.stats() — the shape placement's
+    ReplicaSet emits plus the shard-group keys sharding.py adds."""
+    rows = []
+    for g, inv in enumerate(invokes):
+        state = "fenced" if g == fenced else "ready"
+        rows.append({"device": g * 2, "platform": "cpu",
+                     "invokes": inv, "batches": inv, "errors": 0,
+                     "queue_depth": 0, "up": state == "ready",
+                     "state": state, "compile_count": 1,
+                     "adopted_epoch": 1,
+                     "group": g, "devices": [g * 2, g * 2 + 1],
+                     "shards": 2})
+    return {"replicas": rows, "devices": len(invokes),
+            "live": sum(1 for r in rows if r["up"]),
+            "routed": sum(invokes), "reoffers": 0, "rejected": 0,
+            "fences": 1 if fenced is not None else 0,
+            "group_size": 2,
+            "leases": {"free": 8 - 2 * len(invokes),
+                       "leased": 2 * len(invokes), "fenced": 0}}
+
+
+class TestShardExposition:
+    def test_shard_family_round_trips_and_conserves(self):
+        """Sharded-serving satellite: nns_shard_* series survive
+        render → parse with group/devices labels intact, and Σ shard
+        group invokes == the filter's replica invokes — tensor-parallel
+        conservation from one scrape."""
+        st = _sharded_replicas(invokes=(6, 4))
+        parsed = parse_prometheus(render_prometheus(metrics_snapshot(
+            replicas={"f": st})))
+        fam = parsed["nns_shard_group_invokes_total"]
+        assert fam["type"] == "counter"
+        by_group = {re.search(r'group="([^"]+)"', k).group(1): v
+                    for k, v in fam["samples"].items()}
+        assert by_group == {"0": 6.0, "1": 4.0}
+        # the per-chip replica family carries the same rows, so the
+        # shard sum equals the replica sum equals filter invokes
+        rep = parsed["nns_replica_invokes_total"]["samples"]
+        assert sum(by_group.values()) == sum(rep.values()) == 10.0
+        # devices label names every member chip of the group
+        assert any('devices="0,1"' in k for k in fam["samples"])
+        # width + lease ledger exported as gauges
+        assert parsed["nns_shard_group_size"]["samples"][
+            'nns_shard_group_size{filter="f"}'] == 2.0
+        leases = parsed["nns_shard_leased_chips"]["samples"]
+        assert leases['nns_shard_leased_chips{filter="f",'
+                      'state="leased"}'] == 4.0
+        # adopted epoch: one distinct value across groups == atomic swap
+        epochs = set(parsed["nns_shard_group_adopted_epoch"]
+                     ["samples"].values())
+        assert epochs == {1.0}
+
+    def test_member_fence_shows_as_group_down(self):
+        st = _sharded_replicas(invokes=(6, 4), fenced=1)
+        parsed = parse_prometheus(render_prometheus(metrics_snapshot(
+            replicas={"f": st})))
+        up = parsed["nns_shard_group_up"]["samples"]
+        down = [k for k, v in up.items() if v == 0.0]
+        assert len(down) == 1
+        assert 'group="1"' in down[0] and 'state="fenced"' in down[0]
+
+    def test_unsharded_stats_emit_no_shard_family(self):
+        st = _sharded_replicas(invokes=(3,))
+        for r in st["replicas"]:
+            for k in ("group", "devices", "shards"):
+                r.pop(k)
+        st.pop("group_size"); st.pop("leases")
+        parsed = parse_prometheus(render_prometheus(metrics_snapshot(
+            replicas={"f": st})))
+        assert "nns_replica_invokes_total" in parsed
+        assert not any(f.startswith("nns_shard_") for f in parsed)
+
+    def test_shard_rows_appear_in_top_table(self):
+        cur = parse_prometheus(render_prometheus(metrics_snapshot(
+            replicas={"f": _sharded_replicas()})))
+        lines = "\n".join(top_table({}, cur, 1.0))
+        assert "nns_shard_group_invokes_total" in lines
+        assert "nns_shard_group_up" in lines
+
+
 class TestTopView:
     def test_counter_rates_and_gauges(self):
         p1 = parse_prometheus(render_prometheus(metrics_snapshot(

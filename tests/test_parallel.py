@@ -345,6 +345,100 @@ def test_ring_attention_batch_axis_pallas_block(eight_cpu_devices):
                                atol=1e-4)
 
 
+# -- dormant-module smoke (sharded-serving PR satellites) ---------------------
+
+def test_mesh_spec_resolve_edge_cases(eight_cpu_devices):
+    from nnstreamer_tpu.core.errors import PipelineError
+
+    # a single wildcard soaks up every remaining device
+    assert MeshSpec(dp=-1).resolve(8) == (8, 1, 1, 1, 1)
+    assert MeshSpec(dp=1, tp=-1, sp=2).resolve(8) == (1, 1, 4, 1, 2)
+    # exact fit with no wildcard
+    assert MeshSpec(dp=2, tp=2, sp=2).resolve(8) == (2, 1, 2, 1, 2)
+    # two wildcards are ambiguous — refused, never guessed
+    with pytest.raises(PipelineError, match="at most one"):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+    # fixed axes that do not divide the device count
+    with pytest.raises(PipelineError, match="divide"):
+        MeshSpec(dp=3, tp=2).resolve(8)
+    # oversubscription: more chips demanded than visible
+    with pytest.raises(PipelineError):
+        MeshSpec(dp=16).resolve(8)
+    with pytest.raises(PipelineError):
+        MeshSpec(dp=4, tp=4).resolve(8)
+
+
+def test_compat_shard_map_is_the_single_source():
+    """Satellite guard: every shard_map consumer goes through the
+    `parallel/_compat` shim (one copy of the jax-version import dance),
+    and the shim accepts the modern `check_vma` keyword."""
+    from nnstreamer_tpu.parallel import _compat, moe, pipeline, ring_attention
+
+    assert moe.shard_map is _compat.shard_map
+    assert pipeline.shard_map is _compat.shard_map
+    assert ring_attention.shard_map is _compat.shard_map
+    assert callable(_compat.shard_map)
+
+
+def test_block_attn_streaming_accumulator_matches_reference(
+        eight_cpu_devices):
+    """`_block_attn` is the online-softmax accumulator both the ring and
+    the sharded-serving prefill lean on: feeding the K/V blocks through
+    it sequentially (no mesh at all) must reproduce dense attention."""
+    from nnstreamer_tpu.parallel.ring_attention import (
+        NEG_INF, _block_attn, reference_attention)
+
+    key = jax.random.PRNGKey(5)
+    B, S, H, D, nblk = 2, 32, 2, 8, 4
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    m = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+    o = jnp.zeros((B, S, H, D), jnp.float32)
+    step = S // nblk
+    for i in range(nblk):
+        kb = k[:, i * step:(i + 1) * step]
+        vb = v[:, i * step:(i + 1) * step]
+        m, l, o = _block_attn(q, kb, vb, m, l, o)
+    got = o / l.transpose(0, 2, 1)[..., None]
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_matches_block_accumulator_exactly(
+        eight_cpu_devices):
+    """Ring attention on the sp mesh vs the same `_block_attn` chain run
+    serially in ring-visit order: identical block count and order means
+    the mesh only changes *where* blocks live, not the numerics."""
+    from nnstreamer_tpu.parallel.ring_attention import (
+        NEG_INF, _block_attn, ring_attention)
+
+    n = 4
+    mesh = make_mesh(MeshSpec(dp=1, tp=1, sp=n))
+    key = jax.random.PRNGKey(6)
+    B, S, H, D = 1, 32, 2, 8
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    out = ring_attention(q, k, v, mesh=mesh)
+    step = S // n
+    rows = []
+    for d in range(n):           # device d's query block
+        qd = q[:, d * step:(d + 1) * step]
+        m = jnp.full((B, H, step), NEG_INF, jnp.float32)
+        l = jnp.zeros((B, H, step), jnp.float32)
+        o = jnp.zeros((B, step, H, D), jnp.float32)
+        for hop in range(n):     # ppermute ring visit order
+            src = (d - hop) % n
+            kb = k[:, src * step:(src + 1) * step]
+            vb = v[:, src * step:(src + 1) * step]
+            m, l, o = _block_attn(qd, kb, vb, m, l, o)
+        rows.append(o / l.transpose(0, 2, 1)[..., None])
+    want = jnp.concatenate(rows, axis=1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_dryrun_composed_dp_tp_sp_numeric(eight_cpu_devices):
     """The driver gate's composed-mesh section (dp×tp×sp in one program
     + in-gate numeric check) on the virtual 8-device mesh."""
