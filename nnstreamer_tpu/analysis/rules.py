@@ -40,6 +40,13 @@ NNL012 shard-safety       shard_map / NamedSharding / PartitionSpec
                           canonical-blocking helpers; a stray
                           shard_map elsewhere reintroduces
                           shard-count-dependent numerics
+NNL013 shm-safety         multiprocessing.shared_memory / mmap only
+                          inside serving/shm.py (segment lifetime and
+                          resource-tracker semantics live in ONE
+                          place), and no per-frame `pickle.dumps`
+                          inside loops on the serving hot paths — the
+                          shm ring lane exists so steady-state hops
+                          don't re-serialize per frame
 
 Every rule is pure AST — nothing here imports the code under analysis.
 Heuristics err toward silence (a missed finding is a review problem; a
@@ -907,12 +914,79 @@ class ShardSafety(Rule):
                         f"make_llm_fns) or parallel/")
 
 
+class ShmSafety(Rule):
+    rule_id = "NNL013"
+    title = "shm-safety"
+    rationale = (
+        "the same-host shared-memory transport's conservation story "
+        "(zero lost frames, zero orphan segments through worker "
+        "kill/restart) holds because segment lifetime — create/attach/"
+        "close/unlink and the resource-tracker unregister discipline — "
+        "lives in exactly one module, serving/shm.py. A SharedMemory "
+        "or mmap constructed anywhere else is a second lifetime "
+        "story the kill drill does not audit. And on the serving hot "
+        "paths, a `pickle.dumps` inside a loop re-serializes per "
+        "frame — the tax the ring lane exists to remove; hoist the "
+        "serialization out of the loop or route the payload through "
+        "the transport")
+
+    #: the one module allowed to own shared-memory segment lifetime
+    ALLOWED = ("serving/shm.py",)
+    #: where a per-frame pickle.dumps is a hot-path tax, not a choice
+    HOT_PATHS = ("serving/",)
+
+    def check(self, module: Module, project: Project):
+        p = f"/{module.path}"
+        if not any(f"/{a}" in p for a in self.ALLOWED):
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    if mod == "multiprocessing.shared_memory" or (
+                            mod == "multiprocessing" and any(
+                                a.name == "shared_memory"
+                                for a in node.names)):
+                        yield node, (
+                            "multiprocessing.shared_memory imported "
+                            "outside serving/shm.py: segment lifetime "
+                            "(create/attach/close/unlink, resource-"
+                            "tracker discipline) lives in ONE module — "
+                            "use ShmRing from serving/shm.py")
+                elif isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    if name.split(".")[-1] == "SharedMemory" \
+                            or name == "mmap.mmap":
+                        yield node, (
+                            f"`{name}(...)` outside serving/shm.py: a "
+                            f"shared segment constructed here has a "
+                            f"lifetime the worker-kill drill does not "
+                            f"audit — route through ShmRing "
+                            f"(serving/shm.py)")
+        if not any(f"/{s}" in p for s in self.HOT_PATHS):
+            return
+        seen: Set[int] = set()
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for node in walk_no_functions(loop.body + loop.orelse):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                if dotted(node.func) == "pickle.dumps":
+                    seen.add(id(node))
+                    yield node, (
+                        "per-frame `pickle.dumps` in a serving hot "
+                        "loop: steady-state hops should not "
+                        "re-serialize every frame — hoist the "
+                        "serialization out of the loop or move the "
+                        "payload onto the shm ring lane "
+                        "(serving/shm.py)")
+
+
 #: registry, in catalog order
 ALL_RULES: List[Rule] = [
     ElementContract(), ForcedSync(), LockDiscipline(), JitPurity(),
     SpawnSafety(), PicklableErrors(), ThreadAudit(), SocketAudit(),
     PlacementAudit(), DeviceAccountingAudit(), SeededChaosAudit(),
-    ShardSafety(),
+    ShardSafety(), ShmSafety(),
 ]
 
 

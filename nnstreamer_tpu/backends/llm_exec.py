@@ -205,6 +205,10 @@ class PagedLLMExecutor:
         self.prefills = 0
         self.chunk_prefills = 0
         self.decode_steps = 0
+        # compiled decode windows (decode_multi): windows dispatched /
+        # decode steps served through a window
+        self.decode_windows = 0
+        self.window_steps = 0
 
     # -- store integration -------------------------------------------------
     def _vkey(self, version: Optional[int] = None):
@@ -633,6 +637,116 @@ class PagedLLMExecutor:
         self.kernel_invokes[kernel] += 1
         return out
 
+    def _get_multi_jit(self, bucket: int, steps: int, version=None):
+        """Jitted K-step greedy decode window: ``jax.lax.scan`` whose
+        body is exactly the per-step decode kernel plus an on-device
+        ``jnp.argmax`` feeding the next step. One cache entry per
+        (bucket, steps) pair — the engine rounds `steps` down to a
+        power of two so the cache stays O(log K) per bucket."""
+        import jax
+        import jax.numpy as jnp
+
+        kernel = self._kind_kernel("decode")
+        key = (self._ns(version), "decmulti", bucket, steps, kernel)
+        jitted = self._jits.get(key)
+        if jitted is not None:
+            self.cache_hits += 1
+            return jitted, False
+        self.cache_misses += 1
+        if kernel == "pallas":
+            from nnstreamer_tpu.backends.pallas_paged import (
+                paged_flash_decode_step)
+            step_fn = paged_flash_decode_step
+        else:
+            from nnstreamer_tpu.llm.paged_model import paged_decode_step
+            step_fn = paged_decode_step
+
+        def multi(params, cur, tab, pos, kc, vc, *, n_heads, dtype):
+            def body(carry, _):
+                cur_, pos_, kc_, vc_ = carry
+                logits, kc2, vc2 = step_fn(
+                    params, cur_, tab, pos_, kc_, vc_,
+                    n_heads=n_heads, dtype=dtype)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, pos_ + 1, kc2, vc2), nxt
+            (_, _, kc_f, vc_f), toks = jax.lax.scan(
+                body, (cur, pos, kc, vc), None, length=steps)
+            return toks, kc_f, vc_f
+
+        jitted = jax.jit(multi, static_argnames=("n_heads", "dtype"),
+                         donate_argnums=(4, 5))
+        self._jits[key] = jitted
+        return jitted, True
+
+    def decode_multi(self, cur: List[int], tables: List[List[int]],
+                     pos: List[int], steps: int) -> np.ndarray:
+        """`steps` greedy decode steps for `len(cur)` live rows as ONE
+        compiled dispatch (the engine's `decode_window` fast path): the
+        sampled token feeds the next step on-device, so the host pays
+        one Python dispatch and one sync per window instead of one per
+        token. Returns a host (n, steps) int32 token matrix.
+
+        The caller guarantees the window invariants (llm/engine.py
+        `_window_len`): every row is greedy (temperature<=0, matching
+        the host argmax tie-breaking bit for bit), `steps` never
+        exceeds any row's remaining token budget (block tables are
+        fully pre-allocated at admission, so position pos+steps-1 is
+        always backed), and rows that hit EOS mid-window have their
+        trailing tokens discarded host-side — the extra KV writes land
+        in blocks the row still owned when the window ran."""
+        from nnstreamer_tpu.backends.xla import _next_pow2
+
+        n = len(cur)
+        steps = int(steps)
+        b_b = _next_pow2(n, 1)
+        cur_a = np.zeros((b_b,), np.int32)
+        cur_a[:n] = cur
+        tab_a = np.full((b_b, self.max_blocks), SCRATCH_BLOCK, np.int32)
+        for i, t in enumerate(tables):
+            tab_a[i, :len(t)] = t
+        pos_a = np.zeros((b_b,), np.int32)
+        pos_a[:n] = pos
+
+        def _run():
+            jitted, fresh = self._get_multi_jit(b_b, steps)
+            toks, self.cache.k, self.cache.v = jitted(
+                self._exec_params("decode"), cur_a, tab_a, pos_a,
+                self.cache.k, self.cache.v, n_heads=self.n_heads,
+                dtype=self.dtype)
+            return toks, fresh
+
+        prof = devprof.get()
+        if prof.enabled:
+            prof.note_dispatch(self.name, f"decmulti:{b_b}x{steps}")
+        t0 = time.perf_counter()
+        try:
+            toks, fresh = _run()
+        except Exception as e:
+            if self.paged_kernel != "pallas":
+                raise
+            self._kernel_fallback_to_xla("decode", e)
+            toks, fresh = _run()
+        kernel = self._kind_kernel("decode")
+        out = np.asarray(device_sync(
+            toks, tracer=self.tracer,
+            name=f"{self.name}:decmulti"))[:, :n].T
+        t1 = time.perf_counter()
+        if fresh:
+            self.compile_count += 1
+            self._span("compile", t0, t1, what="llm_decode_multi",
+                       bucket=b_b, steps=steps, kernel=kernel)
+            self._note_bucket(("llmw", b_b, steps))
+        else:
+            self._span("invoke", t0, t1, what="llm_decode_multi",
+                       bucket=b_b, steps=steps, rows=n, kernel=kernel)
+        # the ledger counts the same decode steps whether or not the
+        # window path served them — parity with per-step mode
+        self.decode_steps += steps
+        self.kernel_invokes[kernel] += steps
+        self.decode_windows += 1
+        self.window_steps += steps
+        return out
+
     # -- warm paths --------------------------------------------------------
     def _warm_compile(self, kind: str, bucket: int, version=None,
                       params=None) -> bool:
@@ -808,6 +922,8 @@ class PagedLLMExecutor:
             "prefills": self.prefills,
             "chunk_prefills": self.chunk_prefills,
             "decode_steps": self.decode_steps,
+            "decode_windows": self.decode_windows,
+            "window_steps": self.window_steps,
             "swap_count": self.swap_count,
             "paged_kernel": self.paged_kernel,
             "kernel_invokes": dict(self.kernel_invokes),

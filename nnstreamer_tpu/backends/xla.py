@@ -151,6 +151,16 @@ class XLABackend(FilterBackend):
         # their HBM for outputs instead of allocating more)
         self.donated_invokes = 0
         self._donate = False         # resolved in open() (platform gate)
+        # compiled multi-step windows (invoke_window): K frames through
+        # one lax.scan dispatch — the scheduler-bypass hot path
+        self.window_invokes = 0
+        self.window_frames = 0
+        # window-scan traces are counted apart from compile_count: the
+        # latter means "per-frame bucket traces" to bucketing tests and
+        # the one-dispatch segment invariants, and a ("win", k) bucket
+        # is a second executable over the SAME per-frame bucket, not a
+        # new per-frame bucket
+        self.window_compile_count = 0
         # observed micro-batch occupancy, {n: invokes} — a first-class
         # sensor (tensor_filter.extra_stats → autotuner bucket
         # refinement) instead of making callers infer occupancy from
@@ -963,6 +973,101 @@ class XLABackend(FilterBackend):
         else:
             out = self._jitted(params, *staged)
         return _to_tuple(out)
+
+    def invoke_window(self, frames: List[ArrayTuple]) -> List[ArrayTuple]:
+        """Compiled multi-step window: K same-signature frames through
+        ONE ``jax.lax.scan`` whose body is exactly the per-frame full
+        function — one Python dispatch, one device program, K frames.
+        This is the scheduler-bypass hot path: the steady-state loop
+        (runtime/compiled_loop.py) collects the window, this runs it.
+
+        Guarantees the scheduler's bail matrix leans on:
+
+        - the scan body IS `_full_fn`, so outputs are bit-identical to
+          K per-frame invokes of the same bucket;
+        - version pick / epoch adoption happens ONCE at the window
+          boundary (the scheduler bails to per-frame when it sees a
+          pending swap, so adoption never lands mid-window);
+        - store invoke accounting records K invokes of dt/K each —
+          per-version counters reconcile exactly with per-frame mode.
+        """
+        import jax
+        import numpy as np_
+
+        k = len(frames)
+        self._seg_begin()
+        if self._store_entry is not None:
+            ver = self._pick_version()
+            vs = self._vstates[ver]
+            bundle = vs.bundle
+            ns = self._ns(ver)
+            packed = self._with_seg(
+                (vs.device_params, getattr(self, "_post_aux", None)))
+        else:
+            ver = None
+            bundle = self._bundle
+            ns = self._ns()
+            self._current_params()     # follow shared-entry reloads
+            packed = self._packed_params()
+        if bundle.host_pre is not None:
+            frames = [tuple(bundle.host_pre(tuple(f))) for f in frames]
+        n_in = len(frames[0])
+        stacked = tuple(
+            np_.stack([np_.asarray(f[i]) for f in frames], axis=0)
+            for i in range(n_in))
+        basekey = ("win", k) + tuple(
+            (tuple(a.shape[1:]), str(a.dtype)) for a in stacked)
+        full = self._full_fn(count=False,
+                             bundle=bundle if ver is not None else None)
+
+        def make():
+            self.window_compile_count += 1
+            def window_fn(p, *xs):
+                def body(carry, x):
+                    return carry, _to_tuple(full(carry, *x))
+                _, ys = jax.lax.scan(body, p, xs)
+                return ys
+            return jax.jit(window_fn)
+
+        jitted = self._bucket_jit((ns,) + basekey + self._seg_suffix(),
+                                  make=make)
+        staged, _ = self._stage(stacked)
+        prof = devprof.get()
+        if prof.enabled:
+            prof.note_dispatch(self._prof_label(), f"win:{k}")
+        t0 = time.perf_counter()
+        try:
+            ys = _to_tuple(jitted(packed, *staged))
+        except Exception:
+            if ver is not None:
+                self._record_invoke(ver, t0, error=True)
+            raise
+        dt = time.perf_counter() - t0
+        if ver is not None:
+            # K invokes of dt/K each: the per-version ledger counts the
+            # same frames whether or not the window path served them
+            for _ in range(k):
+                self._store_entry.record(ver, dt / k)
+        self.window_invokes += 1
+        self.window_frames += k
+        tr = self.tracer
+        if tr.active:
+            tr.backend_span(self.trace_name or "xla", "invoke_window",
+                            t0, t0 + dt, frames=k,
+                            **({"version": ver} if ver is not None
+                               else {}))
+        # unstack: row i of every output is frame i's output tuple
+        return [tuple(y[i] for y in ys) for i in range(k)]
+
+    def swap_pending(self) -> bool:
+        """True when the bound store entry flipped epochs since this
+        backend last adopted — the scheduler's compiled loop checks
+        this at window entry and bails to per-frame mode so adoption
+        happens at an ordinary invoke boundary (bail cause "swap")."""
+        if self._store_entry is None or self._pinned_version is not None:
+            return False
+        _, epoch = self._store_entry.state
+        return epoch != self.adopted_epoch
 
     # -- flexible shapes (invoke-dynamic analog) ---------------------------
     def invoke_flexible(self, regions: List[Any]) -> List[Any]:

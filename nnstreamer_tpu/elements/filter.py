@@ -125,6 +125,14 @@ class TensorFilter(Element):
             int, 0, "consecutive invoke failures to open the circuit (0=off)"),
         "breaker_cooldown_ms": PropDef(
             float, 1000.0, "open-circuit cooldown before the probe invoke"),
+        # scheduler bypass (runtime/compiled_loop.py): when the stream
+        # reaches steady state the scheduler may sweep queued frames
+        # into ONE jitted K-step scan (backend invoke_window) instead
+        # of K per-frame dispatches. Per-element opt-out; the global
+        # [runtime] compiled_loop knob gates the whole mechanism.
+        "compiled_loop": PropDef(
+            lambda s: str(s).lower() in ("1", "true"), True,
+            "allow the scheduler's compiled steady-state window"),
     }
 
     def __init__(self, name=None, **props):
@@ -603,7 +611,9 @@ class TensorFilter(Element):
         out = {}
         for k in ("compile_count", "cache_hits", "cache_misses",
                   "invoke_failures", "staging_transfers",
-                  "staging_elided", "donated_invokes"):
+                  "staging_elided", "donated_invokes",
+                  "window_invokes", "window_frames",
+                  "window_compile_count"):
             v = getattr(self.backend, k, None)
             if v is not None:
                 out["backend_" + k] = v
@@ -819,6 +829,71 @@ class TensorFilter(Element):
         self._lat_window.append(time.perf_counter() - t0)
         self._invoke_count += 1
         return [(0, buf.with_tensors(tuple(outputs)))]
+
+    # -- compiled steady-state window (scheduler bypass) --------------------
+    def window_capable(self) -> bool:
+        """Whether this element may serve frames through the compiled
+        multi-step window. The exclusions are exactly the paths whose
+        per-frame behavior is NOT a pure function of one fixed-shape
+        invoke: flexible/batched shapes (their own bucketing), replica
+        routing (per-frame placement decisions), an armed breaker
+        (per-frame failure accounting), sync latency mode (the point is
+        per-frame sync), and host-fallback segment members (host Python
+        per frame regardless)."""
+        return (bool(self.props["compiled_loop"])
+                and self.backend is not None
+                and hasattr(self.backend, "invoke_window")
+                and not self._flexible
+                and not self._dyn_batched
+                and self.replicas is None
+                and self._breaker is None
+                and self.props["latency_mode"] != "sync"
+                and not (self._member_stages
+                         and not self._segment_in_backend))
+
+    def swap_pending(self) -> bool:
+        """A store epoch flip this backend has not adopted yet — the
+        scheduler bails the window (cause "swap") so adoption happens
+        at an ordinary per-frame invoke boundary."""
+        fn = getattr(self.backend, "swap_pending", None)
+        return bool(fn()) if fn is not None else False
+
+    def process_window(self, pad: int,
+                       bufs: List[TensorBuffer]) -> List[Emission]:
+        """K same-signature frames through ONE compiled scan dispatch
+        (backend invoke_window). Host-side combination/pre/post stages
+        apply per frame exactly as `process()` would — outputs are
+        bit-identical to K per-frame calls; only the dispatch count
+        changes. Raises leave ALL K frames unconsumed semantically: the
+        scheduler re-runs them through the per-frame path so the error
+        lands on the precise frame that faulted."""
+        frames = []
+        for buf in bufs:
+            inputs = buf.tensors
+            if self._in_combination is not None:
+                inputs = tuple(inputs[i] for i in self._in_combination)
+            if self._pre is not None and not self._fused_in_backend:
+                inputs = self._pre(inputs)
+            frames.append(tuple(inputs))
+        t0 = time.perf_counter()
+        outs = self.backend.invoke_window(frames)
+        per = (time.perf_counter() - t0) / len(bufs)
+        emissions: List[Emission] = []
+        for buf, outputs in zip(bufs, outs):
+            if self._post is not None and not self._fused_in_backend:
+                outputs = self._post(outputs) \
+                    if self._fused_decoder is None \
+                    else self._post(outputs, self._host_decoder_aux())
+            self._lat_window.append(per)
+            self._invoke_count += 1
+            if self._out_combination is not None:
+                sel = []
+                for kind, idx in self._out_combination:
+                    sel.append(buf.tensors[idx] if kind == "i"
+                               else outputs[idx])
+                outputs = tuple(sel)
+            emissions.append((0, buf.with_tensors(tuple(outputs))))
+        return emissions
 
     # -- stats (reference latency/throughput props) ------------------------
     @property

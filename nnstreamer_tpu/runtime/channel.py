@@ -128,6 +128,20 @@ class Channel:
             self._not_full.notify()
             return item, len(self._buf)
 
+    def get_nowait(self) -> Tuple[Any, int]:
+        """Non-blocking get for compiled-window collection: ``(item,
+        depth_after_pop)`` when a buffered item exists, ``(CLOSED, 0)``
+        when closed *and* drained, ``(TIMED_OUT, 0)`` when merely empty.
+        Never sleeps — the scheduler's steady-state loop uses this to
+        sweep already-queued frames into one jitted window without ever
+        stalling the window boundary on a slow producer."""
+        with self._not_empty:
+            if not self._buf:
+                return (CLOSED, 0) if self._closed else (TIMED_OUT, 0)
+            item = self._buf.popleft()
+            self._not_full.notify()
+            return item, len(self._buf)
+
     # -- lifecycle / introspection ----------------------------------------
     def close(self) -> None:
         """Refuse further puts and wake every waiter (guaranteed

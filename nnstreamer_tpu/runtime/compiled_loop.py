@@ -1,0 +1,125 @@
+"""Steady-state detector for the scheduler's compiled multi-step loop.
+
+NNStreamer's claim is that pipeline overhead disappears next to the
+model; our per-frame hot path still pays Python on every frame —
+dispatch decision, tracer stamps, sync-window bookkeeping. For a
+pipeline in *steady state* (the same tensor signature frame after
+frame, which is what a camera or an open-loop benchmark produces), all
+of that work is identical per frame and can be amortized: after the
+detector arms, the scheduler sweeps the frames already queued on the
+element's channel into one window and hands them to a single jitted
+K-step ``jax.lax.scan`` body (`TensorFilter.process_window` →
+`XLABackend.invoke_window`), so the host thread touches Python once
+per window instead of once per frame.
+
+Entry and exit are *guarded*, never speculative:
+
+- the detector arms only after ``arm_after`` consecutive frames with an
+  identical signature (shapes + dtypes + dyn-batch count);
+- any divergence drops straight back to per-frame mode with the cause
+  accounted (``shape``, ``error``, ``swap``, ``timer``, ``eos``) and
+  stats reconciled exactly — a window that fails mid-flight re-runs its
+  frames through the ordinary per-frame path so error policies land on
+  the precise frame that faulted;
+- EOS drains whatever partial window was collected, then cascades.
+
+This module is deliberately host-only: signatures, arming, and the
+bail ledger. The jitted window itself lives in the backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+#: bail causes, in the order report() prints them
+BAIL_CAUSES = ("shape", "error", "swap", "timer", "eos")
+
+
+def frame_signature(buf) -> Optional[Tuple]:
+    """Steady-state identity of one frame: per-tensor (shape, dtype)
+    plus the dynamic-batch row count when present. Two frames with
+    equal signatures hit the same jitted bucket; a signature change is
+    exactly a recompile risk, which is exactly a window bail. Reads
+    only ``.shape``/``.dtype`` attributes — never materializes a
+    device array (this runs on the scheduler hot path, where an
+    implicit host sync would defeat the whole bypass)."""
+    try:
+        tensors = buf.tensors
+    except Exception:
+        return None           # non-tensor payloads never enter a window
+    rows = []
+    for t in tensors:
+        dt = getattr(t, "dtype", None)
+        if dt is None:        # dtype-less payload: stay per-frame
+            return None
+        rows.append((tuple(np.shape(t)), str(dt)))
+    sig: Tuple = tuple(rows)
+    dyn = buf.meta.get("dyn_batch") if isinstance(buf.meta, dict) else None
+    if isinstance(dyn, dict) and "n" in dyn:
+        sig = sig + (("dyn_n", int(dyn["n"])),)
+    return sig
+
+
+class SteadyStateDetector:
+    """Arms after ``arm_after`` consecutive identical-signature frames.
+
+    One detector per (runner, element). ``observe()`` is on the hot
+    path — a tuple compare and an int bump, nothing else.
+    """
+
+    __slots__ = ("arm_after", "_sig", "_streak")
+
+    def __init__(self, arm_after: int = 4):
+        self.arm_after = max(1, int(arm_after))
+        self._sig: Optional[Tuple] = None
+        self._streak = 0
+
+    def observe(self, sig: Optional[Tuple]) -> bool:
+        """Feed one frame's signature; returns True when armed (this
+        frame extends an identical streak of >= arm_after)."""
+        if sig is None:
+            self._sig, self._streak = None, 0
+            return False
+        if sig == self._sig:
+            self._streak += 1
+        else:
+            self._sig, self._streak = sig, 1
+        return self._streak >= self.arm_after
+
+    @property
+    def armed(self) -> bool:
+        return self._streak >= self.arm_after
+
+    @property
+    def signature(self) -> Optional[Tuple]:
+        return self._sig
+
+    def reset(self) -> None:
+        self._sig, self._streak = None, 0
+
+
+class LoopStats:
+    """Per-element compiled-loop ledger the scheduler owns.
+
+    ``entries`` counts windows entered, ``steps`` counts frames that
+    went through a compiled window (so ``steps / buffers`` is the
+    compiled-window share report() prints), ``bails`` counts armed
+    windows that fell back, by cause.
+    """
+
+    __slots__ = ("entries", "steps", "bails")
+
+    def __init__(self):
+        self.entries = 0
+        self.steps = 0
+        self.bails: Dict[str, int] = {}
+
+    def bail(self, cause: str) -> None:
+        self.bails[cause] = self.bails.get(cause, 0) + 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"loop_entries": self.entries,
+                "compiled_steps": self.steps,
+                "loop_bails": dict(self.bails)}

@@ -45,6 +45,16 @@ Host-path design (docs/performance.md):
   dispatches by syncing the OLDEST emitted output once the window
   overflows. Host-bound elements (WANTS_HOST sinks/encoders) stay the
   pipeline's sync points; EOS drains the window before propagating.
+- **Compiled steady-state loop** ([runtime] compiled_loop, default on):
+  after `compiled_loop_arm` consecutive identical-signature frames, an
+  eligible tensor_filter's worker sweeps the frames already queued on
+  its channel into one window (≤ `compiled_loop_window`) and runs them
+  as a SINGLE jitted `jax.lax.scan` dispatch
+  (`TensorFilter.process_window` → `XLABackend.invoke_window`) — the
+  per-frame Python loop is bypassed entirely in steady state. Any
+  divergence (signature change, error, pending model swap, armed
+  timer, EOS) bails back to per-frame mode with the cause accounted
+  and stats reconciled exactly (runtime/compiled_loop.py).
 """
 
 from __future__ import annotations
@@ -59,6 +69,9 @@ from nnstreamer_tpu.core.errors import PipelineError, StreamError
 from nnstreamer_tpu.core.log import get_logger
 from nnstreamer_tpu.graph.pipeline import Element, Link, Pipeline, SourceElement
 from nnstreamer_tpu.runtime.channel import CLOSED, TIMED_OUT, Channel
+from nnstreamer_tpu.runtime.compiled_loop import (LoopStats,
+                                                 SteadyStateDetector,
+                                                 frame_signature)
 from nnstreamer_tpu.runtime.sync import device_sync
 from nnstreamer_tpu.runtime.tracing import NULL_TRACER, Tracer
 from nnstreamer_tpu.tensor.buffer import TensorBuffer
@@ -195,7 +208,10 @@ class PipelineRunner:
                  watchdog_action: Optional[str] = None,
                  chain_fusion: Optional[bool] = None,
                  device_segments: Optional[bool] = None,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 compiled_loop: Optional[bool] = None,
+                 compiled_loop_window: Optional[int] = None,
+                 compiled_loop_arm: Optional[int] = None):
         self.pipeline = pipeline
         self._optimize = optimize
         # trace=False → NULL_TRACER (hot path pays one attribute load);
@@ -227,6 +243,24 @@ class PipelineRunner:
             max_inflight = get_config().get_int(
                 "runtime", "max_inflight", 8)
         self._max_inflight = max(0, max_inflight)
+        # compiled steady-state loop (scheduler bypass): arm after N
+        # identical-signature frames, then sweep ≤ K queued frames into
+        # one jitted lax.scan window per iteration
+        if compiled_loop is None:
+            compiled_loop = get_config().get_bool(
+                "runtime", "compiled_loop", True)
+        self._compiled_loop = bool(compiled_loop)
+        if compiled_loop_window is None:
+            compiled_loop_window = get_config().get_int(
+                "runtime", "compiled_loop_window", 8)
+        self._loop_window = max(2, compiled_loop_window)
+        if compiled_loop_arm is None:
+            compiled_loop_arm = get_config().get_int(
+                "runtime", "compiled_loop_arm", 4)
+        self._loop_arm = max(1, compiled_loop_arm)
+        # element name -> LoopStats; populated in _work only for
+        # elements that actually run with the loop enabled
+        self._loop_stats: Dict[str, LoopStats] = {}
         self._chains: Dict[str, List[Element]] = {}
         self._chain_member: Dict[str, str] = {}
         # built in start(), AFTER transform fusion removed elements —
@@ -445,6 +479,10 @@ class PipelineRunner:
             extra = getattr(e, "extra_stats", None)
             if extra is not None:
                 d.update(extra())
+            ls = self._loop_stats.get(name)
+            if ls is not None:
+                # loop_entries / compiled_steps / loop_bails{cause}
+                d.update(ls.snapshot())
             out[name] = d
         return out
 
@@ -492,6 +530,21 @@ class PipelineRunner:
                 continue     # mid-chain links have no queue at all
             lines.append(f"  {l.src.name} → {l.dst.name}: "
                          f"peak {d['queue_peak']}/{self._cap}")
+        loops = [(name, ls) for name, ls in sorted(self._loop_stats.items())
+                 if ls.entries or ls.steps or ls.bails]
+        if loops:
+            lines.append("")
+            lines.append("compiled steady-state windows (scheduler "
+                         "bypass, [runtime] compiled_loop):")
+            for name, ls in loops:
+                total = st.get(name, {}).get("buffers", 0)
+                share = 100.0 * ls.steps / total if total else 0.0
+                bails = " ".join(f"{c}={ls.bails[c]}"
+                                 for c in sorted(ls.bails)) or "none"
+                lines.append(
+                    f"  {name}: windows={ls.entries} "
+                    f"compiled_frames={ls.steps} ({share:.0f}% of "
+                    f"{total}) bails: {bails}")
         rob = [(name, d) for name, d in sorted(st.items())
                if any(d.get(k) for k in
                       ("errors", "retries", "skipped", "degraded",
@@ -1075,6 +1128,142 @@ class PipelineRunner:
             except Exception:
                 pass
 
+    def _run_compiled_window(self, elem, ch: Channel, stats: ElementStats,
+                             lstats: LoopStats,
+                             detector: SteadyStateDetector,
+                             pending: deque, window, tr, pad: int, item,
+                             t_enq: float, sig) -> bool:
+        """One compiled steady-state window attempt, starting at `item`
+        (detector already armed). Returns True when the frame was fully
+        consumed here — a window ran, or its frames were handed back
+        via `pending` for per-frame re-run; False when the caller must
+        process `item` through the ordinary per-frame path (entry bail,
+        or fewer than two matching frames queued).
+
+        Stats reconcile exactly on every path: a K-frame window records
+        K buffers of dt/K each (plus per-frame queue waits and tracer
+        process spans), and an errored window re-runs its frames
+        per-frame so the error policy lands on the precise frame that
+        faulted.
+        """
+        now = time.perf_counter()
+        # entry bails: state the jitted window must not bake in. Both
+        # are transient — the detector stays armed and the very next
+        # frame retries (swap adoption / timer fire happen per-frame).
+        if elem.swap_pending():
+            lstats.bail("swap")
+            if tr.active:
+                tr.record_loop_bail(elem.name, "swap", now)
+            return False
+        if elem.next_deadline() is not None:
+            lstats.bail("timer")
+            if tr.active:
+                tr.record_loop_bail(elem.name, "timer", now)
+            return False
+        batch = [(pad, item, t_enq)]
+        eos_msg = None
+        parked = None
+        while len(batch) < self._loop_window:
+            m, d = ch.get_nowait()
+            if m is TIMED_OUT or m is CLOSED:
+                break      # channel empty/closed — run with what we have
+            if tr.active:
+                tr.dequeue(elem.name, d, time.perf_counter())
+            p2, it2, _te2 = m
+            if it2 is EOS:
+                # the partial window runs first, then the EOS cascades
+                # via the ordinary path (flush + async-window drain)
+                eos_msg = m
+                lstats.bail("eos")
+                if tr.active:
+                    tr.record_loop_bail(elem.name, "eos",
+                                        time.perf_counter())
+                detector.reset()
+                break
+            if p2 != pad or frame_signature(it2) != sig:
+                # divergent frame: parked for per-frame processing
+                # after this window; the streak restarts behind it
+                parked = m
+                lstats.bail("shape")
+                if tr.active:
+                    tr.record_loop_bail(elem.name, "shape",
+                                        time.perf_counter())
+                detector.reset()
+                break
+            batch.append(m)
+        if len(batch) < 2:
+            # a window of one is just the per-frame path with extra
+            # steps — hand everything back
+            if parked is not None:
+                pending.append(parked)
+            if eos_msg is not None:
+                pending.append(eos_msg)
+            return False
+        # power-of-two round-down: every distinct K is its own jitted
+        # scan bucket, and queue depth would otherwise mint one per
+        # depth (measured: the open-loop serving A/B dropped 6x while
+        # K∈{2..8} each compiled). {2,4,8,...} bounds the cache to
+        # O(log window); the remainder runs per-frame via `pending`.
+        k = 1 << (len(batch).bit_length() - 1)
+        leftover = batch[k:]
+        batch = batch[:k]
+        t0 = time.perf_counter()
+        for _, _, te in batch:
+            if te:
+                stats.record_wait(t0 - te)
+        self._inflight[elem.name] = time.monotonic()
+        try:
+            emissions = elem.process_window(pad, [m[1] for m in batch])
+        except Exception:
+            # re-run every frame through the per-frame path so the
+            # error (and its fail-fast policy) lands on the precise
+            # frame that faulted — frames before it still emit. t_enq
+            # zeroed so queue-wait isn't double-counted.
+            lstats.bail("error")
+            if tr.active:
+                tr.record_loop_bail(elem.name, "error",
+                                    time.perf_counter())
+            detector.reset()
+            rerun = [(p, it, 0.0) for p, it, _ in batch]
+            rerun.extend(leftover)
+            if parked is not None:
+                rerun.append(parked)
+            if eos_msg is not None:
+                rerun.append(eos_msg)
+            pending.extendleft(reversed(rerun))
+            return True
+        finally:
+            self._inflight.pop(elem.name, None)
+        t1 = time.perf_counter()
+        lstats.entries += 1
+        lstats.steps += k
+        per = (t1 - t0) / k
+        for i, m in enumerate(batch):
+            stats.record(per)
+            if tr.active:
+                tr.record_process(elem.name, m[1], t0 + i * per,
+                                  t0 + (i + 1) * per)
+        if tr.active:
+            tr.record_compiled_window(elem.name, k, t0, t1)
+        self._consec_errors = 0
+        for sp, b in emissions:
+            self._emit(elem, sp, b)
+            if window is not None and isinstance(b, TensorBuffer) \
+                    and b.on_device:
+                window.append(b.tensors)
+        if window:
+            while len(window) > self._max_inflight:
+                device_sync(window.popleft(), forced=False)
+            if tr.active:
+                tr.record_inflight(elem.name, len(window),
+                                   time.perf_counter())
+        pending.extend(leftover)
+        if parked is not None:
+            pending.append(parked)
+        if eos_msg is not None:
+            pending.append(eos_msg)
+        return True
+
     def _work(self, elem: Element) -> None:
         ch = self._queues[elem.name]
         n_pads = max(1, len(self.pipeline.links_to(elem)))
@@ -1088,6 +1277,22 @@ class PipelineRunner:
         # emitted output once more than max_inflight are live, bounding
         # HBM held by in-flight results without a per-result sync
         window = deque() if elem.DEVICE_RESIDENT else None
+        # compiled steady-state loop: only fail-fast tensor_filters with
+        # a window-capable backend opt in (elements/filter.py
+        # window_capable); every other element pays one attribute probe
+        # at thread start and nothing per frame
+        loop_on = (self._compiled_loop and policy.kind == "fail"
+                   and getattr(elem, "window_capable", None) is not None
+                   and elem.window_capable())
+        detector = SteadyStateDetector(self._loop_arm) if loop_on else None
+        lstats = None
+        if loop_on:
+            lstats = self._loop_stats[elem.name] = LoopStats()
+        # frames drained off the channel but handed back by a window
+        # bail (shape divergence / error re-run / trailing EOS); always
+        # consumed, in order, before the channel is touched again, and
+        # never re-enter a window — ordering is preserved by construction
+        pending: deque = deque()
         try:
             while not self._stop_evt.is_set():
                 # deadline-aware wait: an element holding half-assembled
@@ -1107,14 +1312,21 @@ class PipelineRunner:
                             tr.record_timer(elem.name, now,
                                             time.perf_counter())
                         continue
-                msg, depth = ch.get(deadline)
-                if msg is CLOSED:     # teardown wakeup (stop()/_fail())
-                    return
-                if msg is TIMED_OUT:  # deadline due — loop fires on_timer
-                    continue
+                if pending:
+                    # bailed-window frames: already dequeued (and
+                    # traced) — just process them per-frame, in order
+                    msg = pending.popleft()
+                    from_pending = True
+                else:
+                    msg, depth = ch.get(deadline)
+                    if msg is CLOSED:  # teardown wakeup (stop()/_fail())
+                        return
+                    if msg is TIMED_OUT:  # deadline due — fires on_timer
+                        continue
+                    if tr.active:
+                        tr.dequeue(elem.name, depth, time.perf_counter())
+                    from_pending = False
                 pad, item, t_enq = msg
-                if tr.active:
-                    tr.dequeue(elem.name, depth, time.perf_counter())
                 if item is EOS:
                     eos_pads.add(pad)
                     if len(eos_pads) >= n_pads:
@@ -1142,6 +1354,17 @@ class PipelineRunner:
                         self._broadcast_eos(elem)
                         return
                     continue
+                # -- compiled steady-state window ----------------------
+                # bail-parked frames never re-enter a window (would
+                # reorder them past frames still in `pending`)
+                if detector is not None and not from_pending:
+                    sig = frame_signature(item)
+                    if detector.observe(sig) and \
+                            self._run_compiled_window(
+                                elem, ch, stats, lstats, detector,
+                                pending, window, tr, pad, item, t_enq,
+                                sig):
+                        continue
                 t0 = time.perf_counter()
                 if t_enq:
                     stats.record_wait(t0 - t_enq)

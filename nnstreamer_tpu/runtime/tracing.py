@@ -312,6 +312,18 @@ class NullTracer:
     def record_inflight(self, name, depth, t):
         pass
 
+    def record_compiled_window(self, name, k, t0, t1):
+        pass
+
+    def compiled_windows(self):
+        return {}
+
+    def record_loop_bail(self, name, cause, t):
+        pass
+
+    def loop_bails(self):
+        return {}
+
     def record_shed(self, name, cause, t, **args):
         pass
 
@@ -379,6 +391,13 @@ class Tracer:
         self._kernel_spans: Dict[Tuple[str, str], int] = {}
         # element name -> {"peak": max async in-flight depth sampled}
         self._inflight: Dict[str, Dict[str, int]] = {}
+        # element name -> {"windows": n, "frames": n} compiled
+        # steady-state windows (runtime/compiled_loop.py): kept whole
+        # like _forced so the compiled-window share survives ring wrap
+        self._compiled: Dict[str, Dict[str, int]] = {}
+        # element name -> {cause: count} of armed windows that fell
+        # back to per-frame mode (same keep-whole rationale)
+        self._loop_bails: Dict[str, Dict[str, int]] = {}
         # server name -> {cause: count} of admission sheds/rejections
         # (edge/query.py): kept whole like swaps — per-cause shed
         # totals must survive ring wrap under sustained overload
@@ -570,6 +589,38 @@ class Tracer:
 
     def inflight_gauges(self) -> Dict[str, dict]:
         return {name: dict(g) for name, g in self._inflight.items()}
+
+    def record_compiled_window(self, name: str, k: int, t0: float,
+                               t1: float) -> None:
+        """One compiled steady-state window (scheduler bypass,
+        runtime/compiled_loop.py): `k` frames ran as a single jitted
+        lax.scan dispatch. Counted wrap-proof per element so report()'s
+        compiled-window share survives ring wrap."""
+        c = self._compiled.get(name)
+        if c is None:
+            c = self._compiled[name] = {"windows": 0, "frames": 0}
+        c["windows"] += 1
+        c["frames"] += int(k)
+        self._append("X", "element", name, "compiled_window", t0,
+                     t1 - t0, {"frames": int(k)})
+
+    def compiled_windows(self) -> Dict[str, Dict[str, int]]:
+        """Per-element {"windows": n, "frames": n} totals (wrap-proof)."""
+        return {name: dict(c) for name, c in self._compiled.items()}
+
+    def record_loop_bail(self, name: str, cause: str, t: float) -> None:
+        """An armed compiled window fell back to per-frame mode; cause
+        is one of compiled_loop.BAIL_CAUSES."""
+        c = self._loop_bails.get(name)
+        if c is None:
+            c = self._loop_bails[name] = {}
+        c[cause] = c.get(cause, 0) + 1
+        self._append("i", "element", name, f"loop_bail_{cause}", t,
+                     0.0, None)
+
+    def loop_bails(self) -> Dict[str, Dict[str, int]]:
+        """Per-element {cause: count} bail totals (wrap-proof)."""
+        return {name: dict(c) for name, c in self._loop_bails.items()}
 
     def record_shed(self, name: str, cause: str, t: float,
                     **args) -> None:

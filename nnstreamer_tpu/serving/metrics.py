@@ -193,6 +193,21 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
             out.append(_series(f"{ns}_pool_{key}_total", "counter",
                                f"pool: {help_}",
                                [({}, float(p.get(key, 0)))]))
+        # same-host shared-memory transport (serving/shm.py): frames/
+        # bytes moved over the rings + hops that fell back to pickle.
+        # shm_frames + shm_fallbacks == dispatches + replies attempted,
+        # so the lane split is checkable from one scrape.
+        for key, help_ in (
+                ("shm_frames", "payload hops served over the "
+                               "shared-memory ring lane"),
+                ("shm_bytes", "payload bytes moved over the "
+                              "shared-memory rings"),
+                ("shm_fallbacks", "hops that fell back to the pickle "
+                                  "pipe lane (ring full / shm "
+                                  "unavailable)")):
+            out.append(_series(f"{ns}_{key}_total", "counter",
+                               f"pool shm transport: {help_}",
+                               [({}, float(p.get(key, 0)))]))
         for key, help_ in (("live", "live workers"),
                            ("ready", "ready workers"),
                            ("pending", "router backlog"),
@@ -391,6 +406,30 @@ def metrics_snapshot(tracer=None, admission: Optional[dict] = None,
                 "merged from that worker process)",
                 [({"element": name}, h)
                  for name, h in sorted(hists.items())]))
+        cw = tracer.compiled_windows() \
+            if hasattr(tracer, "compiled_windows") else {}
+        if cw:
+            out.append(_series(
+                f"{ns}_loop_entries_total", "counter",
+                "compiled steady-state windows entered per element "
+                "(scheduler bypass, runtime/compiled_loop.py)",
+                [({"element": n}, float(c["windows"]))
+                 for n, c in sorted(cw.items())]))
+            out.append(_series(
+                f"{ns}_compiled_steps_total", "counter",
+                "frames served through a compiled window per element",
+                [({"element": n}, float(c["frames"]))
+                 for n, c in sorted(cw.items())]))
+        bails = tracer.loop_bails() \
+            if hasattr(tracer, "loop_bails") else {}
+        if bails:
+            out.append(_series(
+                f"{ns}_loop_bails_total", "counter",
+                "armed compiled windows that fell back to per-frame "
+                "mode, by element and cause",
+                [({"element": n, "cause": c}, float(v))
+                 for n, causes in sorted(bails.items())
+                 for c, v in sorted(causes.items())]))
         forced = tracer.forced_syncs()
         if forced:
             out.append(_series(

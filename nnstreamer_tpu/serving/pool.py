@@ -161,6 +161,14 @@ class _Slot:
         self.version: Optional[tuple] = None
         self.bound_model: Optional[str] = None   # rebind() routing hint
         self.chips: tuple = ()        # leased device ordinals (placement)
+        # same-host shm lane (serving/shm.py): the parent-created ring
+        # pair for THIS process occupancy; shm_ok flips true only after
+        # the child acks attach at handshake, so the lane is negotiated,
+        # never assumed. spawns makes ring names unique per occupancy.
+        self.shm_req = None           # parent→child ring (parent writes)
+        self.shm_res = None           # child→parent ring (parent reads)
+        self.shm_ok = False
+        self.spawns = 0
 
     def hb_age_s(self, now: float) -> float:
         return now - max(self.last_hb, self.started_t)
@@ -184,6 +192,8 @@ class WorkerPool:
                  drain_timeout_s: float = 10.0,
                  spawn_grace_s: float = 20.0,
                  chips: Optional[Sequence[int]] = None,
+                 shm_transport: bool = True,
+                 shm_ring_bytes: int = 0,
                  name: str = "worker_pool"):
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
@@ -240,6 +250,18 @@ class WorkerPool:
         self.epoch = 0                # bumps on every committed swap
         self.degraded = 0             # slots disabled by the circuit
         self.reoffered = 0
+        # same-host shm lane (serving/shm.py): enabled pools give every
+        # slot a per-spawn ring pair; payloads that fit ride shared
+        # memory, everything else transparently stays on pickle+pipe
+        from nnstreamer_tpu.serving.shm import (
+            DEFAULT_RING_BYTES, shm_supported)
+
+        self.shm_transport = bool(shm_transport) and shm_supported()
+        self.shm_ring_bytes = int(shm_ring_bytes) or DEFAULT_RING_BYTES
+        self._shm_stat_lock = threading.Lock()
+        self.shm_frames = 0           # records moved via shm (both dirs)
+        self.shm_bytes = 0
+        self.shm_fallbacks = 0        # lane bypasses (full/unattached)
         self.rebinds = 0              # committed rebind broadcasts
         self.tenant_table = None      # serving.tenancy.TenantTable
         self.last_worker_error: Optional[BaseException] = None
@@ -308,6 +330,31 @@ class WorkerPool:
             import dataclasses
 
             spec = dataclasses.replace(spec, chips=slot.chips)
+        slot.spawns += 1
+        slot.shm_ok = False
+        if self.shm_transport:
+            # per-spawn ring pair with unique names: a respawned slot
+            # can never attach its predecessor's (possibly half-written)
+            # segments. Create failure degrades to pipe-only, silently.
+            import dataclasses
+
+            from nnstreamer_tpu.serving.shm import ShmRing, ring_name
+
+            try:
+                slot.shm_req = ShmRing.create(
+                    ring_name("rq", self.name, slot.wid, slot.spawns),
+                    self.shm_ring_bytes)
+                slot.shm_res = ShmRing.create(
+                    ring_name("rs", self.name, slot.wid, slot.spawns),
+                    self.shm_ring_bytes)
+                spec = dataclasses.replace(
+                    spec, shm_req=slot.shm_req.name,
+                    shm_res=slot.shm_res.name)
+            except Exception as e:
+                log.warning("pool %s: shm ring create failed (%s) — "
+                            "slot %d stays on pipe", self.name, e,
+                            slot.wid)
+                self._drop_rings(slot)
         parent_conn, child_conn = self._ctx.Pipe(duplex=True)
         proc = self._ctx.Process(
             target=worker_main, args=(child_conn, spec, slot.wid),
@@ -327,6 +374,19 @@ class WorkerPool:
         slot.reader.start()
         self._event(slot.wid, "spawn", pid=proc.pid)
 
+    def _drop_rings(self, slot: _Slot) -> None:
+        """Close AND unlink a slot's ring pair (parent is the creator,
+        so the name dies here — the /dev/shm audit in the worker-kill
+        drill counts on this being unconditional). Serialized against
+        in-flight ring writes via send_lock."""
+        with slot.send_lock:
+            slot.shm_ok = False
+            for ring in (slot.shm_req, slot.shm_res):
+                if ring is not None:
+                    ring.close()
+                    ring.unlink()
+            slot.shm_req = slot.shm_res = None
+
     # -- per-worker reader -------------------------------------------------
     def _read_loop(self, slot: _Slot, conn) -> None:
         """Drains one worker's pipe until EOF. Runs everything the
@@ -343,6 +403,8 @@ class WorkerPool:
                 slot.last_hb = time.monotonic()
             elif tag == "res":
                 self._on_result(slot, msg[1], msg[2])
+            elif tag == "ress":
+                self._on_shm_result(slot, msg[1], msg[2], msg[3])
             elif tag == "err":
                 self._on_request_error(slot, msg[1], msg[2])
             elif tag == "ready":
@@ -351,6 +413,16 @@ class WorkerPool:
                     if slot.state == STARTING:
                         slot.state = READY
                 info = msg[1]
+                if isinstance(info, dict) and slot.shm_req is not None:
+                    if info.get("shm"):
+                        slot.shm_ok = True
+                    else:
+                        # child couldn't attach: the lane is dead for
+                        # this occupancy — reclaim the segments now
+                        # rather than carrying them as ballast
+                        with self._shm_stat_lock:
+                            self.shm_fallbacks += 1
+                        self._drop_rings(slot)
                 t_child = info.get("t_perf") if isinstance(info, dict) \
                     else None
                 if t_child is not None:
@@ -442,6 +514,32 @@ class WorkerPool:
         self.qs.reply(int(req.client_id), buf.with_tensors(
             buf.tensors, pts=req.pts))
         self._dispatch_evt.set()
+
+    def _on_shm_result(self, slot: _Slot, rid: int, nbytes: int,
+                       seq: int) -> None:
+        """A result whose payload rode the res ring. Any ring fault
+        (mismatch, torn record, ring gone) sheds exactly this request —
+        the control message is still the unit of accounting, so
+        conservation can't drift whatever the lane does."""
+        ring = slot.shm_res
+        try:
+            if ring is None:
+                raise ValueError("shm result with no attached ring")
+            payload = ring.read_record(nbytes, seq)
+        except Exception as e:
+            log.warning("pool %s: worker %d shm result fault for "
+                        "rid=%s: %s", self.name, slot.wid, rid, e)
+            with self._lock:
+                req = slot.inflight.pop(rid, None)
+            if req is not None:
+                self.qs.frames.note_failed("worker_error", cls=req.cls)
+                self.qs.send_busy(req.client_id, req.pts, "worker_error")
+                self._dispatch_evt.set()
+            return
+        with self._shm_stat_lock:
+            self.shm_frames += 1
+            self.shm_bytes += nbytes
+        self._on_result(slot, rid, payload)
 
     def _on_request_error(self, slot: _Slot, rid: int,
                           exc_bytes: bytes) -> None:
@@ -546,7 +644,23 @@ class WorkerPool:
                 attempt=req.attempts)
         try:
             with slot.send_lock:
-                slot.conn.send(("req", req.rid, req.payload))
+                # same-host shm lane: payload into the req ring, a tiny
+                # control message on the pipe; ring-full (or no lane)
+                # falls back to the classic pickle+pipe send — same
+                # rid, same accounting, just a fatter message
+                seq = slot.shm_req.try_write(req.payload) \
+                    if slot.shm_ok and slot.shm_req is not None else None
+                if seq is not None:
+                    slot.conn.send(("reqs", req.rid, len(req.payload),
+                                    seq))
+                    with self._shm_stat_lock:
+                        self.shm_frames += 1
+                        self.shm_bytes += len(req.payload)
+                else:
+                    if slot.shm_ok:
+                        with self._shm_stat_lock:
+                            self.shm_fallbacks += 1
+                    slot.conn.send(("req", req.rid, req.payload))
         except (OSError, ValueError, BrokenPipeError):
             # worker died between pick and send: undo, let the
             # supervisor reap it; the request goes back to pending
@@ -627,6 +741,11 @@ class WorkerPool:
                             "after join — leaked", self.name, slot.wid)
         self._event(slot.wid, "exit", cause=cause, exitcode=exitcode,
                     pid=slot.pid)
+        # shm reclamation: the reader has drained (no more ring reads
+        # can race), the process is dead (no more ring writes) — close
+        # and unlink both segments so a killed worker leaks nothing;
+        # the replacement spawn creates a fresh, differently-named pair
+        self._drop_rings(slot)
         if self.chip_table is not None and slot.chips:
             # the dead worker's chips go out of service until the
             # replacement process re-leases them at _spawn
@@ -922,6 +1041,21 @@ class WorkerPool:
         with self._lock:
             return list(self._all_pids)
 
+    def shm_segments(self) -> List[str]:
+        """Names of this pool's shm segments still present in /dev/shm
+        — the shm half of the orphan audit: after close() (or a reap)
+        this must be empty for the affected slots, exactly like
+        `all_pids_ever` must be all-dead."""
+        from nnstreamer_tpu.serving.shm import shm_safe
+
+        marker = f"_{shm_safe(self.name)}_"
+        try:
+            return sorted(n for n in os.listdir("/dev/shm")
+                          if n.startswith("nns_") and marker in n
+                          and n.endswith(f"_{os.getpid()}"))
+        except OSError:
+            return []
+
     def kill_worker(self, wid: Optional[int] = None,
                     sig: int = signal.SIGKILL) -> Optional[int]:
         """Chaos surface: signal one live worker (default SIGKILL,
@@ -954,6 +1088,7 @@ class WorkerPool:
                 "replied": s.replied,
                 "bound_model": s.bound_model,
                 "chips": list(s.chips),
+                "shm": s.shm_ok,
             } for s in self._slots]
             return {
                 "pool": {
@@ -969,6 +1104,9 @@ class WorkerPool:
                     "pending": len(self._pending),
                     "epoch": self.epoch,
                     "rebinds": self.rebinds,
+                    "shm_frames": self.shm_frames,
+                    "shm_bytes": self.shm_bytes,
+                    "shm_fallbacks": self.shm_fallbacks,
                 },
                 "workers": workers,
                 **({"chips": self.chip_table.snapshot()}
@@ -1067,6 +1205,7 @@ class WorkerPool:
                 pass
             if slot.reader is not None:
                 slot.reader.join(timeout=2)
+            self._drop_rings(slot)
             self._event(slot.wid, "drain_stop", pid=slot.pid)
         # 6. transport down last: every owed BUSY has been sent
         self.qs.pool = None

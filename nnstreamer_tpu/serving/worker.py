@@ -102,6 +102,12 @@ class WorkerSpec:
     preload: tuple = ()                   # ((name, version, ref), ...)
     resident_models: int = 0
     resident_bytes: int = 0
+    # same-host shared-memory lane (serving/shm.py): names of the two
+    # per-spawn rings the supervisor created for this slot ("" = pipe
+    # only). The child *attaches*; attach failure is not an error — it
+    # acks ``shm: False`` at handshake and both sides stay on pickle.
+    shm_req: str = ""                     # parent→child payload ring
+    shm_res: str = ""                     # child→parent payload ring
     # chip ownership (serving/placement.ChipLeaseTable): device ordinals
     # this worker is leased. Informational to the child (it pins its
     # own placement from these); authoritative to the SUPERVISOR, which
@@ -530,9 +536,32 @@ def worker_main(conn, spec: WorkerSpec, wid: int = 0) -> None:
     point: one wedged/GIL-bound worker never slows its siblings."""
     send_lock = threading.Lock()
 
+    # same-host shm lane: attach the supervisor's rings, or silently
+    # stay on pickle — the handshake ack below tells the parent which
+    shm_req_ring = shm_res_ring = None
+    if spec.shm_req and spec.shm_res:
+        try:
+            from nnstreamer_tpu.serving.shm import ShmRing
+
+            shm_req_ring = ShmRing.attach(spec.shm_req)
+            shm_res_ring = ShmRing.attach(spec.shm_res)
+        except Exception:
+            if shm_req_ring is not None:
+                shm_req_ring.close()
+            shm_req_ring = shm_res_ring = None
+
     def reply(msg) -> None:
         try:
             with send_lock:
+                # result payloads ride the res ring when they fit; the
+                # ring write lands BEFORE the control send (and both
+                # under send_lock), so ring order == pipe order and the
+                # parent's reader never guesses
+                if shm_res_ring is not None and msg[0] == "res":
+                    seq = shm_res_ring.try_write(msg[2])
+                    if seq is not None:
+                        conn.send(("ress", msg[1], len(msg[2]), seq))
+                        return
                 conn.send(msg)
         except (OSError, ValueError, BrokenPipeError):
             os._exit(0)               # parent gone — never orphan
@@ -572,7 +601,8 @@ def worker_main(conn, spec: WorkerSpec, wid: int = 0) -> None:
     # offset at handshake (pool.py "ready" handler) so shipped trace
     # timestamps align on one pool-wide timeline
     reply(("ready", dict(service.ready_info(), pid=os.getpid(),
-                         wid=wid, t_perf=time.perf_counter())))
+                         wid=wid, t_perf=time.perf_counter(),
+                         shm=shm_res_ring is not None)))
     swap_state: dict = {}
     try:
         while True:
@@ -581,8 +611,19 @@ def worker_main(conn, spec: WorkerSpec, wid: int = 0) -> None:
             except (EOFError, OSError):
                 os._exit(1)           # supervisor died — exit, no orphan
             tag = msg[0]
-            if tag == "req":
-                _, rid, payload = msg
+            if tag == "req" or tag == "reqs":
+                if tag == "reqs":
+                    # payload rode the req ring; the control message
+                    # promised (nbytes, seq) — any mismatch is a
+                    # request-scoped error, recovered by redelivery
+                    _, rid, nbytes, seq = msg
+                    try:
+                        payload = shm_req_ring.read_record(nbytes, seq)
+                    except BaseException as e:
+                        reply(("err", rid, _pickle_exc(e)))
+                        continue
+                else:
+                    _, rid, payload = msg
                 try:
                     service.serve(rid, payload, reply)
                 except BaseException as e:
@@ -602,6 +643,10 @@ def worker_main(conn, spec: WorkerSpec, wid: int = 0) -> None:
         hb.stop()
         if service is not None:
             service.close()
+        # close (never unlink — the creator owns the name) the shm lane
+        for ring in (shm_req_ring, shm_res_ring):
+            if ring is not None:
+                ring.close()
     if tracer is not None:
         # final drain: a graceful stop must not strand the tail of the
         # trace in the child (the heartbeat cadence may not have fired

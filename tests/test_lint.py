@@ -634,6 +634,70 @@ def test_nnl012_blessed_in_parallel_and_sharding():
     })
 
 
+# -- NNL013 shm-safety -------------------------------------------------------
+
+BAD_SHM = '''
+import mmap
+import pickle
+from multiprocessing import shared_memory
+
+def open_segment(name, frames):
+    seg = shared_memory.SharedMemory(name=name, create=True, size=4096)
+    ring = mmap.mmap(-1, 4096)                     # second lifetime story
+    for f in frames:
+        blob = pickle.dumps(f)                     # per-frame re-serialize
+        seg.buf[:len(blob)] = blob
+    return seg, ring
+'''
+
+GOOD_SHM = '''
+import pickle
+from nnstreamer_tpu.serving.shm import ShmRing, ring_name
+
+def open_rings(pool, wid, spawn, frames):
+    ring = ShmRing.create(ring_name("rq", pool, wid, spawn))
+    blob = pickle.dumps(frames)          # hoisted: once per batch
+    for _ in frames:
+        ring.try_write(blob)
+    return ring
+'''
+
+
+def test_nnl013_fires_on_segment_lifetime_outside_shm_module():
+    findings = assert_fires(
+        "NNL013", {"nnstreamer_tpu/serving/fix.py": BAD_SHM}, n_min=4)
+    msgs = " ".join(f.message for f in findings)
+    # all three arms: the import, each construction site, and the
+    # per-frame pickle.dumps in the hot loop
+    assert "multiprocessing.shared_memory" in msgs
+    assert "SharedMemory" in msgs and "mmap.mmap" in msgs
+    assert "pickle.dumps" in msgs
+
+
+def test_nnl013_silent_on_routing_through_shm_ring():
+    assert_silent("NNL013",
+                  {"nnstreamer_tpu/serving/fix.py": GOOD_SHM})
+
+
+def test_nnl013_blessed_in_the_shm_module_itself():
+    # serving/shm.py IS the lifetime owner — the rule keeps segments
+    # from being constructed anywhere else. (The hot-loop pickle arm
+    # still applies there, so strip the loop body for this fixture.)
+    segments_only = BAD_SHM.replace("blob = pickle.dumps(f)",
+                                    "blob = bytes(f)")
+    assert_silent("NNL013",
+                  {"nnstreamer_tpu/serving/shm.py": segments_only})
+
+
+def test_nnl013_per_frame_pickle_scoped_to_serving():
+    # a pickle loop outside serving/ is someone else's trade-off; the
+    # segment-construction arm still applies everywhere
+    assert_silent("NNL013", {REPO_PATHS["runtime"]: GOOD_SHM})
+    findings = assert_fires("NNL013", {REPO_PATHS["runtime"]: BAD_SHM},
+                            n_min=3)
+    assert not any("pickle.dumps" in f.message for f in findings)
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_inline_suppression_waives_a_finding():

@@ -274,6 +274,54 @@ class TestMeshExposition:
         assert sum(local.values()) == (9 - 1) + (31 - 1)
 
 
+class TestLoopAndShmExposition:
+    def test_shm_transport_counters_round_trip(self):
+        """ISSUE 20 satellite: the pool's shm-lane counters survive
+        render → parse, typed as counters, and the lane split
+        (shm_frames vs shm_fallbacks) is visible from one scrape."""
+        pool = _pool()
+        pool["pool"].update(shm_frames=80, shm_bytes=5_242_880,
+                            shm_fallbacks=2)
+        parsed = parse_prometheus(render_prometheus(metrics_snapshot(
+            pool=pool)))
+        for fam, want in (("nns_shm_frames_total", 80.0),
+                          ("nns_shm_bytes_total", 5242880.0),
+                          ("nns_shm_fallbacks_total", 2.0)):
+            assert parsed[fam]["type"] == "counter"
+            assert parsed[fam].get("help")
+            assert parsed[fam]["samples"][fam] == want
+
+    def test_pipe_only_pool_still_exports_zeroed_lane(self):
+        # a pool that never used shm still exposes the families at 0 —
+        # dashboards don't need existence checks
+        parsed = parse_prometheus(render_prometheus(metrics_snapshot(
+            pool=_pool())))
+        assert parsed["nns_shm_frames_total"]["samples"][
+            "nns_shm_frames_total"] == 0.0
+
+    def test_compiled_loop_counters_round_trip(self):
+        """Windows entered / frames windowed / bails-by-cause as
+        recorded by the scheduler's tracer hooks, scraped back."""
+        tr = _traced(6, name="f")
+        t0 = time.perf_counter()
+        tr.record_compiled_window("f", 4, t0, t0 + 1e-3)
+        tr.record_compiled_window("f", 2, t0, t0 + 2e-3)
+        tr.record_loop_bail("f", "eos", t0)
+        tr.record_loop_bail("f", "shape", t0)
+        tr.record_loop_bail("f", "shape", t0)
+        parsed = parse_prometheus(render_prometheus(metrics_snapshot(
+            tracer=tr)))
+        assert parsed["nns_loop_entries_total"]["samples"][
+            'nns_loop_entries_total{element="f"}'] == 2.0
+        assert parsed["nns_compiled_steps_total"]["samples"][
+            'nns_compiled_steps_total{element="f"}'] == 6.0
+        fam = parsed["nns_loop_bails_total"]
+        assert fam["type"] == "counter"
+        by_cause = {re.search(r'cause="([^"]+)"', k).group(1): v
+                    for k, v in fam["samples"].items()}
+        assert by_cause == {"eos": 1.0, "shape": 2.0}
+
+
 def _sharded_replicas(invokes=(6, 4), fenced=None):
     """Synthetic ShardedReplicaSet.stats() — the shape placement's
     ReplicaSet emits plus the shard-group keys sharding.py adds."""
